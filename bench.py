@@ -1,0 +1,151 @@
+"""North-star benchmark: 100-node MNIST MLP FedAvg simulation, 10 rounds.
+
+BASELINE.json: "FL rounds/sec & sec/round (100-node MNIST FedAvg); final
+test-acc parity", target >= 50x wall-clock vs the Ray+PyTorch CPU baseline,
+zero host-side weight transfers during aggregation.
+
+The TPU path runs the whole experiment as ONE jitted XLA program
+(p2pfl_tpu.parallel.MeshSimulation): weights stay in HBM across all rounds.
+The baseline is a faithful stand-in for the reference's per-node compute: an
+identical MLP trained per committee member with an eager PyTorch CPU loop
+(the reference's simulation executes exactly this inside Ray actors,
+p2pfl/learning/frameworks/simulation/actor_pool.py:38-63 — our measurement
+omits Ray/gossip overhead, which makes the baseline strictly conservative).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where value
+is TPU sec/round and vs_baseline is the speedup factor (baseline sec/round /
+TPU sec/round).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def _phase(msg: str) -> None:
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
+
+NUM_NODES = 100
+ROUNDS = 10
+EPOCHS = 1
+COMMITTEE = 4
+BATCH = 64
+SAMPLES_PER_NODE = 600  # MNIST 60k / 100 nodes
+TEST_SAMPLES = 1024
+
+
+def bench_tpu() -> dict:
+    from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.parallel.simulation import MeshSimulation
+
+    import jax
+    import jax.numpy as jnp
+
+    _phase("generating data on device")
+
+    # Same distribution as synthetic_mnist (class templates + noise), but
+    # generated directly on the accelerator: with a tunneled TPU, uploading
+    # the ~190MB stacked dataset dominates startup otherwise.
+    @jax.jit
+    def make_data(key):
+        kt, ky, kn, kyt, knt = jax.random.split(key, 5)
+        templates = jax.random.uniform(kt, (10, 28, 28), jnp.float32)
+        y = jax.random.randint(ky, (NUM_NODES, SAMPLES_PER_NODE), 0, 10)
+        x = jnp.clip(
+            templates[y]
+            + 0.35 * jax.random.normal(kn, (NUM_NODES, SAMPLES_PER_NODE, 28, 28)),
+            0.0,
+            1.0,
+        )
+        mask = jnp.ones((NUM_NODES, SAMPLES_PER_NODE), jnp.float32)
+        yt = jax.random.randint(kyt, (TEST_SAMPLES,), 0, 10)
+        xt = jnp.clip(
+            templates[yt] + 0.35 * jax.random.normal(knt, (TEST_SAMPLES, 28, 28)), 0.0, 1.0
+        )
+        return x, y.astype(jnp.int32), mask, xt, yt.astype(jnp.int32)
+
+    x, y, mask, xt, yt = make_data(jax.random.key(42))
+    jax.block_until_ready(x)
+    _phase("building simulation")
+    sim = MeshSimulation(
+        mlp_model(seed=0),
+        (x, y, mask),
+        test_data=(xt, yt),
+        train_set_size=COMMITTEE,
+        batch_size=BATCH,
+        seed=1,
+    )
+    _phase("warmup compile + timed run")
+    res = sim.run(rounds=ROUNDS, epochs=EPOCHS, warmup=True)
+    _phase(f"tpu done: {res.seconds_per_round:.4f}s/round acc={res.test_acc[-1]:.3f}")
+    return {
+        "sec_per_round": res.seconds_per_round,
+        "rounds_per_sec": 1.0 / res.seconds_per_round,
+        "final_test_acc": res.test_acc[-1],
+    }
+
+
+def bench_torch_cpu_baseline() -> float:
+    """One federated round of committee compute, eager PyTorch CPU.
+
+    Returns sec/round (committee of COMMITTEE nodes, EPOCHS local epochs
+    each, same model/batch/data sizes as the TPU path).
+    """
+    import numpy as np
+    import torch
+    from torch import nn
+
+    torch.manual_seed(0)
+    rng = np.random.default_rng(0)
+    x = torch.from_numpy(rng.normal(size=(SAMPLES_PER_NODE, 784)).astype(np.float32))
+    y = torch.from_numpy(rng.integers(0, 10, size=SAMPLES_PER_NODE).astype(np.int64))
+
+    def one_node_epoch() -> None:
+        model = nn.Sequential(
+            nn.Flatten(), nn.Linear(784, 256), nn.ReLU(), nn.Linear(256, 128),
+            nn.ReLU(), nn.Linear(128, 10),
+        )
+        opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+        loss_fn = nn.CrossEntropyLoss()
+        for _ in range(EPOCHS):
+            for i in range(0, SAMPLES_PER_NODE, BATCH):
+                opt.zero_grad()
+                loss = loss_fn(model(x[i : i + BATCH]), y[i : i + BATCH])
+                loss.backward()
+                opt.step()
+
+    one_node_epoch()  # warmup
+    t0 = time.monotonic()
+    for _ in range(COMMITTEE):
+        one_node_epoch()
+    return time.monotonic() - t0
+
+
+def main() -> None:
+    tpu = bench_tpu()
+    _phase("torch cpu baseline")
+    baseline_sec_per_round = bench_torch_cpu_baseline()
+    _phase("baseline done")
+    value = tpu["sec_per_round"]
+    out = {
+        "metric": "sec_per_round_100node_mnist_fedavg",
+        "value": round(value, 6),
+        "unit": "s/round",
+        "vs_baseline": round(baseline_sec_per_round / value, 3),
+        "extra": {
+            "rounds_per_sec": round(tpu["rounds_per_sec"], 3),
+            "final_test_acc": round(tpu["final_test_acc"], 4),
+            "baseline_sec_per_round_torch_cpu": round(baseline_sec_per_round, 6),
+            "rounds": ROUNDS,
+            "nodes": NUM_NODES,
+            "committee": COMMITTEE,
+        },
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""p2pfl_tpu — a TPU-native decentralized federated learning framework.
+
+Capability-equivalent to the reference p2pfl (peer-to-peer federated learning
+over gossip; see /root/reference and SURVEY.md), re-designed TPU-first:
+
+* local training is a jitted XLA computation (``lax.scan`` over batches) with
+  parameters resident in HBM,
+* aggregation math (FedAvg / median / trimmed-mean / Krum / SCAFFOLD) runs as
+  jitted kernels over stacked parameter pytrees,
+* large-scale simulation shards the federated population over a
+  ``jax.sharding.Mesh`` (one slab of nodes per TPU device) instead of a Ray
+  actor pool, keeping the whole multi-round loop on device,
+* the host control plane (gossip, heartbeats, voting, commands) is a
+  transport-agnostic protocol with in-memory and gRPC implementations, and a
+  safe (no-pickle) flat-buffer wire format for weights.
+
+Public API mirrors the reference's capabilities (reference: p2pfl/node.py:57):
+
+    from p2pfl_tpu import Node
+    node = Node(model, data, aggregator=FedAvg())
+    node.start(); node.connect(addr)
+    node.set_start_learning(rounds=3, epochs=1)
+"""
+
+__version__ = "0.1.0"
+
+from p2pfl_tpu.config import Settings  # noqa: F401
+
+__all__ = ["Settings", "Node", "__version__"]
+
+
+def __getattr__(name):  # lazy import to keep `import p2pfl_tpu` light
+    if name == "Node":
+        from p2pfl_tpu.node import Node
+
+        return Node
+    raise AttributeError(f"module 'p2pfl_tpu' has no attribute {name!r}")

@@ -1,0 +1,4 @@
+"""Communication layer: envelopes, protocols, gossip, membership."""
+
+from p2pfl_tpu.comm.envelope import Envelope  # noqa: F401
+from p2pfl_tpu.comm.protocol import CommunicationProtocol  # noqa: F401

@@ -1,0 +1,232 @@
+"""The framework's command set.
+
+Parity with the reference's commands (SURVEY.md §2.3, p2pfl/communication/
+commands/message/*.py and weights/*.py). Each command captures the node
+facade and manipulates its state / learner / aggregator exactly like the
+reference handlers:
+
+* control plane: start_learning, stop_learning, model_initialized,
+  vote_train_set, models_aggregated, models_ready, metrics
+* model plane (weights payloads): init_model, partial_model, full_model
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import TYPE_CHECKING, Any, List
+
+from p2pfl_tpu.comm.commands.command import Command
+
+if TYPE_CHECKING:  # pragma: no cover
+    from p2pfl_tpu.node import Node
+
+log = logging.getLogger("p2pfl_tpu")
+
+
+class StartLearningCommand(Command):
+    """Kick off a learning session on this node
+    (reference message/start_learning_command.py:26-79)."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "start_learning"
+
+    def execute(self, source: str, round: int, *args: str, **kwargs: Any) -> None:
+        rounds, epochs = int(args[0]), int(args[1])
+        self._node.start_learning_thread(rounds, epochs)
+
+
+class StopLearningCommand(Command):
+    """(reference message/stop_learning_command.py:30)"""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "stop_learning"
+
+    def execute(self, source: str, round: int, *args: str, **kwargs: Any) -> None:
+        self._node.stop_learning_locally()
+
+
+class ModelInitializedCommand(Command):
+    """Peer announced an initialized model: nei_status[src] = -1
+    (reference message/model_initialized_command.py:25)."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "model_initialized"
+
+    def execute(self, source: str, round: int, *args: str, **kwargs: Any) -> None:
+        self._node.state.nei_status[source] = -1
+
+
+class VoteTrainSetCommand(Command):
+    """Store a peer's committee votes; args are a flat
+    [candidate, weight, ...] list (reference
+    message/vote_train_set_command.py:28-56: accept round r or r+1 because
+    votes may arrive before the local round increments)."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "vote_train_set"
+
+    def execute(self, source: str, round: int, *args: str, **kwargs: Any) -> None:
+        state = self._node.state
+        current = state.round
+        if current is None or round not in (current, current + 1):
+            log.debug("vote from %s for round %s ignored (local round %s)", source, round, current)
+            return
+        votes = {args[i]: int(args[i + 1]) for i in range(0, len(args) - 1, 2)}
+        with state.train_set_votes_lock:
+            state.train_set_votes[source] = votes
+        state.votes_ready_event.set()
+
+
+class ModelsAggregatedCommand(Command):
+    """Track a trainset peer's partial-aggregation progress
+    (reference message/models_agregated_command.py:26)."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "models_aggregated"
+
+    def execute(self, source: str, round: int, *args: str, **kwargs: Any) -> None:
+        state = self._node.state
+        if state.round is not None and round == state.round:
+            state.models_aggregated[source] = list(args)
+
+
+class ModelsReadyCommand(Command):
+    """Peer finished its round (reference message/models_ready_command.py:26:
+    accept round-1 or round)."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "models_ready"
+
+    def execute(self, source: str, round: int, *args: str, **kwargs: Any) -> None:
+        state = self._node.state
+        current = state.round
+        if current is None or round not in (current - 1, current):
+            return
+        state.nei_status[source] = round
+
+
+class MetricsCommand(Command):
+    """Peer metrics broadcast (reference message/metrics_command.py:26);
+    args = flat [name, value, ...]."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "metrics"
+
+    def execute(self, source: str, round: int, *args: str, **kwargs: Any) -> None:
+        for i in range(0, len(args) - 1, 2):
+            self._node.log_remote_metric(source, round, args[i], float(args[i + 1]))
+
+
+class InitModelCommand(Command):
+    """Adopt initial weights if we don't have a model yet
+    (reference weights/init_model_command.py:31-97)."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "init_model"
+
+    def execute(self, source: str, round: int, *args: str, **kwargs: Any) -> None:
+        state = self._node.state
+        if state.model_initialized_event.is_set():
+            return
+        weights: bytes = kwargs["weights"]
+        try:
+            self._node.learner.get_model().set_parameters(weights)
+            state.model_initialized_event.set()
+            self._node.protocol.broadcast(
+                self._node.protocol.build_msg(ModelInitializedCommand.get_name())
+            )
+        except Exception:
+            log.exception("init_model from %s failed", source)
+
+
+class PartialModelCommand(Command):
+    """Merge a partially-aggregated model from a trainset peer, then
+    re-announce progress (reference weights/partial_model_command.py:33-112)."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "partial_model"
+
+    def execute(self, source: str, round: int, *args: str, **kwargs: Any) -> None:
+        node = self._node
+        state = node.state
+        if state.round is None:
+            return
+        if round != state.round:
+            log.debug("partial model for round %s ignored (local %s)", round, state.round)
+            return
+        weights: bytes = kwargs["weights"]
+        contributors: List[str] = list(kwargs.get("contributors", []))
+        num_samples: int = int(kwargs.get("num_samples", 1))
+        model = node.learner.get_model().build_copy(
+            params=weights, contributors=contributors, num_samples=num_samples
+        )
+        agg = node.aggregator.add_model(model)
+        if agg:
+            node.protocol.broadcast(
+                node.protocol.build_msg(
+                    ModelsAggregatedCommand.get_name(), args=agg, round=state.round
+                )
+            )
+
+
+class FullModelCommand(Command):
+    """Adopt the round's fully-aggregated model
+    (reference weights/full_model_command.py:31-89)."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "full_model"
+
+    def execute(self, source: str, round: int, *args: str, **kwargs: Any) -> None:
+        node = self._node
+        state = node.state
+        if state.round is None:
+            return
+        if round < state.round:
+            return
+        weights: bytes = kwargs["weights"]
+        try:
+            node.learner.get_model().set_parameters(weights)
+            state.last_full_model_round = max(state.last_full_model_round, round)
+            state.aggregated_model_event.set()
+        except Exception:
+            log.exception("full_model from %s failed", source)

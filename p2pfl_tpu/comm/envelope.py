@@ -1,0 +1,69 @@
+"""Transport-agnostic message envelope.
+
+Plays the role of the reference's protobuf ``RootMessage`` with its
+``Message``/``Weights`` oneof (grpc/proto/node.proto:26-59): a command name
+plus either small string args (control plane, TTL-gossiped) or a weights
+payload (model plane). Both transports carry this same shape — the in-memory
+transport passes the dataclass directly, the gRPC transport maps it onto its
+proto schema.
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from p2pfl_tpu.config import Settings
+
+
+@dataclass
+class Envelope:
+    source: str
+    cmd: str
+    round: int = 0
+    args: List[str] = field(default_factory=list)
+    ttl: int = 0
+    msg_id: int = 0
+    payload: Optional[bytes] = None  # serialized weights (ops.serialization)
+    contributors: List[str] = field(default_factory=list)
+    num_samples: int = 0
+
+    @property
+    def is_weights(self) -> bool:
+        return self.payload is not None
+
+    @staticmethod
+    def message(source: str, cmd: str, args: Optional[List[str]] = None, round: int = 0) -> "Envelope":
+        """Control-plane message with fresh TTL and a random dedup id
+        (reference grpc_client.py:56-88)."""
+        return Envelope(
+            source=source,
+            cmd=cmd,
+            round=round,
+            args=[str(a) for a in (args or [])],
+            ttl=Settings.TTL,
+            msg_id=secrets.randbits(63),
+        )
+
+    @staticmethod
+    def weights(
+        source: str,
+        cmd: str,
+        round: int,
+        payload: bytes,
+        contributors: List[str],
+        num_samples: int,
+    ) -> "Envelope":
+        """Model-plane message (reference grpc_client.py:90-123). Not
+        TTL-gossiped; routed point-to-point by the model gossip loop."""
+        return Envelope(
+            source=source,
+            cmd=cmd,
+            round=round,
+            ttl=0,
+            msg_id=secrets.randbits(63),
+            payload=payload,
+            contributors=list(contributors),
+            num_samples=int(num_samples),
+        )

@@ -1,0 +1,154 @@
+"""Gossip engine: async message diffusion + synchronous model gossip.
+
+Parity with reference communication/protocols/gossiper.py:31-239:
+
+* **async path** — pending (envelope, targets) pairs drained every
+  ``GOSSIP_PERIOD``, at most ``GOSSIP_MESSAGES_PER_PERIOD`` per tick
+  (:124-155 in the reference), with a bounded dedup ring of recently-seen
+  message ids (:101-122),
+* **sync path** — ``gossip_weights``: a paced loop that asks for candidate
+  peers, exits when candidates are empty or progress stalls for
+  ``GOSSIP_EXIT_ON_X_EQUAL_ROUNDS`` consecutive rounds, and sends
+  ``GOSSIP_MODELS_PER_ROUND`` models per tick (:163-239).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from collections import OrderedDict, deque
+from typing import Any, Callable, List, Optional, Tuple
+
+from p2pfl_tpu.comm.envelope import Envelope
+from p2pfl_tpu.config import Settings
+
+
+class Gossiper:
+    """Owns the async gossip thread; the sync weights gossip runs on the
+    caller's thread (stage machine)."""
+
+    def __init__(
+        self,
+        self_addr: str,
+        send_fn: Callable[[str, Envelope], None],
+        get_direct_neighbors_fn: Callable[[], List[str]],
+    ) -> None:
+        self._self_addr = self_addr
+        self._send = send_fn
+        self._get_direct = get_direct_neighbors_fn
+        self._pending: deque[Tuple[Envelope, List[str]]] = deque()
+        self._pending_lock = threading.Lock()
+        self._processed: "OrderedDict[int, None]" = OrderedDict()
+        self._processed_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # --- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name=f"gossiper-{self._self_addr}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    # --- dedup (reference gossiper.py:101-122) ------------------------------
+
+    def check_and_set_processed(self, msg_id: int) -> bool:
+        """True if unseen (and records it); False if duplicate."""
+        if msg_id == 0:
+            return True
+        with self._processed_lock:
+            if msg_id in self._processed:
+                return False
+            self._processed[msg_id] = None
+            while len(self._processed) > Settings.AMOUNT_LAST_MESSAGES_SAVED:
+                self._processed.popitem(last=False)
+            return True
+
+    # --- async message gossip ----------------------------------------------
+
+    def add_message(self, env: Envelope, targets: Optional[List[str]] = None) -> None:
+        """Queue a message for diffusion to ``targets`` (default: direct
+        neighbors except the message source)."""
+        if targets is None:
+            targets = [n for n in self._get_direct() if n != env.source]
+        if not targets:
+            return
+        with self._pending_lock:
+            self._pending.append((env, targets))
+
+    def _run(self) -> None:
+        while not self._stop.wait(Settings.GOSSIP_PERIOD):
+            budget = Settings.GOSSIP_MESSAGES_PER_PERIOD
+            while budget > 0:
+                with self._pending_lock:
+                    if not self._pending:
+                        break
+                    env, targets = self._pending.popleft()
+                for t in targets:
+                    try:
+                        self._send(t, env)
+                    except Exception:
+                        pass  # peer may be gone; failure detector handles it
+                budget -= len(targets) or 1
+
+    # --- sync model gossip (reference gossiper.py:163-239) ------------------
+
+    def gossip_weights(
+        self,
+        early_stopping_fn: Callable[[], bool],
+        get_candidates_fn: Callable[[], List[str]],
+        status_fn: Callable[[], Any],
+        model_fn: Callable[[str], Optional[Envelope]],
+        period: Optional[float] = None,
+        max_rounds: Optional[int] = None,
+    ) -> None:
+        """Paced diffusion of model weights until convergence.
+
+        Each tick: stop if ``early_stopping_fn`` or no candidates; stop if
+        ``status_fn()`` hasn't changed for ``GOSSIP_EXIT_ON_X_EQUAL_ROUNDS``
+        ticks; otherwise sample ``GOSSIP_MODELS_PER_ROUND`` candidates and
+        send each ``model_fn(candidate)``.
+        """
+        period = Settings.GOSSIP_MODELS_PERIOD if period is None else period
+        equal_rounds = 0
+        last_status: Any = None
+        ticker = threading.Event()
+        rounds = 0
+        while True:
+            if early_stopping_fn():
+                return
+            if max_rounds is not None and rounds >= max_rounds:
+                return
+            rounds += 1
+            candidates = get_candidates_fn()
+            if not candidates:
+                return
+            status = status_fn()
+            if status == last_status:
+                equal_rounds += 1
+                if equal_rounds >= Settings.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS:
+                    return
+            else:
+                equal_rounds = 0
+                last_status = status
+            sample = random.sample(
+                candidates, min(Settings.GOSSIP_MODELS_PER_ROUND, len(candidates))
+            )
+            for nei in sample:
+                env = model_fn(nei)
+                if env is None:
+                    continue
+                try:
+                    self._send(nei, env)
+                except Exception:
+                    pass
+            if ticker.wait(period):  # plain sleep, interruptible-style
+                return

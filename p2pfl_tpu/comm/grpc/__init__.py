@@ -1,0 +1,3 @@
+"""gRPC transport for real (multi-process / multi-host) federations."""
+
+from p2pfl_tpu.comm.grpc.grpc_protocol import GrpcCommunicationProtocol  # noqa: F401

@@ -1,0 +1,72 @@
+"""Heartbeat-based membership / failure detector.
+
+Parity with reference communication/protocols/heartbeater.py:33-113: a thread
+broadcasts a ``beat`` every ``HEARTBEAT_PERIOD``; every second tick it sweeps
+neighbors whose last_seen is older than ``HEARTBEAT_TIMEOUT``. Incoming beats
+call :meth:`beat` -> ``neighbors.refresh_or_add`` — this is how non-direct
+neighbors are discovered.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from p2pfl_tpu.comm.envelope import Envelope
+from p2pfl_tpu.comm.neighbors import Neighbors
+from p2pfl_tpu.config import Settings
+
+HEARTBEAT_CMD = "beat"
+
+
+class Heartbeater:
+    def __init__(
+        self,
+        self_addr: str,
+        neighbors: Neighbors,
+        broadcast_fn: Callable[[Envelope], None],
+    ) -> None:
+        self._self_addr = self_addr
+        self._neighbors = neighbors
+        self._broadcast = broadcast_fn
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name=f"heartbeater-{self._self_addr}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def beat(self, source: str, timestamp: float) -> None:
+        """Incoming heartbeat (reference heartbeater.py:66-80)."""
+        if source == self._self_addr:
+            return
+        self._neighbors.refresh_or_add(source)
+
+    def _run(self) -> None:
+        tick = 0
+        while not self._stop.is_set():
+            try:
+                env = Envelope.message(
+                    self._self_addr, HEARTBEAT_CMD, args=[str(time.time())]
+                )
+                self._broadcast(env)
+            except Exception:
+                pass
+            tick += 1
+            if tick % 2 == 0:  # sweep stale neighbors (reference :85-105)
+                now = time.time()
+                for addr, seen in self._neighbors.last_seen().items():
+                    if now - seen > Settings.HEARTBEAT_TIMEOUT:
+                        self._neighbors.remove(addr, notify=False)
+            if self._stop.wait(Settings.HEARTBEAT_PERIOD):
+                return

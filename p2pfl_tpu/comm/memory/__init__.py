@@ -1,0 +1,3 @@
+"""In-process transport for single-host simulation and tests."""
+
+from p2pfl_tpu.comm.memory.memory_protocol import InMemoryCommunicationProtocol  # noqa: F401

@@ -1,0 +1,99 @@
+"""In-memory communication protocol.
+
+Parity with reference memory/memory_communication_protocol.py:33-66 +
+memory_client.py:30-87: same envelope semantics as the gRPC transport but
+delivery is a registry lookup + handoff to the receiver's executor (which
+models the gRPC server's thread pool, so handlers never run reentrantly on
+the sender's stack — avoiding the lock-inversion deadlocks a purely
+synchronous in-proc transport would create).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from dataclasses import replace
+from typing import Optional
+
+from p2pfl_tpu.comm.envelope import Envelope
+from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+from p2pfl_tpu.comm.neighbors import Neighbors
+from p2pfl_tpu.comm.protocol import CommunicationProtocol
+from p2pfl_tpu.exceptions import CommunicationError
+
+
+class _InMemoryNeighbors(Neighbors):
+    def connect_to(self, addr: str, *, handshake: bool):
+        peer = InMemoryRegistry.lookup(addr)
+        if peer is None:
+            raise CommunicationError(f"no in-memory server at {addr}")
+        if handshake:
+            peer.accept_handshake(self.self_addr)
+        return addr  # connection object is just the address
+
+    def disconnect_from(self, addr: str, conn, *, notify: bool) -> None:
+        if notify:
+            peer = InMemoryRegistry.lookup(addr)
+            if peer is not None:
+                peer.accept_disconnect(self.self_addr)
+
+
+class InMemoryCommunicationProtocol(CommunicationProtocol):
+    """Single-process transport backed by a global registry."""
+
+    def __init__(self, addr: Optional[str] = None) -> None:
+        self._executor: Optional[concurrent.futures.ThreadPoolExecutor] = None
+        super().__init__(addr)
+
+    def _default_addr(self) -> str:
+        return InMemoryRegistry.fresh_addr()
+
+    def _build_neighbors(self, addr: str) -> Neighbors:
+        return _InMemoryNeighbors(addr)
+
+    # --- server side --------------------------------------------------------
+
+    def _server_start(self) -> None:
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix=f"memsrv-{self.addr}"
+        )
+        InMemoryRegistry.register(self.addr, self)
+
+    def _server_stop(self) -> None:
+        InMemoryRegistry.unregister(self.addr)
+        if self._executor is not None:
+            self._executor.shutdown(wait=False, cancel_futures=True)
+            self._executor = None
+
+    def accept_handshake(self, source_addr: str) -> None:
+        """Remote side of connect (reference grpc_server.py:135-143)."""
+        if not self._running:
+            raise CommunicationError(f"{self.addr} is not started")
+        self.neighbors.add(source_addr, non_direct=False, handshake=False)
+
+    def accept_disconnect(self, source_addr: str) -> None:
+        self.neighbors.remove(source_addr, notify=False)
+
+    def deliver(self, env: Envelope) -> None:
+        """Entry point for inbound envelopes (the "RPC")."""
+        if not self._running or self._executor is None:
+            raise CommunicationError(f"{self.addr} is not started")
+        self._executor.submit(self._handle_safely, env)
+
+    def _handle_safely(self, env: Envelope) -> None:
+        try:
+            self.handle_envelope(env)
+        except Exception:
+            import logging
+
+            logging.getLogger("p2pfl_tpu").exception(
+                "error handling %s from %s at %s", env.cmd, env.source, self.addr
+            )
+
+    # --- client side --------------------------------------------------------
+
+    def _transport_send(self, nei: str, env: Envelope) -> None:
+        peer = InMemoryRegistry.lookup(nei)
+        if peer is None:
+            raise CommunicationError(f"no in-memory server at {nei}")
+        # Copy the envelope so receivers can't mutate the sender's view.
+        peer.deliver(replace(env, args=list(env.args), contributors=list(env.contributors)))

@@ -1,0 +1,96 @@
+"""Thread-safe neighbor table.
+
+Parity with reference communication/protocols/neighbors.py:27-167: direct
+neighbors (we hold a live connection) vs non-direct neighbors (learned about
+via heartbeat gossip); refresh-or-add keeps last-seen timestamps for the
+failure detector.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class Neighbors:
+    """addr -> (connection, direct, last_seen). Transports subclass to build
+    real connections in :meth:`connect_to`."""
+
+    def __init__(self, self_addr: str) -> None:
+        self.self_addr = self_addr
+        self._lock = threading.RLock()
+        self._neighbors: Dict[str, Tuple[Any, bool, float]] = {}
+
+    # --- transport hooks ----------------------------------------------------
+
+    def connect_to(self, addr: str, *, handshake: bool) -> Any:
+        """Build a transport connection object. Default: no connection state.
+        Raising here aborts :meth:`add`."""
+        return None
+
+    def disconnect_from(self, addr: str, conn: Any, *, notify: bool) -> None:
+        """Tear down a transport connection object."""
+
+    # --- table --------------------------------------------------------------
+
+    def add(self, addr: str, *, non_direct: bool = False, handshake: bool = True) -> bool:
+        if addr == self.self_addr:
+            return False
+        with self._lock:
+            existing = self._neighbors.get(addr)
+            if existing is not None:
+                conn, direct, _ = existing
+                if direct or non_direct:
+                    # Already at least as connected as requested: refresh.
+                    self._neighbors[addr] = (conn, direct, time.time())
+                    return True
+        # Build the connection outside the lock (may do network IO).
+        conn = None
+        if not non_direct:
+            conn = self.connect_to(addr, handshake=handshake)
+        with self._lock:
+            self._neighbors[addr] = (conn, not non_direct, time.time())
+        return True
+
+    def refresh_or_add(self, addr: str) -> None:
+        """Heartbeat path (reference heartbeater.py:66-80): update last_seen,
+        or learn a new non-direct neighbor."""
+        with self._lock:
+            existing = self._neighbors.get(addr)
+            if existing is not None:
+                conn, direct, _ = existing
+                self._neighbors[addr] = (conn, direct, time.time())
+                return
+        self.add(addr, non_direct=True)
+
+    def remove(self, addr: str, *, notify: bool = False) -> None:
+        with self._lock:
+            entry = self._neighbors.pop(addr, None)
+        if entry is not None and entry[0] is not None:
+            try:
+                self.disconnect_from(addr, entry[0], notify=notify)
+            except Exception:
+                pass
+
+    def exists(self, addr: str, *, only_direct: bool = False) -> bool:
+        with self._lock:
+            e = self._neighbors.get(addr)
+            return e is not None and (e[1] or not only_direct)
+
+    def get(self, addr: str) -> Optional[Any]:
+        with self._lock:
+            e = self._neighbors.get(addr)
+            return e[0] if e else None
+
+    def get_all(self, only_direct: bool = False) -> List[str]:
+        with self._lock:
+            return [a for a, (_, direct, _) in self._neighbors.items() if direct or not only_direct]
+
+    def last_seen(self) -> Dict[str, float]:
+        with self._lock:
+            return {a: t for a, (_, _, t) in self._neighbors.items()}
+
+    def clear(self) -> None:
+        for addr in self.get_all():
+            self.remove(addr, notify=True)

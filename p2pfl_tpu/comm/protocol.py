@@ -1,0 +1,244 @@
+"""CommunicationProtocol: the transport-agnostic composition root.
+
+Parity with the reference's CommunicationProtocol ABC
+(communication/protocols/communication_protocol.py:27-198) and the per-
+transport composition roots (grpc_communication_protocol.py:50-263,
+memory_communication_protocol.py:33-66). Design departure: the reference
+duplicates the Neighbors+Client+Gossiper+Server+Heartbeater wiring in each
+transport; here the base class owns the composition and transports supply
+three factories (server, client-send, neighbors), so both transports share
+one tested code path.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable, List, Optional
+
+from p2pfl_tpu.comm.commands.command import Command, CommandDispatcher
+from p2pfl_tpu.comm.envelope import Envelope
+from p2pfl_tpu.comm.gossiper import Gossiper
+from p2pfl_tpu.comm.heartbeater import HEARTBEAT_CMD, Heartbeater
+from p2pfl_tpu.comm.neighbors import Neighbors
+from p2pfl_tpu.exceptions import (
+    CommunicationError,
+    NeighborNotConnectedError,
+    ProtocolNotStartedError,
+)
+
+
+def running(fn: Callable) -> Callable:
+    """Guard decorator: raise unless the protocol has been started
+    (reference grpc_communication_protocol.py:38-47)."""
+
+    @functools.wraps(fn)
+    def wrapper(self: "CommunicationProtocol", *args: Any, **kwargs: Any) -> Any:
+        if not self._running:
+            raise ProtocolNotStartedError(f"{fn.__name__} requires a started protocol")
+        return fn(self, *args, **kwargs)
+
+    return wrapper
+
+
+class CommunicationProtocol:
+    """Base protocol: membership + gossip + command dispatch.
+
+    Subclasses implement :meth:`_build_neighbors`, :meth:`_server_start`,
+    :meth:`_server_stop`, and :meth:`_transport_send`.
+    """
+
+    def __init__(self, addr: Optional[str] = None) -> None:
+        self._addr = addr or self._default_addr()
+        self._running = False
+        self._lock = threading.Lock()
+        self.dispatcher = CommandDispatcher()
+        self.neighbors = self._build_neighbors(self._addr)
+        self.gossiper = Gossiper(
+            self._addr,
+            send_fn=self._safe_send,
+            get_direct_neighbors_fn=lambda: self.neighbors.get_all(only_direct=True),
+        )
+        self.heartbeater = Heartbeater(self._addr, self.neighbors, self.broadcast)
+        # auto-register the heartbeat handler (reference
+        # grpc_communication_protocol.py:63-89)
+        protocol = self
+
+        class _BeatCommand(Command):
+            @staticmethod
+            def get_name() -> str:
+                return HEARTBEAT_CMD
+
+            def execute(self, source: str, round: int, *args: str, **kwargs: Any) -> None:
+                ts = float(args[0]) if args else 0.0
+                protocol.heartbeater.beat(source, ts)
+
+        self.dispatcher.register([_BeatCommand()])
+
+    # --- transport hooks ----------------------------------------------------
+
+    def _default_addr(self) -> str:
+        raise NotImplementedError
+
+    def _build_neighbors(self, addr: str) -> Neighbors:
+        raise NotImplementedError
+
+    def _server_start(self) -> None:
+        raise NotImplementedError
+
+    def _server_stop(self) -> None:
+        raise NotImplementedError
+
+    def _transport_send(self, nei: str, env: Envelope) -> None:
+        """Deliver one envelope to a connected neighbor (may raise)."""
+        raise NotImplementedError
+
+    # --- lifecycle (reference communication_protocol.py:56-77) --------------
+
+    @property
+    def addr(self) -> str:
+        return self._addr
+
+    def get_address(self) -> str:
+        return self._addr
+
+    def start(self) -> None:
+        if self._running:
+            return
+        self._server_start()
+        # _running must be set before the heartbeater launches: its thread
+        # broadcasts immediately and would hit the @running guard, delaying
+        # first-beat membership discovery by a full HEARTBEAT_PERIOD.
+        self._running = True
+        self.heartbeater.start()
+        self.gossiper.start()
+
+    def stop(self) -> None:
+        if not self._running:
+            return
+        self._running = False
+        self.heartbeater.stop()
+        self.gossiper.stop()
+        self.neighbors.clear()
+        self._server_stop()
+
+    # --- membership ---------------------------------------------------------
+
+    @running
+    def connect(self, addr: str, non_direct: bool = False) -> bool:
+        try:
+            return self.neighbors.add(addr, non_direct=non_direct)
+        except Exception as exc:
+            raise CommunicationError(f"could not connect to {addr}: {exc}") from exc
+
+    @running
+    def disconnect(self, addr: str, notify: bool = True) -> None:
+        self.neighbors.remove(addr, notify=notify)
+
+    @running
+    def get_neighbors(self, only_direct: bool = False) -> List[str]:
+        return self.neighbors.get_all(only_direct=only_direct)
+
+    # --- messaging (reference communication_protocol.py:95-160) -------------
+
+    def build_msg(self, cmd: str, args: Optional[List[str]] = None, round: int = 0) -> Envelope:
+        return Envelope.message(self._addr, cmd, args=args, round=round)
+
+    def build_weights(
+        self,
+        cmd: str,
+        round: int,
+        serialized_model: bytes,
+        contributors: Optional[List[str]] = None,
+        num_samples: int = 1,
+    ) -> Envelope:
+        return Envelope.weights(
+            self._addr, cmd, round, serialized_model, list(contributors or []), num_samples
+        )
+
+    @running
+    def send(
+        self,
+        nei: str,
+        env: Envelope,
+        create_connection: bool = False,
+        raise_error: bool = True,
+        remove_on_error: bool = True,
+    ) -> None:
+        """Unicast with the reference's failure semantics
+        (grpc_client.py:124-192): on send failure the neighbor is dropped."""
+        if not self.neighbors.exists(nei):
+            if create_connection:
+                self.neighbors.add(nei, non_direct=False)
+            elif raise_error:
+                raise NeighborNotConnectedError(f"{nei} is not a neighbor")
+            else:
+                return
+        try:
+            self._transport_send(nei, env)
+        except Exception as exc:
+            if remove_on_error:
+                self.neighbors.remove(nei, notify=False)
+            if raise_error:
+                raise CommunicationError(f"send to {nei} failed: {exc}") from exc
+
+    def _safe_send(self, nei: str, env: Envelope) -> None:
+        if not self._running:
+            return
+        self.send(nei, env, raise_error=False, remove_on_error=True)
+
+    @running
+    def broadcast(self, env: Envelope, node_list: Optional[List[str]] = None) -> None:
+        """Send to every direct neighbor (reference grpc_client.py:194-208)."""
+        for nei in node_list if node_list is not None else self.neighbors.get_all(only_direct=True):
+            self.send(nei, env, raise_error=False, remove_on_error=True)
+
+    # --- command wiring -----------------------------------------------------
+
+    def add_command(self, cmds: Command | List[Command]) -> None:
+        self.dispatcher.register(cmds if isinstance(cmds, list) else [cmds])
+
+    # --- inbound (called by transport servers) ------------------------------
+
+    def handle_envelope(self, env: Envelope) -> None:
+        """Inbound dispatch with dedup + TTL re-gossip
+        (reference grpc_server.py:161-212)."""
+        if env.is_weights:
+            self.dispatcher.dispatch(
+                env.cmd,
+                env.source,
+                env.round,
+                weights=env.payload,
+                contributors=env.contributors,
+                num_samples=env.num_samples,
+            )
+            return
+        if not self.gossiper.check_and_set_processed(env.msg_id):
+            return
+        self.dispatcher.dispatch(env.cmd, env.source, env.round, *env.args)
+        if env.ttl > 1:
+            fwd = Envelope(
+                source=env.source,
+                cmd=env.cmd,
+                round=env.round,
+                args=env.args,
+                ttl=env.ttl - 1,
+                msg_id=env.msg_id,
+            )
+            self.gossiper.add_message(fwd)
+
+    # --- model gossip (reference communication_protocol.py:162-198) ---------
+
+    @running
+    def gossip_weights(
+        self,
+        early_stopping_fn: Callable[[], bool],
+        get_candidates_fn: Callable[[], List[str]],
+        status_fn: Callable[[], Any],
+        model_fn: Callable[[str], Optional[Envelope]],
+        period: Optional[float] = None,
+        create_connection: bool = False,
+    ) -> None:
+        self.gossiper.gossip_weights(
+            early_stopping_fn, get_candidates_fn, status_fn, model_fn, period
+        )

@@ -1,0 +1,101 @@
+"""Global configuration.
+
+Capability parity with the reference's ``Settings`` class-attribute config
+(reference: p2pfl/settings.py:8-153), upgraded with typed accessors, an
+environment-variable override layer (``P2PFL_TPU_<NAME>``) and a scoped
+``overridden()`` context manager — the reference mutates class attributes
+directly with no load/save story (SURVEY.md §5 "Config / flag system").
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any, Iterator
+
+
+def _env_override(name: str, default: Any) -> Any:
+    raw = os.environ.get(f"P2PFL_TPU_{name}")
+    if raw is None:
+        return default
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+class Settings:
+    """Process-wide tunables.
+
+    Defaults track the reference's (p2pfl/settings.py:34-148) so that round
+    pacing, timeouts and gossip rates behave identically out of the box.
+    Tests shrink them via :func:`p2pfl_tpu.utils.utils.set_test_settings`.
+    """
+
+    # --- transport ---------------------------------------------------------
+    GRPC_TIMEOUT: float = _env_override("GRPC_TIMEOUT", 10.0)
+    USE_SSL: bool = _env_override("USE_SSL", False)
+    SSL_SERVER_KEY: str = _env_override("SSL_SERVER_KEY", "")
+    SSL_SERVER_CRT: str = _env_override("SSL_SERVER_CRT", "")
+    SSL_CLIENT_KEY: str = _env_override("SSL_CLIENT_KEY", "")
+    SSL_CLIENT_CRT: str = _env_override("SSL_CLIENT_CRT", "")
+    SSL_CA_CRT: str = _env_override("SSL_CA_CRT", "")
+    MAX_MESSAGE_BYTES: int = _env_override("MAX_MESSAGE_BYTES", 1 << 30)  # 1 GiB
+
+    # --- membership / failure detection ------------------------------------
+    HEARTBEAT_PERIOD: float = _env_override("HEARTBEAT_PERIOD", 2.0)
+    HEARTBEAT_TIMEOUT: float = _env_override("HEARTBEAT_TIMEOUT", 5.0)
+    WAIT_HEARTBEATS_CONVERGENCE: float = _env_override("WAIT_HEARTBEATS_CONVERGENCE", 4.0)
+
+    # --- gossip -------------------------------------------------------------
+    TTL: int = _env_override("TTL", 10)
+    GOSSIP_PERIOD: float = _env_override("GOSSIP_PERIOD", 0.1)
+    GOSSIP_MESSAGES_PER_PERIOD: int = _env_override("GOSSIP_MESSAGES_PER_PERIOD", 100)
+    GOSSIP_MODELS_PERIOD: float = _env_override("GOSSIP_MODELS_PERIOD", 1.0)
+    GOSSIP_MODELS_PER_ROUND: int = _env_override("GOSSIP_MODELS_PER_ROUND", 2)
+    GOSSIP_EXIT_ON_X_EQUAL_ROUNDS: int = _env_override("GOSSIP_EXIT_ON_X_EQUAL_ROUNDS", 10)
+    AMOUNT_LAST_MESSAGES_SAVED: int = _env_override("AMOUNT_LAST_MESSAGES_SAVED", 100)
+
+    # --- learning round -----------------------------------------------------
+    TRAIN_SET_SIZE: int = _env_override("TRAIN_SET_SIZE", 4)
+    VOTE_TIMEOUT: float = _env_override("VOTE_TIMEOUT", 60.0)
+    AGGREGATION_TIMEOUT: float = _env_override("AGGREGATION_TIMEOUT", 300.0)
+
+    # --- observability ------------------------------------------------------
+    LOG_LEVEL: str = _env_override("LOG_LEVEL", "INFO")
+    LOG_DIR: str = _env_override("LOG_DIR", "logs")
+    RESOURCE_MONITOR_PERIOD: float = _env_override("RESOURCE_MONITOR_PERIOD", 1.0)
+
+    # --- TPU execution ------------------------------------------------------
+    # Default dtype for training compute. bfloat16 feeds the MXU at full rate;
+    # aggregation math stays float32 for parity with the reference's numpy.
+    COMPUTE_DTYPE: str = _env_override("COMPUTE_DTYPE", "bfloat16")
+    # Disable device-mesh simulation (mirror of the reference's DISABLE_RAY).
+    DISABLE_MESH: bool = _env_override("DISABLE_MESH", False)
+
+    @classmethod
+    def snapshot(cls) -> dict[str, Any]:
+        """Copy of all current settings (upper-case attributes only)."""
+        return {k: getattr(cls, k) for k in dir(cls) if k.isupper()}
+
+    @classmethod
+    def restore(cls, snap: dict[str, Any]) -> None:
+        for k, v in snap.items():
+            setattr(cls, k, v)
+
+    @classmethod
+    @contextlib.contextmanager
+    def overridden(cls, **kwargs: Any) -> Iterator[None]:
+        """Scoped settings override (mainly for tests)."""
+        snap = cls.snapshot()
+        try:
+            for k, v in kwargs.items():
+                if k not in snap:
+                    raise AttributeError(f"unknown setting {k!r}")
+                setattr(cls, k, v)
+            yield
+        finally:
+            cls.restore(snap)

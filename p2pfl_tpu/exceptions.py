@@ -1,0 +1,42 @@
+"""Framework exceptions.
+
+Parity with the reference's exception surface (p2pfl/exceptions.py,
+p2pfl/communication/protocols/exceptions.py,
+p2pfl/learning/frameworks/exceptions.py — SURVEY.md §2.1).
+"""
+
+
+class P2pflTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class NodeRunningException(P2pflTpuError):
+    """Operation requires the node to be stopped (or vice versa)."""
+
+
+class ZeroRoundsException(P2pflTpuError):
+    """Learning was started with zero rounds."""
+
+
+class LearningRunningException(P2pflTpuError):
+    """Operation not allowed while a learning session is in progress."""
+
+
+class ProtocolNotStartedError(P2pflTpuError):
+    """The communication protocol was used before ``start()``."""
+
+
+class NeighborNotConnectedError(P2pflTpuError):
+    """Tried to message a neighbor that is not connected."""
+
+
+class CommunicationError(P2pflTpuError):
+    """Transport-level send/connect failure."""
+
+
+class DecodingParamsError(P2pflTpuError):
+    """Received a weights payload that could not be decoded."""
+
+
+class ModelNotMatchingError(P2pflTpuError):
+    """Received parameters do not match the local model's structure."""

@@ -1,0 +1,1 @@
+"""Learning layer: datasets, learners, aggregators."""

@@ -1,0 +1,9 @@
+"""Aggregators: round-scoped accumulators wrapping the jitted kernels."""
+
+from p2pfl_tpu.learning.aggregators.base import Aggregator  # noqa: F401
+from p2pfl_tpu.learning.aggregators.fedavg import FedAvg  # noqa: F401
+from p2pfl_tpu.learning.aggregators.fedmedian import FedMedian  # noqa: F401
+from p2pfl_tpu.learning.aggregators.robust import Krum, TrimmedMean  # noqa: F401
+from p2pfl_tpu.learning.aggregators.scaffold import Scaffold  # noqa: F401
+
+__all__ = ["Aggregator", "FedAvg", "FedMedian", "Krum", "TrimmedMean", "Scaffold"]

@@ -1,0 +1,166 @@
+"""Round-scoped model accumulator.
+
+Capability parity with the reference Aggregator base
+(p2pfl/learning/aggregators/aggregator.py:35-270): a per-round accumulator
+that nodes and gossip handlers feed models into, with
+
+* contributor-set dedup (a model is redundant if its contributors are a
+  subset of what we already merged — reference :113-175),
+* trainset membership checks,
+* a completion event set once every trainset member is covered,
+* ``wait_and_get_aggregation`` blocking with timeout and aggregating whatever
+  arrived (reference :177-207),
+* ``get_partial_model(except_nodes)`` for partial-aggregation gossip
+  (reference :219-270): combine everything the peer hasn't seen.
+
+Thread-safety: a single RLock guards the model table; completion is an Event.
+The reference's lock choreography releases an unacquired lock on edge cases
+(aggregator.py:113-118, noted in SURVEY.md §7) — Events avoid that class of
+bug.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.models.model_handle import ModelHandle
+
+
+class Aggregator:
+    """Base class; subclasses implement :meth:`aggregate`."""
+
+    #: whether intermediate subsets may be merged eagerly and re-gossiped
+    #: (FedAvg-style linear rules) — reference ``partial_aggregation`` flag.
+    partial_aggregation: bool = False
+
+    def __init__(self) -> None:
+        self.node_addr = "unknown-node"
+        self._lock = threading.RLock()
+        self._finish_event = threading.Event()
+        self._train_set: List[str] = []
+        self._models: List[ModelHandle] = []
+
+    # --- learner integration -------------------------------------------------
+
+    def get_required_callbacks(self) -> List[str]:
+        """Learner callbacks this rule depends on (reference
+        CallbackFactory contract, callback_factory.py:16-101)."""
+        return []
+
+    def set_addr(self, addr: str) -> None:
+        self.node_addr = addr
+
+    # --- round lifecycle -----------------------------------------------------
+
+    def set_nodes_to_aggregate(self, train_set: Sequence[str]) -> None:
+        """Open the round: declare whose contributions we expect
+        (reference :66-81)."""
+        with self._lock:
+            if self._train_set:
+                raise RuntimeError("aggregation already in progress — clear() first")
+            self._train_set = list(train_set)
+            self._models = []
+            self._finish_event.clear()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._train_set = []
+            self._models = []
+            self._finish_event.clear()
+
+    def get_aggregated_models(self) -> List[str]:
+        """Addresses whose contributions have been merged so far."""
+        with self._lock:
+            out: List[str] = []
+            for m in self._models:
+                out.extend(m.get_contributors())
+            return sorted(set(out))
+
+    def get_missing_models(self) -> List[str]:
+        with self._lock:
+            return sorted(set(self._train_set) - set(self.get_aggregated_models()))
+
+    # --- feeding models ------------------------------------------------------
+
+    def add_model(self, model: ModelHandle) -> List[str]:
+        """Merge a (possibly partially-aggregated) model into the round.
+
+        Returns the updated list of aggregated contributors (the caller
+        broadcasts it as round progress — reference train_stage.py:79-85).
+        Duplicate/subset contributions and contributors outside the trainset
+        are ignored, matching reference :113-175.
+        """
+        contributors = set(model.get_contributors())
+        with self._lock:
+            if not self._train_set:
+                # Round not open yet (e.g. model gossip raced ahead of the
+                # vote result) — the caller may retry; reference logs this.
+                return []
+            if not contributors <= set(self._train_set):
+                return self.get_aggregated_models()
+            already = set(self.get_aggregated_models())
+            if contributors <= already:
+                return sorted(already)  # nothing new
+            # Drop stored models that are now subsets of the incoming one.
+            self._models = [
+                m for m in self._models if not set(m.get_contributors()) <= contributors
+            ]
+            self._models.append(model)
+            agg = self.get_aggregated_models()
+            if set(agg) >= set(self._train_set):
+                self._finish_event.set()
+            return agg
+
+    # --- consuming the result ------------------------------------------------
+
+    def wait_and_get_aggregation(self, timeout: Optional[float] = None) -> ModelHandle:
+        """Block until the round completes (or timeout) then aggregate
+        whatever arrived (reference :177-207)."""
+        timeout = Settings.AGGREGATION_TIMEOUT if timeout is None else timeout
+        self._finish_event.wait(timeout)
+        with self._lock:
+            if not self._models:
+                raise RuntimeError("no models to aggregate")
+            missing = self.get_missing_models()
+            if missing:
+                # Timeout path: proceed with partial participation (matches
+                # reference behavior of aggregating what it has).
+                pass
+            return self.aggregate(list(self._models))
+
+    def get_partial_model(self, except_nodes: Sequence[str]) -> Optional[ModelHandle]:
+        """Model to gossip to a peer that already merged ``except_nodes``.
+
+        With ``partial_aggregation``: merge every stored model the peer has
+        not seen into one. Otherwise return one unseen raw model
+        (reference :219-270).
+        """
+        except_set = set(except_nodes)
+        with self._lock:
+            unseen = [
+                m for m in self._models if not (set(m.get_contributors()) & except_set)
+            ]
+            if not unseen:
+                return None
+            if not self.partial_aggregation:
+                return unseen[0]
+            if len(unseen) == 1:
+                return unseen[0]
+            merged = self.aggregate(unseen)
+            return merged
+
+    # --- rule ---------------------------------------------------------------
+
+    def aggregate(self, models: List[ModelHandle]) -> ModelHandle:
+        """Combine models into one; contributors = union, num_samples = sum."""
+        raise NotImplementedError
+
+    @staticmethod
+    def _merge_metadata(models: List[ModelHandle]) -> tuple[List[str], int]:
+        contributors: List[str] = []
+        for m in models:
+            contributors.extend(m.get_contributors())
+        total = sum(m.get_num_samples() for m in models)
+        return sorted(set(contributors)), total

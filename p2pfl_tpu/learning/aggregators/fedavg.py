@@ -1,0 +1,29 @@
+"""FedAvg (McMahan et al. 2017) — sample-weighted mean.
+
+Parity with reference fedavg.py:29-77, computed by the jitted stacked-pytree
+kernel (one fused XLA reduction instead of a per-layer numpy loop).
+Supports partial aggregation.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from p2pfl_tpu.learning.aggregators.base import Aggregator
+from p2pfl_tpu.models.model_handle import ModelHandle
+from p2pfl_tpu.ops import aggregation as agg_ops
+
+
+class FedAvg(Aggregator):
+    partial_aggregation = True
+
+    def aggregate(self, models: List[ModelHandle]) -> ModelHandle:
+        if not models:
+            raise ValueError("nothing to aggregate")
+        stacked = agg_ops.tree_stack([m.params for m in models])
+        weights = jnp.asarray([m.get_num_samples() for m in models], jnp.float32)
+        out = agg_ops.fedavg(stacked, weights)
+        contributors, total = self._merge_metadata(models)
+        return models[0].build_copy(params=out, contributors=contributors, num_samples=total)

@@ -1,0 +1,26 @@
+"""FedMedian (Yin et al. 2018) — coordinate-wise median.
+
+The reference declares this rule but its ``aggregate`` raises
+NotImplementedError (fedmedian.py:41, dead code); implemented for real here
+via the jitted kernel. Median is non-linear, so no partial aggregation.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from p2pfl_tpu.learning.aggregators.base import Aggregator
+from p2pfl_tpu.models.model_handle import ModelHandle
+from p2pfl_tpu.ops import aggregation as agg_ops
+
+
+class FedMedian(Aggregator):
+    partial_aggregation = False
+
+    def aggregate(self, models: List[ModelHandle]) -> ModelHandle:
+        if not models:
+            raise ValueError("nothing to aggregate")
+        stacked = agg_ops.tree_stack([m.params for m in models])
+        out = agg_ops.fedmedian(stacked)
+        contributors, total = self._merge_metadata(models)
+        return models[0].build_copy(params=out, contributors=contributors, num_samples=total)

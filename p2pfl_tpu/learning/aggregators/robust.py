@@ -1,0 +1,62 @@
+"""Byzantine-robust aggregation rules (BASELINE.json config #4:
+"CIFAR-10 ResNet-18, 100 nodes, Byzantine-robust (Krum / trimmed-mean) with
+10% adversarial nodes"). Not present in the reference — capability extension
+required by the north-star configs.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from p2pfl_tpu.learning.aggregators.base import Aggregator
+from p2pfl_tpu.models.model_handle import ModelHandle
+from p2pfl_tpu.ops import aggregation as agg_ops
+
+
+class TrimmedMean(Aggregator):
+    """Coordinate-wise trimmed mean: robust to ``trim_ratio`` adversaries."""
+
+    partial_aggregation = False
+
+    def __init__(self, trim_ratio: float = 0.1) -> None:
+        super().__init__()
+        if not 0.0 <= trim_ratio < 0.5:
+            raise ValueError("trim_ratio must be in [0, 0.5)")
+        self.trim_ratio = trim_ratio
+
+    def aggregate(self, models: List[ModelHandle]) -> ModelHandle:
+        if not models:
+            raise ValueError("nothing to aggregate")
+        n = len(models)
+        trim = min(int(n * self.trim_ratio), (n - 1) // 2)
+        stacked = agg_ops.tree_stack([m.params for m in models])
+        out = agg_ops.trimmed_mean(stacked, trim=trim)
+        contributors, total = self._merge_metadata(models)
+        return models[0].build_copy(params=out, contributors=contributors, num_samples=total)
+
+
+class Krum(Aggregator):
+    """(Multi-)Krum (Blanchard et al. 2017): select the model(s) closest to
+    their peers, discarding up to ``num_byzantine`` outliers."""
+
+    partial_aggregation = False
+
+    def __init__(self, num_byzantine: int = 1, num_selected: int = 1) -> None:
+        super().__init__()
+        self.num_byzantine = int(num_byzantine)
+        self.num_selected = int(num_selected)
+
+    def aggregate(self, models: List[ModelHandle]) -> ModelHandle:
+        if not models:
+            raise ValueError("nothing to aggregate")
+        n = len(models)
+        sel = min(self.num_selected, n)
+        stacked = agg_ops.tree_stack([m.params for m in models])
+        weights = jnp.asarray([m.get_num_samples() for m in models], jnp.float32)
+        out = agg_ops.krum(
+            stacked, weights, num_byzantine=min(self.num_byzantine, n - 1), num_selected=sel
+        )
+        contributors, total = self._merge_metadata(models)
+        return models[0].build_copy(params=out, contributors=contributors, num_samples=total)

@@ -1,0 +1,100 @@
+"""SCAFFOLD server-side aggregation (Karimireddy et al. 2020).
+
+Capability parity with reference scaffold.py:29-140: clients ship
+``delta_y_i`` / ``delta_c_i`` in the model's additional-info side channel
+(written by the learner's in-jit scaffold hook — see
+:class:`p2pfl_tpu.learning.learner.JaxLearner`); the aggregator maintains the
+simulated global model and the global control variate ``c`` across rounds and
+hands ``global_c`` back to learners via ``additional_info['scaffold_server']``.
+The update math itself is the jitted :func:`p2pfl_tpu.ops.aggregation.scaffold_update`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2pfl_tpu.learning.aggregators.base import Aggregator
+from p2pfl_tpu.models.model_handle import ModelHandle
+from p2pfl_tpu.ops import aggregation as agg_ops
+
+Pytree = Any
+
+
+class Scaffold(Aggregator):
+    partial_aggregation = False
+
+    def __init__(self, global_lr: float = 1.0, total_population: Optional[int] = None) -> None:
+        super().__init__()
+        self.global_lr = float(global_lr)
+        self.total_population = total_population
+        self._global_params: Optional[Pytree] = None
+        self._global_c: Optional[Pytree] = None
+
+    def get_required_callbacks(self) -> List[str]:
+        return ["scaffold"]
+
+    def _deltas(self, model: ModelHandle, template: Pytree) -> tuple[Pytree, Pytree]:
+        info = model.get_info("scaffold")
+        if info is None or "delta_y_i" not in info:
+            raise ValueError(
+                "scaffold aggregation requires models trained with the "
+                "'scaffold' learner callback"
+            )
+        treedef = jax.tree.structure(template)
+        dy = jax.tree.unflatten(treedef, [jnp.asarray(a) for a in info["delta_y_i"]])
+        dc = jax.tree.unflatten(treedef, [jnp.asarray(a) for a in info["delta_c_i"]])
+        return dy, dc
+
+    def aggregate(self, models: List[ModelHandle]) -> ModelHandle:
+        if not models:
+            raise ValueError("nothing to aggregate")
+        template = models[0].params
+        if self._global_params is None:
+            # Bootstrap the simulated global model: client params minus their
+            # deltas reconstruct the common round-start point.
+            dy0, _ = self._deltas(models[0], template)
+            self._global_params = jax.tree.map(
+                lambda p, d: p.astype(jnp.float32) - d, template, dy0
+            )
+        if self._global_c is None:
+            self._global_c = jax.tree.map(
+                lambda p: jnp.zeros_like(p, dtype=jnp.float32), template
+            )
+
+        deltas = [self._deltas(m, template) for m in models]
+        dy_stack = agg_ops.tree_stack([d[0] for d in deltas])
+        dc_stack = agg_ops.tree_stack([d[1] for d in deltas])
+        population = float(
+            self.total_population if self.total_population is not None else len(models)
+        )
+        self._global_params, self._global_c = agg_ops.scaffold_update(
+            self._global_params,
+            self._global_c,
+            dy_stack,
+            dc_stack,
+            jnp.float32(self.global_lr),
+            jnp.float32(population),
+        )
+
+        contributors, total = self._merge_metadata(models)
+        out = models[0].build_copy(
+            params=jax.tree.map(
+                lambda g, t: g.astype(t.dtype), self._global_params, template
+            ),
+            contributors=contributors,
+            num_samples=total,
+        )
+        out.add_info(
+            "scaffold_server",
+            {"global_c": [np.asarray(a) for a in jax.tree.leaves(self._global_c)]},
+        )
+        # The per-round delta payload is consumed; don't re-gossip it.
+        out.additional_info.pop("scaffold", None)
+        return out
+
+    def clear(self) -> None:  # keep global state across rounds (reference does)
+        super().clear()

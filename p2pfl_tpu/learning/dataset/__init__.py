@@ -1,0 +1,9 @@
+"""Dataset wrapper, partition strategies, and jax export."""
+
+from p2pfl_tpu.learning.dataset.dataset import FederatedDataset, synthetic_mnist  # noqa: F401
+from p2pfl_tpu.learning.dataset.partition import (  # noqa: F401
+    DirichletPartitionStrategy,
+    LabelSkewedPartitionStrategy,
+    PercentageBasedNonIIDPartitionStrategy,
+    RandomIIDPartitionStrategy,
+)

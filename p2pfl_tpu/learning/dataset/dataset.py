@@ -1,0 +1,270 @@
+"""HF-datasets wrapper + jax-native export.
+
+Capability parity with the reference ``P2PFLDataset``
+(p2pfl/learning/dataset/p2pfl_dataset.py:55-342): construction from
+csv/json/parquet/HF-hub/pandas/generator, train/test split, partition
+generation, and export. The export path fixes the reference's inefficiency of
+driving flax through a torch DataLoader with batch_size=1
+(flax/flax_learner.py:40-173, flax_dataset.py:29-67): here export produces
+dense, padded, fixed-shape numpy arrays that a jitted ``lax.scan`` epoch can
+consume directly.
+
+Also ships :func:`synthetic_mnist` — a deterministic, learnable MNIST-shaped
+dataset (random class templates + noise) so tests and benches run with zero
+network egress (the reference downloads ``p2pfl/MNIST`` from the HF hub,
+test/node_test.py:79-135).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from p2pfl_tpu.learning.dataset.partition import PartitionStrategy
+
+try:  # HF datasets is available in the image; keep a soft dependency anyway.
+    import datasets as hf_datasets
+except ImportError:  # pragma: no cover
+    hf_datasets = None
+
+
+class FederatedDataset:
+    """A train/test pair of HF datasets with partition + export helpers.
+
+    Args:
+        data: HF ``Dataset`` (split lazily) or ``DatasetDict`` with
+            ``train``/``test`` keys.
+        x_key / y_key: column names for inputs and labels.
+    """
+
+    def __init__(
+        self,
+        data: Any,
+        x_key: str = "image",
+        y_key: str = "label",
+        train_split: str = "train",
+        test_split: str = "test",
+    ) -> None:
+        self._data = data
+        self.x_key = x_key
+        self.y_key = y_key
+        self.train_split = train_split
+        self.test_split = test_split
+
+    # --- constructors (reference p2pfl_dataset.py:187-223) ------------------
+
+    @classmethod
+    def from_huggingface(cls, dataset_id: str, **kwargs) -> "FederatedDataset":
+        return cls(hf_datasets.load_dataset(dataset_id), **kwargs)
+
+    @classmethod
+    def from_csv(cls, path: str, **kwargs) -> "FederatedDataset":
+        return cls(hf_datasets.load_dataset("csv", data_files=path), **kwargs)
+
+    @classmethod
+    def from_json(cls, path: str, **kwargs) -> "FederatedDataset":
+        return cls(hf_datasets.load_dataset("json", data_files=path), **kwargs)
+
+    @classmethod
+    def from_parquet(cls, path: str, **kwargs) -> "FederatedDataset":
+        return cls(hf_datasets.load_dataset("parquet", data_files=path), **kwargs)
+
+    @classmethod
+    def from_pandas(cls, df: Any, **kwargs) -> "FederatedDataset":
+        return cls(hf_datasets.Dataset.from_pandas(df), **kwargs)
+
+    @classmethod
+    def from_generator(cls, gen: Callable, **kwargs) -> "FederatedDataset":
+        return cls(hf_datasets.Dataset.from_generator(gen), **kwargs)
+
+    @classmethod
+    def from_arrays(
+        cls,
+        x_train: np.ndarray,
+        y_train: np.ndarray,
+        x_test: Optional[np.ndarray] = None,
+        y_test: Optional[np.ndarray] = None,
+        x_key: str = "x",
+        y_key: str = "y",
+    ) -> "FederatedDataset":
+        """Build directly from numpy arrays (no HF machinery in the hot path)."""
+        d: Dict[str, Any] = {
+            "train": _ArraySplit(np.asarray(x_train), np.asarray(y_train)),
+        }
+        if x_test is not None:
+            d["test"] = _ArraySplit(np.asarray(x_test), np.asarray(y_test))
+        return cls(d, x_key=x_key, y_key=y_key)
+
+    # --- splits -------------------------------------------------------------
+
+    def _split(self, train: bool) -> Any:
+        key = self.train_split if train else self.test_split
+        if isinstance(self._data, dict):
+            return self._data[key]
+        if hf_datasets is not None and isinstance(self._data, hf_datasets.DatasetDict):
+            return self._data[key]
+        if train:
+            return self._data
+        raise KeyError("dataset has no test split — call generate_train_test_split first")
+
+    def generate_train_test_split(self, test_size: float = 0.2, seed: int = 0) -> None:
+        """Split an unsplit dataset into train/test in place."""
+        if isinstance(self._data, dict):
+            train = self._data["train"]
+            if isinstance(train, _ArraySplit):
+                a, b = train.train_test_split(test_size, seed)
+            else:  # HF Dataset: keyword args (2nd positional is train_size!)
+                dd = train.train_test_split(test_size=test_size, seed=seed)
+                a, b = dd["train"], dd["test"]
+            self._data = {"train": a, "test": b}
+        elif hf_datasets is not None and isinstance(self._data, hf_datasets.Dataset):
+            self._data = self._data.train_test_split(test_size=test_size, seed=seed)
+        else:
+            raise TypeError("dataset is already split")
+
+    def get_num_samples(self, train: bool = True) -> int:
+        return len(self._split(train))
+
+    # --- partitioning (reference p2pfl_dataset.py:203-223) ------------------
+
+    def generate_partitions(
+        self,
+        num_partitions: int,
+        strategy: Union[PartitionStrategy, type],
+        seed: int = 0,
+        **kwargs,
+    ) -> List["FederatedDataset"]:
+        """Partition the train split; every partition shares the full test
+        split (standard FL evaluation protocol, as in the reference)."""
+        train = self._split(True)
+        labels = np.asarray(train[self.y_key]) if not isinstance(train, _ArraySplit) else train.y
+        index_lists = strategy.generate(labels, num_partitions, seed=seed, **kwargs)
+        out = []
+        try:
+            test = self._split(False)
+        except KeyError:
+            test = None
+        for idx in index_lists:
+            sub_train = train.select(idx) if hasattr(train, "select") else train.take(idx)
+            d = {"train": sub_train}
+            if test is not None:
+                d["test"] = test
+            out.append(
+                FederatedDataset(
+                    d,
+                    x_key=self.x_key,
+                    y_key=self.y_key,
+                    train_split="train",
+                    test_split="test",
+                )
+            )
+        return out
+
+    # --- export -------------------------------------------------------------
+
+    def export_arrays(self, train: bool = True) -> Tuple[np.ndarray, np.ndarray]:
+        """Dense ``(x, y)`` numpy arrays for the requested split."""
+        split = self._split(train)
+        if isinstance(split, _ArraySplit):
+            return split.x, split.y
+        x = np.asarray(split[self.x_key], dtype=np.float32)
+        y = np.asarray(split[self.y_key], dtype=np.int32)
+        if x.dtype == np.uint8 or x.max() > 2.0:
+            x = x.astype(np.float32) / 255.0
+        return x, y
+
+    def export_batches(
+        self,
+        batch_size: int,
+        train: bool = True,
+        seed: int = 0,
+        drop_remainder: bool = False,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Fixed-shape batched arrays for a jitted ``lax.scan`` epoch.
+
+        Returns ``(xb, yb, wb)`` with shapes ``[steps, B, ...]``,
+        ``[steps, B]``, ``[steps, B]``; ``wb`` is a 0/1 validity mask covering
+        the padding of the final partial batch (so jitted loss math can ignore
+        padded rows while shapes stay static).
+        """
+        x, y = self.export_arrays(train)
+        n = len(y)
+        rng = np.random.default_rng(seed)
+        order = rng.permutation(n)
+        x, y = x[order], y[order]
+        if drop_remainder:
+            steps = n // batch_size
+            pad = 0
+        else:
+            steps = -(-n // batch_size)
+            pad = steps * batch_size - n
+        if pad:
+            x = np.concatenate([x, np.zeros((pad, *x.shape[1:]), x.dtype)])
+            y = np.concatenate([y, np.zeros((pad,), y.dtype)])
+        w = np.ones((steps * batch_size,), np.float32)
+        if pad:
+            w[-pad:] = 0.0
+        xb = x[: steps * batch_size].reshape(steps, batch_size, *x.shape[1:])
+        yb = y[: steps * batch_size].reshape(steps, batch_size)
+        wb = w.reshape(steps, batch_size)
+        return xb, yb, wb
+
+
+class _ArraySplit:
+    """Minimal split backed by dense numpy arrays (no HF overhead)."""
+
+    def __init__(self, x: np.ndarray, y: np.ndarray) -> None:
+        assert len(x) == len(y)
+        self.x = x
+        self.y = y
+
+    def __len__(self) -> int:
+        return len(self.y)
+
+    def take(self, idx: np.ndarray) -> "_ArraySplit":
+        return _ArraySplit(self.x[idx], self.y[idx])
+
+    def train_test_split(self, test_size: float, seed: int) -> Tuple["_ArraySplit", "_ArraySplit"]:
+        n = len(self.y)
+        rng = np.random.default_rng(seed)
+        order = rng.permutation(n)
+        cut = int(n * (1 - test_size))
+        return self.take(order[:cut]), self.take(order[cut:])
+
+
+def synthetic_mnist(
+    n_train: int = 4096,
+    n_test: int = 1024,
+    num_classes: int = 10,
+    seed: int = 42,
+    noise: float = 0.35,
+) -> FederatedDataset:
+    """Deterministic MNIST-shaped dataset a small MLP can learn.
+
+    Each class has a fixed random 28x28 template; samples are
+    ``template + gaussian noise`` clipped to [0, 1]. Linearly separable in
+    expectation, so accuracy > 0.5 after one epoch (the reference's e2e
+    assertion, test/node_test.py:126-132) is achievable without downloads.
+    """
+    rng = np.random.default_rng(seed)
+    templates = rng.uniform(0.0, 1.0, size=(num_classes, 28, 28)).astype(np.float32)
+
+    def make(n: int, rng: np.random.Generator) -> Tuple[np.ndarray, np.ndarray]:
+        y = rng.integers(0, num_classes, size=n).astype(np.int32)
+        x = templates[y] + rng.normal(0.0, noise, size=(n, 28, 28)).astype(np.float32)
+        return np.clip(x, 0.0, 1.0), y
+
+    x_train, y_train = make(n_train, np.random.default_rng(seed + 1))
+    x_test, y_test = make(n_test, np.random.default_rng(seed + 2))
+    return FederatedDataset.from_arrays(x_train, y_train, x_test, y_test)
+
+
+def mnist(fallback_synthetic: bool = True) -> FederatedDataset:
+    """Real MNIST from the HF hub if reachable, else the synthetic stand-in."""
+    try:
+        return FederatedDataset.from_huggingface("ylecun/mnist")
+    except Exception:
+        if not fallback_synthetic:
+            raise
+        return synthetic_mnist()

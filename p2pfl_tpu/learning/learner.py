@@ -1,0 +1,348 @@
+"""Learner interface + the TPU-native JaxLearner.
+
+Capability parity with the reference Learner ABC
+(p2pfl/learning/frameworks/learner.py:33-167) and its Flax backend
+(flax/flax_learner.py:40-173) — redesigned TPU-first:
+
+* the whole local-training epoch is ONE jitted computation: parameters,
+  optimizer state and the (pre-batched, fixed-shape) epoch data live on
+  device, and ``lax.scan`` walks the batches (the reference runs an unjitted
+  Python loop at batch_size=1 through a torch DataLoader, a TODO it never
+  fixed),
+* compute in bfloat16 via the model, reductions in float32,
+* SCAFFOLD is implemented inside the same jitted step (gradient correction
+  ``g + c - c_i``) instead of three per-framework callback classes
+  (reference pytorch/callbacks/scaffold_callback.py:32-155 etc.),
+* FedProx's proximal term is a loss addend under the same jit (config #5 in
+  BASELINE.json).
+
+``interrupt_fit`` (unimplemented for Flax in the reference,
+flax_learner.py:167-171) is supported between epochs.
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+import time
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from p2pfl_tpu.learning.dataset.dataset import FederatedDataset
+from p2pfl_tpu.models.model_handle import ModelHandle
+
+Pytree = Any
+
+
+class Learner(abc.ABC):
+    """Template: owns a model + data, trains and evaluates on request."""
+
+    def __init__(
+        self,
+        model: Optional[ModelHandle] = None,
+        data: Optional[FederatedDataset] = None,
+        self_addr: str = "unknown-node",
+    ) -> None:
+        self._model = model
+        self._data = data
+        self._self_addr = self_addr
+        self.epochs = 1
+        self.metric_reporter: Optional[Callable[[str, float, Optional[int]], None]] = None
+
+    # --- wiring -------------------------------------------------------------
+
+    def set_model(self, model: ModelHandle) -> None:
+        self._model = model
+
+    def get_model(self) -> ModelHandle:
+        if self._model is None:
+            raise ValueError("learner has no model")
+        return self._model
+
+    def set_data(self, data: FederatedDataset) -> None:
+        self._data = data
+
+    def get_data(self) -> FederatedDataset:
+        if self._data is None:
+            raise ValueError("learner has no data")
+        return self._data
+
+    def set_addr(self, addr: str) -> None:
+        self._self_addr = addr
+
+    def set_epochs(self, epochs: int) -> None:
+        self.epochs = epochs
+
+    def report(self, name: str, value: float, step: Optional[int] = None) -> None:
+        if self.metric_reporter is not None:
+            self.metric_reporter(name, value, step)
+
+    # --- abstract surface (reference learner.py:92-146) ---------------------
+
+    @abc.abstractmethod
+    def fit(self) -> ModelHandle: ...
+
+    @abc.abstractmethod
+    def interrupt_fit(self) -> None: ...
+
+    @abc.abstractmethod
+    def evaluate(self) -> Dict[str, float]: ...
+
+    @abc.abstractmethod
+    def get_framework(self) -> str: ...
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array, mask: jax.Array) -> jax.Array:
+    """Masked mean CE in float32 (mask zeroes padded rows)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    denom = jnp.maximum(mask.sum(), 1.0)
+    return jnp.sum(nll * mask) / denom
+
+
+class JaxLearner(Learner):
+    """Fully-jitted local trainer.
+
+    Args:
+        optimizer: optax transformation (default ``optax.adam(lr)``).
+        lr: learning rate used when ``optimizer`` is None and for SCAFFOLD's
+            control-variate update (needs the raw step size).
+        batch_size: local batch size (reference flax path hardcoded 1).
+        fedprox_mu: if > 0, add the FedProx proximal term
+            ``mu/2 * ||w - w_round_start||^2`` to the loss.
+        seed: base RNG seed; batch order varies per fit() call.
+    """
+
+    SUPPORTED_CALLBACKS = ("scaffold",)
+
+    def __init__(
+        self,
+        model: Optional[ModelHandle] = None,
+        data: Optional[FederatedDataset] = None,
+        self_addr: str = "unknown-node",
+        optimizer: Optional[optax.GradientTransformation] = None,
+        lr: float = 1e-3,
+        batch_size: int = 64,
+        fedprox_mu: float = 0.0,
+        seed: int = 0,
+        callbacks: Optional[List[str]] = None,
+    ) -> None:
+        super().__init__(model, data, self_addr)
+        self.lr = float(lr)
+        self.optimizer = optimizer if optimizer is not None else optax.adam(self.lr)
+        self.batch_size = int(batch_size)
+        self.fedprox_mu = float(fedprox_mu)
+        self.seed = int(seed)
+        self.callbacks = list(callbacks or [])
+        for cb in self.callbacks:
+            if cb not in self.SUPPORTED_CALLBACKS:
+                raise ValueError(f"unsupported callback {cb!r}")
+        self._interrupt = threading.Event()
+        self._fit_count = 0
+        self._opt_state: Optional[Pytree] = None
+        self._scaffold_c_i: Optional[Pytree] = None
+        self._scaffold = "scaffold" in self.callbacks
+
+    def get_framework(self) -> str:
+        return "jax"
+
+    def interrupt_fit(self) -> None:
+        self._interrupt.set()
+
+    # --- jitted kernels -----------------------------------------------------
+
+    @staticmethod
+    @partial(jax.jit, static_argnames=("apply_fn", "optimizer", "fedprox_mu", "use_scaffold"))
+    def _train_epoch(
+        params: Pytree,
+        opt_state: Pytree,
+        xb: jax.Array,
+        yb: jax.Array,
+        wb: jax.Array,
+        anchor: Pytree,
+        c_global: Pytree,
+        c_local: Pytree,
+        *,
+        apply_fn: Callable,
+        optimizer: optax.GradientTransformation,
+        fedprox_mu: float,
+        use_scaffold: bool,
+    ) -> Tuple[Pytree, Pytree, jax.Array]:
+        """One epoch = lax.scan over fixed-shape batches. Returns
+        (params, opt_state, mean_loss)."""
+
+        def loss_fn(p: Pytree, x: jax.Array, y: jax.Array, w: jax.Array) -> jax.Array:
+            loss = softmax_cross_entropy(apply_fn(p, x), y, w)
+            if fedprox_mu > 0.0:
+                sq = jax.tree.map(
+                    lambda a, b: jnp.sum((a.astype(jnp.float32) - b.astype(jnp.float32)) ** 2),
+                    p,
+                    anchor,
+                )
+                loss = loss + 0.5 * fedprox_mu * sum(jax.tree.leaves(sq))
+            return loss
+
+        def step(carry, batch):
+            p, s = carry
+            x, y, w = batch
+            loss, grads = jax.value_and_grad(loss_fn)(p, x, y, w)
+            if use_scaffold:  # SCAFFOLD drift correction: g + c - c_i
+                grads = jax.tree.map(
+                    lambda g, c, ci: g + c.astype(g.dtype) - ci.astype(g.dtype),
+                    grads,
+                    c_global,
+                    c_local,
+                )
+            updates, s = optimizer.update(grads, s, p)
+            p = optax.apply_updates(p, updates)
+            return (p, s), loss
+
+        (params, opt_state), losses = jax.lax.scan(step, (params, opt_state), (xb, yb, wb))
+        return params, opt_state, jnp.mean(losses)
+
+    @staticmethod
+    @partial(jax.jit, static_argnames=("apply_fn",))
+    def _eval_batches(
+        params: Pytree, xb: jax.Array, yb: jax.Array, wb: jax.Array, *, apply_fn: Callable
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Masked (loss, accuracy) over pre-batched eval data, one jit."""
+
+        def step(carry, batch):
+            x, y, w = batch
+            logits = apply_fn(params, x)
+            loss = softmax_cross_entropy(logits, y, w) * jnp.maximum(w.sum(), 1.0)
+            correct = jnp.sum((jnp.argmax(logits, axis=-1) == y) * w)
+            return carry, (loss, correct, w.sum())
+
+        _, (losses, corrects, counts) = jax.lax.scan(step, None, (xb, yb, wb))
+        total = jnp.maximum(jnp.sum(counts), 1.0)
+        return jnp.sum(losses) / total, jnp.sum(corrects) / total
+
+    # --- public API ---------------------------------------------------------
+
+    def fit(self) -> ModelHandle:
+        """Run ``self.epochs`` of local SGD; returns the updated model.
+
+        Mirrors the reference contract (learner.py:92-105): the model handle
+        is updated in place with new params, the node's own address as
+        contributor, and the local sample count.
+        """
+        model = self.get_model()
+        self._interrupt.clear()
+        t0 = time.monotonic()
+        epoch_seed = self.seed + 1000 * self._fit_count
+        self._fit_count += 1
+
+        params = model.params
+        if self._opt_state is None:
+            self._opt_state = self.optimizer.init(params)
+        opt_state = self._opt_state
+
+        zeros = jax.tree.map(lambda x: jnp.zeros_like(x, dtype=jnp.float32), params)
+        anchor = params
+        c_global, c_local = zeros, zeros
+        if self._scaffold:
+            if self._scaffold_c_i is None:
+                self._scaffold_c_i = zeros
+            c_local = self._scaffold_c_i
+            g = model.get_info("scaffold_server", {})
+            if "global_c" in g:
+                c_global = jax.tree.unflatten(
+                    jax.tree.structure(c_global), [jnp.asarray(a) for a in g["global_c"]]
+                )
+
+        total_steps = 0
+        last_loss = float("nan")
+        for epoch in range(self.epochs):
+            if self._interrupt.is_set():
+                break
+            xb, yb, wb = self.get_data().export_batches(
+                self.batch_size, train=True, seed=epoch_seed + epoch
+            )
+            params, opt_state, loss = self._train_epoch(
+                params,
+                opt_state,
+                jnp.asarray(xb),
+                jnp.asarray(yb),
+                jnp.asarray(wb),
+                anchor,
+                c_global,
+                c_local,
+                apply_fn=model.apply_fn,
+                optimizer=self.optimizer,
+                fedprox_mu=self.fedprox_mu,
+                use_scaffold=self._scaffold,
+            )
+            total_steps += xb.shape[0]
+            last_loss = float(loss)
+            self.report("train_loss", last_loss, step=epoch)
+
+        self._opt_state = opt_state
+        model.params = params
+        model.set_contribution([self._self_addr], self.get_data().get_num_samples(True))
+
+        if self._scaffold and total_steps > 0:
+            # c_i' = c_i - c + (x - y)/(K*lr); deltas ride in additional_info
+            # (contract of reference scaffold callbacks + aggregator,
+            # scaffold.py:59-140).
+            scale = 1.0 / (total_steps * self.lr)
+            delta_y = jax.tree.map(
+                lambda y_, x_: y_.astype(jnp.float32) - x_.astype(jnp.float32), params, anchor
+            )
+            c_i_new = jax.tree.map(
+                lambda ci, c, dy: ci - c - dy * scale, c_local, c_global, delta_y
+            )
+            delta_c = jax.tree.map(lambda n, o: n - o, c_i_new, c_local)
+            self._scaffold_c_i = c_i_new
+            model.add_info(
+                "scaffold",
+                {
+                    "delta_y_i": [np.asarray(a) for a in jax.tree.leaves(delta_y)],
+                    "delta_c_i": [np.asarray(a) for a in jax.tree.leaves(delta_c)],
+                },
+            )
+
+        self.report("fit_time_s", time.monotonic() - t0)
+        return model
+
+    def evaluate(self) -> Dict[str, float]:
+        model = self.get_model()
+        try:
+            xb, yb, wb = self.get_data().export_batches(
+                self.batch_size, train=False, seed=0
+            )
+        except KeyError:
+            return {}
+        loss, acc = self._eval_batches(
+            model.params,
+            jnp.asarray(xb),
+            jnp.asarray(yb),
+            jnp.asarray(wb),
+            apply_fn=model.apply_fn,
+        )
+        metrics = {"test_loss": float(loss), "test_acc": float(acc)}
+        for k, v in metrics.items():
+            self.report(k, v)
+        return metrics
+
+
+class LearnerFactory:
+    """framework tag -> learner class (reference learner_factory.py:24-56)."""
+
+    _registry: Dict[str, type] = {"jax": JaxLearner}
+
+    @classmethod
+    def register(cls, framework: str, learner_cls: type) -> None:
+        cls._registry[framework] = learner_cls
+
+    @classmethod
+    def create_learner(cls, model: ModelHandle) -> type:
+        fw = model.get_framework()
+        if fw not in cls._registry:
+            raise ValueError(f"no learner registered for framework {fw!r}")
+        return cls._registry[fw]

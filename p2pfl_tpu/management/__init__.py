@@ -1,0 +1,1 @@
+"""Management: logging, metrics, monitoring, telemetry."""

@@ -1,0 +1,67 @@
+"""REST telemetry client.
+
+Parity with reference management/p2pfl_web_services.py:58-268 (POST /node,
+/node-log, /node-metric/local, /node-metric/global, /node-metric/system).
+Uses stdlib urllib (no extra deps); failures are swallowed after marking the
+sink broken, so telemetry can never take a node down.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import urllib.request
+from typing import Any, Dict
+
+
+class WebServices:
+    def __init__(self, url: str, key: str, timeout: float = 5.0) -> None:
+        self._url = url.rstrip("/")
+        self._key = key
+        self._timeout = timeout
+        self._broken = False
+
+    def _post(self, path: str, body: Dict[str, Any]) -> None:
+        if self._broken:
+            return
+        try:
+            req = urllib.request.Request(
+                self._url + path,
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json", "x-api-key": self._key},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=self._timeout):
+                pass
+        except Exception as exc:
+            self._broken = True
+            logging.getLogger("p2pfl_tpu").warning("web telemetry disabled: %s", exc)
+
+    def register_node(self, node: str) -> None:
+        self._post("/node", {"address": node})
+
+    def unregister_node(self, node: str) -> None:
+        self._post("/node-remove", {"address": node})
+
+    def send_log(self, node: str, level: str, message: str) -> None:
+        self._post("/node-log", {"address": node, "level": level, "message": message})
+
+    def send_local_metric(
+        self, node: str, exp: str, metric: str, value: float, round: int, step: int
+    ) -> None:
+        self._post(
+            "/node-metric/local",
+            {"address": node, "experiment": exp, "metric": metric, "value": value,
+             "round": round, "step": step},
+        )
+
+    def send_global_metric(
+        self, node: str, exp: str, metric: str, value: float, round: int
+    ) -> None:
+        self._post(
+            "/node-metric/global",
+            {"address": node, "experiment": exp, "metric": metric, "value": value, "round": round},
+        )
+
+    def send_system_metric(self, node: str, metric: str, value: float) -> None:
+        self._post("/node-metric/system", {"address": node, "metric": metric, "value": value})

@@ -1,0 +1,8 @@
+"""Model containers and the built-in flax model zoo."""
+
+from p2pfl_tpu.models.model_handle import ModelHandle  # noqa: F401
+from p2pfl_tpu.models.mlp import MLP, mlp_model  # noqa: F401
+from p2pfl_tpu.models.cnn import CNN, cnn_model  # noqa: F401
+from p2pfl_tpu.models.resnet import ResNet18, resnet18_model  # noqa: F401
+
+__all__ = ["ModelHandle", "MLP", "mlp_model", "CNN", "cnn_model", "ResNet18", "resnet18_model"]

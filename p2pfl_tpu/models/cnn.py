@@ -1,0 +1,50 @@
+"""Small convnet for MNIST / FEMNIST (BASELINE.json configs #2 and #5).
+
+Convolutions are MXU work under XLA; keep channels multiples of 8 and compute
+in bfloat16.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.models.model_handle import ModelHandle
+
+
+class CNN(nn.Module):
+    """conv32-pool-conv64-pool-dense128-logits."""
+
+    out_channels: int = 10
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        if x.ndim == 3:  # [B, H, W] -> [B, H, W, 1]
+            x = x[..., None]
+        x = x.astype(self.compute_dtype)
+        x = nn.Conv(32, (3, 3), dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3), dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.out_channels, dtype=self.compute_dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def cnn_model(
+    seed: int = 0,
+    input_shape: Tuple[int, ...] = (28, 28, 1),
+    out_channels: int = 10,
+) -> ModelHandle:
+    module = CNN(out_channels=out_channels, compute_dtype=jnp.dtype(Settings.COMPUTE_DTYPE))
+    params = module.init(jax.random.key(seed), jnp.zeros((1, *input_shape), jnp.float32))
+    return ModelHandle(params=params, apply_fn=module.apply, model_def=module)

@@ -1,0 +1,52 @@
+"""MNIST-scale MLP (the reference's default model family).
+
+Capability parity with the reference's per-framework MLPs
+(p2pfl/learning/frameworks/flax/flax_model.py:171-195,
+pytorch/lightning_model.py:118+): two hidden layers for 28x28 inputs.
+TPU notes: compute in bfloat16 (MXU-native) with float32 params/outputs;
+all batch math is a single fused matmul chain.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.models.model_handle import ModelHandle
+
+
+class MLP(nn.Module):
+    """Flatten → Dense stack → logits."""
+
+    hidden_sizes: Sequence[int] = (256, 128)
+    out_channels: int = 10
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.reshape((x.shape[0], -1)).astype(self.compute_dtype)
+        for h in self.hidden_sizes:
+            x = nn.Dense(h, dtype=self.compute_dtype)(x)
+            x = nn.relu(x)
+        x = nn.Dense(self.out_channels, dtype=self.compute_dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def mlp_model(
+    seed: int = 0,
+    input_shape: Tuple[int, ...] = (28, 28),
+    hidden_sizes: Sequence[int] = (256, 128),
+    out_channels: int = 10,
+) -> ModelHandle:
+    """Initialize an MLP and wrap it in a :class:`ModelHandle`."""
+    module = MLP(
+        hidden_sizes=tuple(hidden_sizes),
+        out_channels=out_channels,
+        compute_dtype=jnp.dtype(Settings.COMPUTE_DTYPE),
+    )
+    params = module.init(jax.random.key(seed), jnp.zeros((1, *input_shape), jnp.float32))
+    return ModelHandle(params=params, apply_fn=module.apply, model_def=module)

@@ -1,0 +1,171 @@
+"""ModelHandle — the framework's weight container.
+
+Replaces the reference's pickle-based ``P2PFLModel`` ABC
+(p2pfl/learning/frameworks/p2pfl_model.py:30-195) with a JAX-native handle:
+
+* parameters live as a pytree of (device) arrays — they stay in HBM between
+  rounds; ``get_parameters`` only materializes numpy views on demand,
+* wire format is the safe flat-buffer codec (:mod:`p2pfl_tpu.ops.serialization`),
+  never pickle,
+* contributor + sample-count metadata ride along exactly like the reference
+  (p2pfl_model.py:138-173) so aggregator bookkeeping is unchanged,
+* ``additional_info`` carries aggregator side-channels (e.g. SCAFFOLD deltas,
+  reference scaffold.py:59-140).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+
+from p2pfl_tpu.exceptions import DecodingParamsError, ModelNotMatchingError
+from p2pfl_tpu.ops.serialization import deserialize_arrays, serialize_arrays
+
+Pytree = Any
+
+
+class ModelHandle:
+    """A model = apply function + parameter pytree + federation metadata.
+
+    Args:
+        params: parameter pytree (flax ``{'params': ...}`` style or any pytree).
+        apply_fn: ``apply_fn(params, batch_x) -> logits``; optional for
+            pure-container uses (e.g. aggregation tests).
+        model_def: the flax ``nn.Module`` (kept for re-init / introspection).
+        contributors: node addresses whose training contributed to ``params``.
+        num_samples: number of samples backing this model's training.
+        additional_info: aggregator side-channel data (msgpack-safe values).
+    """
+
+    framework = "jax"
+
+    def __init__(
+        self,
+        params: Pytree,
+        apply_fn: Optional[Callable] = None,
+        model_def: Any = None,
+        contributors: Optional[List[str]] = None,
+        num_samples: int = 1,
+        additional_info: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        self.params = params
+        self.apply_fn = apply_fn
+        self.model_def = model_def
+        self._treedef = jax.tree.structure(params)
+        self._shapes = [x.shape for x in jax.tree.leaves(params)]
+        self._dtypes = [np.dtype(x.dtype) for x in jax.tree.leaves(params)]
+        self.contributors: List[str] = list(contributors or [])
+        self.num_samples = int(num_samples)
+        self.additional_info: Dict[str, Any] = dict(additional_info or {})
+
+    # --- parameters ---------------------------------------------------------
+
+    def get_parameters(self) -> List[np.ndarray]:
+        """Flat list of numpy arrays in canonical pytree-leaf order
+        (reference: p2pfl_model.py get/set contract)."""
+        return [np.asarray(x) for x in jax.tree.leaves(self.params)]
+
+    def get_tree(self) -> Pytree:
+        return self.params
+
+    def set_parameters(self, params: Union[Sequence[np.ndarray], bytes, Pytree]) -> None:
+        """Adopt new parameters from a flat list, wire bytes, or pytree.
+
+        Raises:
+            ModelNotMatchingError: leaf count or shapes don't match.
+            DecodingParamsError: wire bytes are malformed.
+        """
+        if isinstance(params, (bytes, bytearray, memoryview)):
+            arrays, meta = deserialize_arrays(bytes(params))
+            self.contributors = list(meta.get("contributors", self.contributors))
+            self.num_samples = int(meta.get("num_samples", self.num_samples))
+            self.additional_info.update(meta.get("additional_info", {}))
+            flat = list(arrays)
+        elif isinstance(params, (list, tuple)):
+            flat = list(params)
+        else:  # pytree
+            flat = jax.tree.leaves(params)
+        if len(flat) != len(self._shapes):
+            raise ModelNotMatchingError(
+                f"expected {len(self._shapes)} tensors, got {len(flat)}"
+            )
+        for arr, shape in zip(flat, self._shapes):
+            if tuple(arr.shape) != tuple(shape):
+                raise ModelNotMatchingError(f"shape mismatch: {arr.shape} != {shape}")
+        cast = [
+            np.asarray(a).astype(dt, copy=False) if not isinstance(a, jax.Array) else a
+            for a, dt in zip(flat, self._dtypes)
+        ]
+        self.params = jax.tree.unflatten(self._treedef, cast)
+
+    def encode_parameters(self) -> bytes:
+        """Serialize params + metadata for the wire (reference encodes with
+        pickle at p2pfl_model.py:71-86; here: safe flat buffers)."""
+        return serialize_arrays(
+            self.get_parameters(),
+            {
+                "contributors": self.contributors,
+                "num_samples": self.num_samples,
+                "additional_info": self.additional_info,
+            },
+        )
+
+    @staticmethod
+    def decode_metadata(blob: bytes) -> Dict[str, Any]:
+        """Peek at a wire buffer's metadata without adopting weights."""
+        _, meta = deserialize_arrays(blob)
+        return meta
+
+    # --- federation metadata (reference p2pfl_model.py:138-173) -------------
+
+    def set_contribution(self, contributors: List[str], num_samples: int) -> None:
+        self.contributors = list(contributors)
+        self.num_samples = int(num_samples)
+
+    def get_contributors(self) -> List[str]:
+        if not self.contributors:
+            raise ValueError("contributors not set on this model")
+        return self.contributors
+
+    def get_num_samples(self) -> int:
+        return self.num_samples
+
+    def add_info(self, key: str, value: Any) -> None:
+        self.additional_info[key] = value
+
+    def get_info(self, key: str, default: Any = None) -> Any:
+        return self.additional_info.get(key, default)
+
+    # --- copies -------------------------------------------------------------
+
+    def build_copy(
+        self,
+        params: Union[Sequence[np.ndarray], bytes, Pytree, None] = None,
+        contributors: Optional[List[str]] = None,
+        num_samples: Optional[int] = None,
+    ) -> "ModelHandle":
+        """New handle sharing apply_fn/model_def, optionally with new params
+        (reference: p2pfl_model.py:174-186 uses deepcopy; we rebuild)."""
+        copy = ModelHandle(
+            params=self.params,
+            apply_fn=self.apply_fn,
+            model_def=self.model_def,
+            contributors=contributors if contributors is not None else list(self.contributors),
+            num_samples=num_samples if num_samples is not None else self.num_samples,
+            additional_info=dict(self.additional_info),
+        )
+        if params is not None:
+            copy.set_parameters(params)
+        return copy
+
+    def get_framework(self) -> str:
+        return self.framework
+
+    def __repr__(self) -> str:
+        n_params = sum(int(np.prod(s)) for s in self._shapes)
+        return (
+            f"ModelHandle(leaves={len(self._shapes)}, params={n_params}, "
+            f"contributors={len(self.contributors)}, num_samples={self.num_samples})"
+        )

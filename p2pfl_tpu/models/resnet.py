@@ -1,0 +1,74 @@
+"""ResNet-18 for CIFAR-10 (BASELINE.json configs #3 and #4).
+
+CIFAR-style ResNet-18 (3x3 stem, no max-pool) in flax linen. GroupNorm
+instead of BatchNorm: federated aggregation of BatchNorm running statistics
+is ill-defined (clients see non-IID data), GroupNorm is stateless and the
+standard choice in FL literature — and it keeps the train step purely
+functional (no mutable batch_stats collection to gossip).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.models.model_handle import ModelHandle
+
+
+class BasicBlock(nn.Module):
+    channels: int
+    strides: Tuple[int, int] = (1, 1)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        residual = x
+        y = nn.Conv(self.channels, (3, 3), self.strides, use_bias=False, dtype=self.compute_dtype)(x)
+        y = nn.GroupNorm(num_groups=min(32, self.channels), dtype=self.compute_dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.channels, (3, 3), use_bias=False, dtype=self.compute_dtype)(y)
+        y = nn.GroupNorm(num_groups=min(32, self.channels), dtype=self.compute_dtype)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.channels, (1, 1), self.strides, use_bias=False, dtype=self.compute_dtype
+            )(residual)
+            residual = nn.GroupNorm(num_groups=min(32, self.channels), dtype=self.compute_dtype)(
+                residual
+            )
+        return nn.relu(y + residual)
+
+
+class ResNet18(nn.Module):
+    out_channels: int = 10
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = x.astype(self.compute_dtype)
+        x = nn.Conv(64, (3, 3), use_bias=False, dtype=self.compute_dtype)(x)
+        x = nn.GroupNorm(num_groups=32, dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        channels = 64
+        for i, blocks in enumerate(self.stage_sizes):
+            for b in range(blocks):
+                strides = (2, 2) if i > 0 and b == 0 else (1, 1)
+                x = BasicBlock(channels, strides, self.compute_dtype)(x)
+            channels *= 2
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.out_channels, dtype=self.compute_dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def resnet18_model(
+    seed: int = 0,
+    input_shape: Tuple[int, ...] = (32, 32, 3),
+    out_channels: int = 10,
+) -> ModelHandle:
+    module = ResNet18(out_channels=out_channels, compute_dtype=jnp.dtype(Settings.COMPUTE_DTYPE))
+    params = module.init(jax.random.key(seed), jnp.zeros((1, *input_shape), jnp.float32))
+    return ModelHandle(params=params, apply_fn=module.apply, model_def=module)

@@ -1,0 +1,235 @@
+"""Node — the user-facing facade.
+
+Capability parity with reference p2pfl/node.py:57-413: wires protocol,
+learner, aggregator, state and commands; exposes
+``start/connect/set_start_learning/set_stop_learning/stop``. Kickoff
+semantics mirror node.py:342-382: broadcast ``start_learning``, mark the own
+model initialized, broadcast ``model_initialized``, then run the stage
+machine on a daemon thread.
+
+TPU notes: the node's learner defaults to the jitted
+:class:`~p2pfl_tpu.learning.learner.JaxLearner`; for mesh-scale simulation of
+hundreds of nodes prefer :mod:`p2pfl_tpu.parallel.simulation`, which runs the
+whole population as one sharded XLA program instead of per-node threads.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Type
+
+from p2pfl_tpu.comm.commands.impl import (
+    FullModelCommand,
+    InitModelCommand,
+    MetricsCommand,
+    ModelInitializedCommand,
+    ModelsAggregatedCommand,
+    ModelsReadyCommand,
+    PartialModelCommand,
+    StartLearningCommand,
+    StopLearningCommand,
+    VoteTrainSetCommand,
+)
+from p2pfl_tpu.comm.memory.memory_protocol import InMemoryCommunicationProtocol
+from p2pfl_tpu.comm.protocol import CommunicationProtocol
+from p2pfl_tpu.exceptions import LearningRunningException, ZeroRoundsException
+from p2pfl_tpu.learning.aggregators import Aggregator, FedAvg
+from p2pfl_tpu.learning.dataset.dataset import FederatedDataset
+from p2pfl_tpu.learning.learner import JaxLearner, Learner
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.models.model_handle import ModelHandle
+from p2pfl_tpu.node_state import NodeState
+from p2pfl_tpu.stages.workflow import LearningWorkflow
+
+
+class Node:
+    """One federated participant.
+
+    Args:
+        model: initial :class:`ModelHandle`.
+        data: this node's local dataset partition.
+        addr: transport address (default: fresh in-memory address).
+        learner: learner class (default :class:`JaxLearner`).
+        aggregator: aggregation rule instance (default :class:`FedAvg`).
+        protocol: communication protocol class (default in-memory).
+        learner_kwargs: forwarded to the learner constructor.
+    """
+
+    def __init__(
+        self,
+        model: ModelHandle,
+        data: FederatedDataset,
+        addr: Optional[str] = None,
+        learner: Type[Learner] = JaxLearner,
+        aggregator: Optional[Aggregator] = None,
+        protocol: Type[CommunicationProtocol] = InMemoryCommunicationProtocol,
+        **learner_kwargs,
+    ) -> None:
+        self.protocol = protocol(addr)
+        self.state = NodeState(self.protocol.get_address())
+        self.aggregator = aggregator if aggregator is not None else FedAvg()
+        self.aggregator.set_addr(self.addr)
+        required = self.aggregator.get_required_callbacks()
+        if required:
+            learner_kwargs.setdefault("callbacks", required)
+        self.learner: Learner = learner(
+            model=model, data=data, self_addr=self.addr, **learner_kwargs
+        )
+        self.state.learner = self.learner
+        self.learner.metric_reporter = self._report_learner_metric
+
+        self._workflow: Optional[LearningWorkflow] = None
+        self._learning_thread: Optional[threading.Thread] = None
+        self._running = False
+
+        # Register the command handlers (reference node.py:121-134).
+        self.protocol.add_command(
+            [
+                StartLearningCommand(self),
+                StopLearningCommand(self),
+                ModelInitializedCommand(self),
+                VoteTrainSetCommand(self),
+                ModelsAggregatedCommand(self),
+                ModelsReadyCommand(self),
+                MetricsCommand(self),
+                InitModelCommand(self),
+                PartialModelCommand(self),
+                FullModelCommand(self),
+            ]
+        )
+
+    # --- identity -----------------------------------------------------------
+
+    @property
+    def addr(self) -> str:
+        return self.protocol.get_address()
+
+    def __repr__(self) -> str:
+        return f"Node({self.addr}, running={self._running})"
+
+    # --- lifecycle (reference node.py:210-253) ------------------------------
+
+    def start(self, wait: bool = False) -> None:
+        if self._running:
+            from p2pfl_tpu.exceptions import NodeRunningException
+
+            raise NodeRunningException(f"{self.addr} already running")
+        logger.register_node(self.addr, simulation=self.state.simulation)
+        self.protocol.start()
+        self._running = True
+        if wait:  # block until stopped (reference honors wait=True)
+            while self._running:
+                threading.Event().wait(1.0)
+
+    def stop(self) -> None:
+        if not self._running:
+            return
+        try:
+            if self.learning_in_progress():
+                self.stop_learning_locally()
+            # Join the workflow thread before tearing down the protocol so a
+            # stage can't broadcast into a stopped transport.
+            if self._learning_thread is not None:
+                self._learning_thread.join(timeout=5.0)
+            self.protocol.stop()
+        finally:
+            self._running = False
+            logger.unregister_node(self.addr)
+
+    # --- membership ---------------------------------------------------------
+
+    def connect(self, addr: str) -> bool:
+        return self.protocol.connect(addr)
+
+    def disconnect(self, addr: str) -> None:
+        self.protocol.disconnect(addr)
+
+    def get_neighbors(self, only_direct: bool = False) -> List[str]:
+        return self.protocol.get_neighbors(only_direct=only_direct)
+
+    # --- learning control (reference node.py:333-397) -----------------------
+
+    def set_start_learning(self, rounds: int = 1, epochs: int = 1) -> None:
+        if rounds < 1:
+            raise ZeroRoundsException("rounds must be >= 1")
+        if self.learning_in_progress():
+            raise LearningRunningException("learning already in progress")
+        # Kick off peers first, then ourselves (reference node.py:359-370).
+        self.protocol.broadcast(
+            self.protocol.build_msg(
+                StartLearningCommand.get_name(), args=[str(rounds), str(epochs)]
+            )
+        )
+        self.start_learning_thread(rounds, epochs)
+
+    def set_stop_learning(self) -> None:
+        self.protocol.broadcast(self.protocol.build_msg(StopLearningCommand.get_name()))
+        self.stop_learning_locally()
+
+    def start_learning_thread(self, rounds: int, epochs: int) -> None:
+        """Spawn the stage machine on a daemon thread (idempotent per
+        session; also the handler body of the start_learning command)."""
+        with self.state.start_thread_lock:
+            if self.learning_in_progress():
+                return
+            self.state.set_experiment(f"experiment-{self.addr}", rounds)
+            logger.experiment_started(self.addr, self.state.experiment)
+            self.learner.set_epochs(epochs)
+            self._workflow = LearningWorkflow()
+            self._learning_thread = threading.Thread(
+                target=self._workflow.run,
+                kwargs={"node": self},
+                name=f"learning-{self.addr}",
+                daemon=True,
+            )
+            self._learning_thread.start()
+
+    def stop_learning_locally(self) -> None:
+        """Abort the in-progress session (reference stop semantics: clear
+        experiment state; stages observe it via check_early_stop)."""
+        self.learner.interrupt_fit()
+        self.aggregator.clear()
+        self.state.experiment = None
+        self.state.train_set = []
+        self.state.votes_ready_event.set()
+        self.state.aggregated_model_event.set()
+        logger.experiment_finished(self.addr)
+
+    def learning_in_progress(self) -> bool:
+        return (
+            self._learning_thread is not None
+            and self._learning_thread.is_alive()
+            and self.state.experiment is not None
+        )
+
+    def wait_learning_finished(self, timeout: Optional[float] = None) -> None:
+        if self._learning_thread is not None:
+            self._learning_thread.join(timeout)
+
+    @property
+    def learning_workflow(self) -> Optional[LearningWorkflow]:
+        return self._workflow
+
+    # --- hooks used by stages/commands --------------------------------------
+
+    def finish_learning(self) -> None:
+        """Normal end of the last round (reference round_finished_stage
+        wrap-up): reset state for the next experiment."""
+        self.state.experiment = None
+        self.state.status = "Idle"
+        self.state.train_set = []
+        self.state.models_aggregated = {}
+        logger.experiment_finished(self.addr)
+
+    def log_metric(self, name: str, value: float, step: Optional[int] = None) -> None:
+        logger.log_metric(self.addr, name, value, step=step, round=self.state.round)
+
+    def _report_learner_metric(self, name: str, value: float, step: Optional[int] = None) -> None:
+        logger.log_metric(self.addr, name, value, step=step, round=self.state.round)
+
+    def log_remote_metric(self, source: str, round: int, name: str, value: float) -> None:
+        logger.log_metric(source, name, value, round=round)
+
+    def log_round_finished(self) -> None:
+        r = self.state.round
+        logger.round_finished_info(self.addr, (r - 1) if r is not None else -1)

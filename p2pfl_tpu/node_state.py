@@ -1,0 +1,97 @@
+"""Per-node shared state and synchronization primitives.
+
+Capability parity with reference p2pfl/node_state.py:26-136: the state object
+is shared between the stage machine, the command handlers (which run on
+transport threads) and the public Node API, so every cross-thread handoff is
+an explicit ``threading.Event`` here.
+
+Design departure from the reference: the reference coordinates with raw
+``threading.Lock`` objects acquired at init and "released" to signal
+(node_state.py:74-80), a pattern that throws if a lock is released twice.
+Events are idempotent and state their intent; the aggregation handoff is an
+Event in the reference too (``aggregated_model_event``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from p2pfl_tpu.experiment import Experiment
+
+
+class NodeState:
+    """Mutable state of one federated node during an experiment.
+
+    Attributes:
+        addr: This node's address string.
+        status: Human-readable lifecycle tag ("Idle" / "Learning").
+        experiment: Active :class:`Experiment` or ``None``.
+        simulation: Whether the learner is being simulated on the mesh backend.
+        models_aggregated: addr -> list of contributors that peer has merged
+            (tracks partial-aggregation progress; reference node_state.py:60).
+        nei_status: addr -> last round that neighbor reported finishing
+            (-1 right after the peer announced an initialized model).
+        train_set: Committee (trainset) elected for the current round.
+        train_set_votes: addr -> {candidate: weight} votes received.
+        learner: The node's learner (set by Node).
+    """
+
+    def __init__(self, addr: str) -> None:
+        self.addr = addr
+        self.status = "Idle"
+        self.experiment: Optional[Experiment] = None
+        self.simulation = False
+
+        # Learning info (populated by commands / stages).
+        self.models_aggregated: Dict[str, List[str]] = {}
+        self.nei_status: Dict[str, int] = {}
+        self.train_set: List[str] = []
+        self.train_set_votes: Dict[str, Dict[str, int]] = {}
+        self.learner: Any = None
+
+        # Synchronization.
+        self.train_set_votes_lock = threading.Lock()
+        self.start_thread_lock = threading.Lock()
+        # Set when all expected votes have (possibly) arrived — consumers
+        # re-check the vote table and clear it again while polling.
+        self.votes_ready_event = threading.Event()
+        # Set once the model has been initialized (own weights or received
+        # via an init-model gossip). Reference models this as a lock acquired
+        # at __init__ (node_state.py:77-79).
+        self.model_initialized_event = threading.Event()
+        # Set when an aggregated (full) model for this round has been adopted.
+        self.aggregated_model_event = threading.Event()
+        # Highest round for which a full aggregated model was adopted — lets
+        # WaitAggregatedModelsStage skip its wait if the model raced ahead of
+        # the stage transition (clear-then-wait race).
+        self.last_full_model_round = -1
+
+    # --- round bookkeeping (proxied off Experiment; reference :84-97) -------
+
+    @property
+    def round(self) -> Optional[int]:
+        return self.experiment.round if self.experiment is not None else None
+
+    @property
+    def total_rounds(self) -> Optional[int]:
+        return self.experiment.total_rounds if self.experiment is not None else None
+
+    def set_experiment(self, exp_name: str, total_rounds: int) -> None:
+        """Start (or restart) an experiment and flip status to Learning."""
+        self.status = "Learning"
+        self.experiment = Experiment(exp_name=exp_name, total_rounds=total_rounds)
+
+    def increase_round(self) -> None:
+        if self.experiment is None:
+            raise ValueError("no experiment in progress")
+        self.experiment.increase_round()
+        self.models_aggregated = {}
+
+    def clear(self) -> None:
+        """Reset to the post-construction state (reference :125-127)."""
+        self.__init__(self.addr)  # type: ignore[misc]
+
+    def __str__(self) -> str:
+        exp = str(self.experiment) if self.experiment else "None"
+        return f"NodeState(addr={self.addr}, status={self.status}, {exp})"

@@ -1,0 +1,1 @@
+"""Compute and wire-format primitives: serialization, aggregation kernels."""

@@ -1,0 +1,178 @@
+"""Aggregation math as pure, jittable JAX kernels over stacked parameters.
+
+The reference computes aggregation with per-layer numpy loops on host
+(p2pfl/learning/aggregators/fedavg.py:41-77, fedmedian.py:24-65,
+scaffold.py:29-140). Here every aggregation rule is a pure function over a
+*stacked* parameter pytree — each leaf has a leading ``num_models`` axis — so:
+
+* one ``jit`` covers every layer (XLA fuses the whole reduction),
+* the same kernel runs on host-gathered models (federation mode) and on a
+  mesh-sharded population (simulation mode): when the stacked axis is sharded
+  over a mesh axis, XLA lowers the reductions below to ``reduce_scatter`` /
+  ``all_reduce`` collectives over ICI — no hand-written NCCL-style calls,
+* Byzantine-robust rules (median / trimmed-mean / Krum — BASELINE.json config
+  #4) come almost for free as different reductions over the same stack.
+
+All kernels take ``weights`` (per-model sample counts) where the rule uses
+them and are wrapped in ``jax.jit`` at import; inputs may be numpy or jax
+arrays.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+def tree_stack(trees: list[Pytree]) -> Pytree:
+    """Stack a list of identically-structured pytrees along a new axis 0."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *trees)
+
+
+def tree_unstack(tree: Pytree, n: int) -> list[Pytree]:
+    """Inverse of :func:`tree_stack`."""
+    return [jax.tree.map(lambda x, i=i: x[i], tree) for i in range(n)]
+
+
+@jax.jit
+def fedavg(stacked: Pytree, weights: jax.Array) -> Pytree:
+    """Sample-weighted mean over the model axis.
+
+    Semantics of reference fedavg.py:41-77: each model contributes
+    proportionally to its ``num_samples``; supports partial aggregation (the
+    caller passes whatever subset it currently holds).
+
+    Args:
+        stacked: pytree with leading axis ``num_models`` on every leaf.
+        weights: ``[num_models]`` float weights (sample counts).
+    """
+    w = jnp.asarray(weights, dtype=jnp.float32)
+    norm = w / jnp.maximum(w.sum(), 1e-12)
+
+    def leaf(x: jax.Array) -> jax.Array:
+        wn = norm.reshape((-1,) + (1,) * (x.ndim - 1))
+        return jnp.sum(x.astype(jnp.float32) * wn, axis=0).astype(x.dtype)
+
+    return jax.tree.map(leaf, stacked)
+
+
+@jax.jit
+def fedavg_masked(stacked: Pytree, weights: jax.Array, mask: jax.Array) -> Pytree:
+    """FedAvg over a masked subset of the stack (static shapes, jit-friendly).
+
+    Used by the mesh simulation where the per-round committee is a boolean
+    mask over the population rather than a dynamic-length list (SURVEY.md §7
+    "variable committee membership ... masked updates").
+    """
+    w = jnp.asarray(weights, dtype=jnp.float32) * jnp.asarray(mask, dtype=jnp.float32)
+    return fedavg(stacked, w)
+
+
+@jax.jit
+def fedmedian(stacked: Pytree) -> Pytree:
+    """Coordinate-wise median over the model axis.
+
+    The reference declares FedMedian but raises NotImplementedError at the top
+    of ``aggregate`` (fedmedian.py:41) — implemented for real here.
+    """
+
+    def leaf(x: jax.Array) -> jax.Array:
+        return jnp.median(x.astype(jnp.float32), axis=0).astype(x.dtype)
+
+    return jax.tree.map(leaf, stacked)
+
+
+@partial(jax.jit, static_argnames=("trim",))
+def trimmed_mean(stacked: Pytree, trim: int) -> Pytree:
+    """Coordinate-wise trimmed mean: drop the ``trim`` largest and smallest
+    values per coordinate, then average. Byzantine-robust for up to ``trim``
+    adversarial models (Yin et al. 2018)."""
+
+    def leaf(x: jax.Array) -> jax.Array:
+        n = x.shape[0]
+        xs = jnp.sort(x.astype(jnp.float32), axis=0)
+        sl = jax.lax.slice_in_dim(xs, trim, n - trim, axis=0)
+        return jnp.mean(sl, axis=0).astype(x.dtype)
+
+    return jax.tree.map(leaf, stacked)
+
+
+def _flatten_stack(stacked: Pytree) -> jax.Array:
+    """[num_models, total_params] float32 matrix from a stacked pytree."""
+    leaves = jax.tree.leaves(stacked)
+    n = leaves[0].shape[0]
+    return jnp.concatenate(
+        [leaf.reshape(n, -1).astype(jnp.float32) for leaf in leaves], axis=1
+    )
+
+
+@partial(jax.jit, static_argnames=("num_byzantine", "num_selected"))
+def krum_select(stacked: Pytree, num_byzantine: int, num_selected: int = 1) -> jax.Array:
+    """(Multi-)Krum selection scores → indices of the selected models.
+
+    Each model is scored by the sum of squared distances to its
+    ``n - num_byzantine - 2`` nearest neighbors; the ``num_selected`` models
+    with the lowest scores are selected (Blanchard et al. 2017). Returns the
+    selected indices ``[num_selected]``.
+    """
+    x = _flatten_stack(stacked)
+    n = x.shape[0]
+    sq = jnp.sum(x * x, axis=1)
+    d2 = sq[:, None] + sq[None, :] - 2.0 * (x @ x.T)  # pairwise squared dists (MXU)
+    d2 = d2 + jnp.diag(jnp.full((n,), jnp.inf, dtype=d2.dtype))
+    k = max(1, n - num_byzantine - 2)
+    nearest = -jax.lax.top_k(-d2, k)[0]  # k smallest distances per row
+    scores = jnp.sum(nearest, axis=1)
+    _, idx = jax.lax.top_k(-scores, num_selected)
+    return idx
+
+
+@partial(jax.jit, static_argnames=("num_byzantine", "num_selected"))
+def krum(stacked: Pytree, weights: jax.Array, num_byzantine: int, num_selected: int = 1) -> Pytree:
+    """Multi-Krum aggregation: average the selected models (sample-weighted)."""
+    idx = krum_select(stacked, num_byzantine, num_selected)
+    sel = jax.tree.map(lambda x: x[idx], stacked)
+    return fedavg(sel, jnp.asarray(weights, dtype=jnp.float32)[idx])
+
+
+@jax.jit
+def scaffold_update(
+    global_params: Pytree,
+    global_c: Pytree,
+    delta_y_stack: Pytree,
+    delta_c_stack: Pytree,
+    global_lr: jax.Array,
+    total_population: jax.Array,
+) -> tuple[Pytree, Pytree]:
+    """SCAFFOLD server update (Karimireddy et al. 2020).
+
+    Reference semantics (scaffold.py:59-140): the server keeps a simulated
+    global model and a global control variate ``c``; each round it applies the
+    mean client model delta scaled by a global learning rate and moves ``c``
+    by the mean control-variate delta scaled by ``num_clients / N``.
+
+    Returns ``(new_global_params, new_global_c)``.
+    """
+    num_clients = jax.tree.leaves(delta_y_stack)[0].shape[0]
+
+    new_params = jax.tree.map(
+        lambda p, dy: (
+            p.astype(jnp.float32) + global_lr * jnp.mean(dy.astype(jnp.float32), axis=0)
+        ).astype(p.dtype),
+        global_params,
+        delta_y_stack,
+    )
+    frac = num_clients / jnp.maximum(total_population, 1.0)
+    new_c = jax.tree.map(
+        lambda c, dc: (
+            c.astype(jnp.float32) + frac * jnp.mean(dc.astype(jnp.float32), axis=0)
+        ).astype(c.dtype),
+        global_c,
+        delta_c_stack,
+    )
+    return new_params, new_c

@@ -1,0 +1,147 @@
+"""Safe, zero-copy-friendly wire format for model weights.
+
+The reference pickles ``{"params": [np.ndarray, ...], "additional_info": {...}}``
+and unpickles network payloads (p2pfl/learning/frameworks/p2pfl_model.py:71-101)
+— an RCE risk called out in SURVEY.md §7. This module replaces pickle with a
+flat self-describing buffer:
+
+    magic "PFLT" | u16 version | u32 header_len | msgpack header | raw array bytes
+
+The header carries dtype/shape per tensor plus a metadata dict (contributors,
+num_samples, aggregator extra-info). Raw tensor bytes are laid out back to
+back, 64-byte aligned, so deserialization is ``np.frombuffer`` views — no
+copies, no code execution. Metadata is msgpack (no arbitrary objects); numpy
+arrays inside metadata (e.g. SCAFFOLD control variates, scaffold.py:59-140 in
+the reference) are encoded recursively with the same dtype/shape tagging.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Sequence, Tuple
+
+import msgpack
+import numpy as np
+
+from p2pfl_tpu.exceptions import DecodingParamsError
+
+_MAGIC = b"PFLT"
+_VERSION = 1
+_ALIGN = 64
+
+# Sentinel key marking a msgpack map as an encoded ndarray.
+_NDARRAY_KEY = "__pflt_ndarray__"
+
+
+def _dtype_to_str(dt: np.dtype) -> str:
+    """Portable dtype tag. ``dt.str`` is an opaque void ('|V2') for ml_dtypes
+    types like bfloat16, so prefer the name when numpy can't round-trip it."""
+    try:
+        if np.dtype(dt.str) == dt:
+            return dt.str
+    except TypeError:
+        pass
+    return dt.name
+
+
+def _str_to_dtype(s: str) -> np.dtype:
+    try:
+        return np.dtype(s)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, s))
+
+
+def _encode_meta_value(v: Any) -> Any:
+    """Recursively make a metadata value msgpack-safe (ndarrays tagged)."""
+    if isinstance(v, np.ndarray):
+        return {
+            _NDARRAY_KEY: True,
+            "dtype": _dtype_to_str(v.dtype),
+            "shape": list(v.shape),
+            "data": v.tobytes(),
+        }
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, dict):
+        return {str(k): _encode_meta_value(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_encode_meta_value(x) for x in v]
+    if v is None or isinstance(v, (bool, int, float, str, bytes)):
+        return v
+    raise TypeError(f"metadata value of type {type(v)!r} is not serializable")
+
+
+def _decode_meta_value(v: Any) -> Any:
+    if isinstance(v, dict):
+        if v.get(_NDARRAY_KEY):
+            arr = np.frombuffer(v["data"], dtype=_str_to_dtype(v["dtype"]))
+            return arr.reshape(v["shape"]).copy()
+        return {k: _decode_meta_value(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_decode_meta_value(x) for x in v]
+    return v
+
+
+def _pad(n: int) -> int:
+    return (-n) % _ALIGN
+
+
+def serialize_arrays(
+    arrays: Sequence[np.ndarray], metadata: Dict[str, Any] | None = None
+) -> bytes:
+    """Encode a flat list of arrays + metadata dict into one buffer."""
+    # np.asarray(order="C") rather than ascontiguousarray: the latter promotes
+    # 0-d arrays to 1-d (numpy >= 2.0), which would corrupt scalar leaves.
+    np_arrays = [np.asarray(a, order="C") for a in arrays]
+    header = {
+        "tensors": [{"dtype": _dtype_to_str(a.dtype), "shape": list(a.shape)} for a in np_arrays],
+        "meta": _encode_meta_value(metadata or {}),
+    }
+    header_bytes = msgpack.packb(header, use_bin_type=True)
+    parts = [_MAGIC, struct.pack("<HI", _VERSION, len(header_bytes)), header_bytes]
+    offset = len(_MAGIC) + 6 + len(header_bytes)
+    parts.append(b"\0" * _pad(offset))
+    offset += _pad(offset)
+    for a in np_arrays:
+        raw = a.tobytes()
+        parts.append(raw)
+        offset += len(raw)
+        parts.append(b"\0" * _pad(offset))
+        offset += _pad(offset)
+    return b"".join(parts)
+
+
+def deserialize_arrays(buf: bytes) -> Tuple[List[np.ndarray], Dict[str, Any]]:
+    """Decode a buffer produced by :func:`serialize_arrays`.
+
+    Returns (arrays, metadata). Arrays are zero-copy views into ``buf`` where
+    alignment allows (always, by construction).
+    """
+    try:
+        if buf[:4] != _MAGIC:
+            raise DecodingParamsError("bad magic — not a p2pfl_tpu weights buffer")
+        version, header_len = struct.unpack_from("<HI", buf, 4)
+        if version != _VERSION:
+            raise DecodingParamsError(f"unsupported wire version {version}")
+        header_end = 10 + header_len
+        header = msgpack.unpackb(buf[10:header_end], raw=False)
+        offset = header_end + _pad(header_end)
+        arrays: List[np.ndarray] = []
+        for t in header["tensors"]:
+            dtype = _str_to_dtype(t["dtype"])
+            shape = tuple(t["shape"])
+            count = int(np.prod(shape, dtype=np.int64))
+            nbytes = dtype.itemsize * count
+            arr = np.frombuffer(buf, dtype=dtype, count=count, offset=offset)
+            arrays.append(arr.reshape(shape))
+            offset += nbytes + _pad(offset + nbytes)
+        meta = _decode_meta_value(header.get("meta", {}))
+        return arrays, meta
+    except DecodingParamsError:
+        raise
+    except Exception as exc:  # malformed input of any kind
+        raise DecodingParamsError(f"could not decode weights payload: {exc}") from exc

@@ -1,0 +1,4 @@
+"""Mesh-scale execution: sharded population simulation, mesh helpers."""
+
+from p2pfl_tpu.parallel.mesh import make_mesh  # noqa: F401
+from p2pfl_tpu.parallel.simulation import MeshSimulation  # noqa: F401

@@ -1,0 +1,53 @@
+"""Device-mesh construction helpers.
+
+The simulation backend replaces the reference's Ray actor pool
+(p2pfl/learning/frameworks/simulation/actor_pool.py:69-357) with placement on
+a ``jax.sharding.Mesh``. Axes:
+
+* ``nodes`` — the federated population axis (the "one node per device" axis
+  of the north-star; with more nodes than devices each device holds a slab),
+* ``model`` — tensor-parallel axis for sharding wide layers within a node,
+  rides ICI.
+
+On a single host this builds from local devices; on multi-host deployments
+call :func:`jax.distributed.initialize` first and the same code builds a
+global mesh over DCN+ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def make_mesh(
+    shape: Optional[Sequence[int]] = None,
+    axis_names: Sequence[str] = ("nodes", "model"),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a mesh. Default shape: all devices on the ``nodes`` axis.
+
+    Args:
+        shape: per-axis device counts (must multiply to len(devices)).
+        axis_names: mesh axis names, default ``("nodes", "model")``.
+        devices: devices to use (default ``jax.devices()``).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    if int(np.prod(shape)) != len(devices):
+        raise ValueError(f"mesh shape {shape} != {len(devices)} devices")
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def population_sharding(mesh: Mesh, axis: str = "nodes") -> NamedSharding:
+    """Sharding for stacked-population arrays: leading axis over ``nodes``."""
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

@@ -1,0 +1,4 @@
+"""Learning workflow: a stage-machine over federated rounds."""
+
+from p2pfl_tpu.stages.stage import Stage, check_early_stop  # noqa: F401
+from p2pfl_tpu.stages.workflow import LearningWorkflow  # noqa: F401

@@ -1,0 +1,356 @@
+"""The six stages of a federated round.
+
+Round trip (reference docs/source/components/workflows.md:12-24 and SURVEY.md
+§2.2): StartLearning → [Vote → (Train | WaitAgg) → GossipModel →
+RoundFinished] * rounds. Stage names match the reference's history pattern so
+the e2e assertions are comparable (test/node_test.py:114-120).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import time
+from typing import TYPE_CHECKING, List, Optional, Type
+
+from p2pfl_tpu.comm.commands.impl import (
+    FullModelCommand,
+    InitModelCommand,
+    MetricsCommand,
+    ModelInitializedCommand,
+    ModelsAggregatedCommand,
+    ModelsReadyCommand,
+    PartialModelCommand,
+    VoteTrainSetCommand,
+)
+from p2pfl_tpu.comm.envelope import Envelope
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.stages.stage import Stage, check_early_stop
+
+if TYPE_CHECKING:  # pragma: no cover
+    from p2pfl_tpu.node import Node
+
+log = logging.getLogger("p2pfl_tpu")
+
+
+class StartLearningStage(Stage):
+    """Set up the experiment, announce/diffuse the initial model
+    (reference stages/base_node/start_learning_stage.py:35-113)."""
+
+    name = "StartLearningStage"
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        state = node.state
+        # Our nodes are constructed with a model; announce it.
+        state.model_initialized_event.set()
+        node.protocol.broadcast(node.protocol.build_msg(ModelInitializedCommand.get_name()))
+        # Let heartbeats propagate membership before voting
+        # (reference start_learning_stage.py:78-84).
+        time.sleep(Settings.WAIT_HEARTBEATS_CONVERGENCE)
+
+        # Diffuse initial weights to direct neighbors that haven't announced
+        # an initialized model yet (reference :86-113).
+        def candidates() -> List[str]:
+            return [
+                n
+                for n in node.protocol.get_neighbors(only_direct=True)
+                if n not in state.nei_status
+            ]
+
+        # The model doesn't change during this stage — serialize once, not
+        # per candidate per gossip tick.
+        model = node.learner.get_model()
+        payload = model.encode_parameters()
+        env = node.protocol.build_weights(
+            InitModelCommand.get_name(),
+            state.round or 0,
+            payload,
+            model.contributors or [node.addr],
+            model.get_num_samples(),
+        )
+
+        node.protocol.gossip_weights(
+            early_stopping_fn=lambda: check_early_stop(node),
+            get_candidates_fn=candidates,
+            status_fn=lambda: sorted(candidates()),
+            model_fn=lambda nei: env,
+        )
+        if check_early_stop(node):
+            return None
+        return VoteTrainSetStage
+
+
+class VoteTrainSetStage(Stage):
+    """Committee election by random weighted voting
+    (reference stages/base_node/vote_train_set_stage.py:34-184)."""
+
+    name = "VoteTrainSetStage"
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        state = node.state
+        if check_early_stop(node):
+            return None
+
+        # --- cast votes (reference :80-106) ---------------------------------
+        candidates = list(node.protocol.get_neighbors(only_direct=False)) + [node.addr]
+        num_votes = min(Settings.TRAIN_SET_SIZE, len(candidates))
+        chosen = random.sample(candidates, num_votes)
+        weights = [int((random.randint(0, 1000) / (i + 1))) for i in range(num_votes)]
+        my_votes = dict(zip(chosen, weights))
+        with state.train_set_votes_lock:
+            state.train_set_votes[node.addr] = my_votes
+        flat: List[str] = []
+        for cand, w in my_votes.items():
+            flat.extend([cand, str(w)])
+        node.protocol.broadcast(
+            node.protocol.build_msg(
+                VoteTrainSetCommand.get_name(), args=flat, round=state.round or 0
+            )
+        )
+
+        # --- aggregate votes (reference :108-168) ---------------------------
+        deadline = time.time() + Settings.VOTE_TIMEOUT
+        while True:
+            if check_early_stop(node):
+                return None
+            expected = set(node.protocol.get_neighbors(only_direct=False)) | {node.addr}
+            with state.train_set_votes_lock:
+                have = set(state.train_set_votes)
+            if expected <= have:
+                break
+            if time.time() >= deadline:
+                log.info("%s: vote timeout — missing %s", node.addr, expected - have)
+                break
+            state.votes_ready_event.wait(timeout=2.0)
+            state.votes_ready_event.clear()
+
+        with state.train_set_votes_lock:
+            all_votes = {n: dict(v) for n, v in state.train_set_votes.items()}
+            state.train_set_votes = {}
+
+        tally: dict[str, int] = {}
+        for votes in all_votes.values():
+            for cand, w in votes.items():
+                tally[cand] = tally.get(cand, 0) + int(w)
+        # top-K by weight, alphabetical tie-break (reference :150-160)
+        ranked = sorted(tally.items(), key=lambda kv: (-kv[1], kv[0]))
+        train_set = [cand for cand, _ in ranked[: Settings.TRAIN_SET_SIZE]]
+        # validate against live membership (reference :170-181)
+        live = set(node.protocol.get_neighbors(only_direct=False)) | {node.addr}
+        state.train_set = [n for n in train_set if n in live]
+        log.info("%s: round %s trainset %s", node.addr, state.round, state.train_set)
+
+        if check_early_stop(node):
+            return None
+        return TrainStage if node.addr in state.train_set else WaitAggregatedModelsStage
+
+
+class TrainStage(Stage):
+    """Local training + partial-aggregation gossip
+    (reference stages/base_node/train_stage.py:35-187)."""
+
+    name = "TrainStage"
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        state = node.state
+        node.aggregator.set_nodes_to_aggregate(state.train_set)
+
+        # Evaluate + share metrics (reference :102-116).
+        TrainStage._evaluate_and_broadcast(node)
+        if check_early_stop(node):
+            return None
+
+        node.learner.fit()
+        if check_early_stop(node):
+            return None
+
+        own = node.learner.get_model()
+        agg_list = node.aggregator.add_model(own)
+        node.protocol.broadcast(
+            node.protocol.build_msg(
+                ModelsAggregatedCommand.get_name(), args=agg_list, round=state.round or 0
+            )
+        )
+
+        TrainStage._gossip_partial_models(node)
+        if check_early_stop(node):
+            return None
+
+        # Adopt the aggregated model (reference :90-96).
+        try:
+            aggregated = node.aggregator.wait_and_get_aggregation(
+                Settings.AGGREGATION_TIMEOUT
+            )
+        except RuntimeError:
+            log.warning("%s: aggregation produced nothing this round", node.addr)
+            aggregated = own
+        node.learner.get_model().set_parameters(aggregated.params)
+        node.learner.get_model().set_contribution(
+            aggregated.contributors, aggregated.get_num_samples()
+        )
+        node.learner.get_model().additional_info.update(aggregated.additional_info)
+        state.aggregated_model_event.set()
+        node.protocol.broadcast(
+            node.protocol.build_msg(ModelsReadyCommand.get_name(), round=state.round or 0)
+        )
+        return GossipModelStage
+
+    @staticmethod
+    def _evaluate_and_broadcast(node: "Node") -> None:
+        metrics = node.learner.evaluate()
+        if metrics:
+            flat: List[str] = []
+            for k, v in metrics.items():
+                flat.extend([k, str(v)])
+                node.log_metric(k, v)
+            node.protocol.broadcast(
+                node.protocol.build_msg(
+                    MetricsCommand.get_name(), args=flat, round=node.state.round or 0
+                )
+            )
+
+    @staticmethod
+    def _gossip_partial_models(node: "Node") -> None:
+        """Partial-aggregation gossip to trainset peers
+        (reference train_stage.py:118-168)."""
+        state = node.state
+
+        def early_stop() -> bool:
+            # Keep gossiping until every trainset peer reports full coverage —
+            # exiting on own completion would starve peers a round behind
+            # (reference train_stage.py:118-168 loops on peer progress).
+            return check_early_stop(node)
+
+        def candidates() -> List[str]:
+            # trainset peers that haven't reported merging everyone
+            return [
+                n
+                for n in state.train_set
+                if n != node.addr
+                and set(state.models_aggregated.get(n, [])) < set(state.train_set)
+            ]
+
+        def status() -> list:
+            return sorted((n, tuple(sorted(state.models_aggregated.get(n, [])))) for n in state.train_set)
+
+        def model_fn(nei: str) -> Optional[Envelope]:
+            partial = node.aggregator.get_partial_model(
+                except_nodes=state.models_aggregated.get(nei, [])
+            )
+            if partial is None:
+                return None
+            return node.protocol.build_weights(
+                PartialModelCommand.get_name(),
+                state.round or 0,
+                partial.encode_parameters(),
+                partial.get_contributors(),
+                partial.get_num_samples(),
+            )
+
+        node.protocol.gossip_weights(
+            early_stopping_fn=early_stop,
+            get_candidates_fn=candidates,
+            status_fn=status,
+            model_fn=model_fn,
+        )
+
+
+class WaitAggregatedModelsStage(Stage):
+    """Non-trainers wait for a full model
+    (reference stages/base_node/wait_agg_models_stage.py:31-67)."""
+
+    name = "WaitAggregatedModelsStage"
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        state = node.state
+        r = state.round if state.round is not None else 0
+        if state.last_full_model_round >= r:
+            # The full model already arrived before this stage started
+            # (clear-then-wait race) — nothing to wait for.
+            got_it = True
+        else:
+            state.aggregated_model_event.clear()
+            if state.last_full_model_round >= r:  # re-check after clear
+                got_it = True
+            else:
+                got_it = state.aggregated_model_event.wait(
+                    timeout=Settings.AGGREGATION_TIMEOUT
+                )
+        if not got_it:
+            log.warning("%s: no aggregated model arrived within timeout", node.addr)
+        if check_early_stop(node):
+            return None
+        node.protocol.broadcast(
+            node.protocol.build_msg(ModelsReadyCommand.get_name(), round=state.round or 0)
+        )
+        return GossipModelStage
+
+
+class GossipModelStage(Stage):
+    """Diffuse the full aggregated model to lagging neighbors
+    (reference stages/base_node/gossip_model_stage.py:32-87)."""
+
+    name = "GossipModelStage"
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        state = node.state
+
+        def candidates() -> List[str]:
+            r = state.round
+            if r is None:
+                return []
+            return [
+                n
+                for n in node.protocol.get_neighbors(only_direct=True)
+                if state.nei_status.get(n, -1) < r
+            ]
+
+        # Serialize the (stage-constant) full model once for all ticks/peers.
+        model = node.learner.get_model()
+        env = node.protocol.build_weights(
+            FullModelCommand.get_name(),
+            state.round or 0,
+            model.encode_parameters(),
+            model.contributors or [node.addr],
+            model.get_num_samples(),
+        )
+
+        node.protocol.gossip_weights(
+            early_stopping_fn=lambda: check_early_stop(node),
+            get_candidates_fn=candidates,
+            status_fn=lambda: sorted(candidates()),
+            model_fn=lambda nei: env,
+        )
+        if check_early_stop(node):
+            return None
+        return RoundFinishedStage
+
+
+class RoundFinishedStage(Stage):
+    """Close the round; loop or finish
+    (reference stages/base_node/round_finished_stage.py:33-91)."""
+
+    name = "RoundFinishedStage"
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        state = node.state
+        if check_early_stop(node):
+            return None
+        node.aggregator.clear()
+        state.increase_round()
+        node.log_round_finished()
+
+        r, total = state.round, state.total_rounds
+        if r is not None and total is not None and r < total:
+            return VoteTrainSetStage
+
+        # Final evaluation + wrap-up (reference :60-91).
+        TrainStage._evaluate_and_broadcast(node)
+        node.finish_learning()
+        return None

@@ -1,0 +1,44 @@
+"""The while-loop stage machine (reference p2pfl/stages/workflows.py:28-58):
+run stage -> next stage class -> repeat until None; record history for
+test assertions (reference test/node_test.py:114-120)."""
+
+from __future__ import annotations
+
+import logging
+from typing import TYPE_CHECKING, List, Optional, Type
+
+from p2pfl_tpu.stages.stage import Stage
+
+if TYPE_CHECKING:  # pragma: no cover
+    from p2pfl_tpu.node import Node
+
+log = logging.getLogger("p2pfl_tpu")
+
+
+class LearningWorkflow:
+    def __init__(self, start_stage: Optional[Type[Stage]] = None) -> None:
+        if start_stage is None:
+            from p2pfl_tpu.stages.base_node import StartLearningStage
+
+            start_stage = StartLearningStage
+        self.start_stage = start_stage
+        self.history: List[str] = []
+
+    def run(self, node: "Node") -> None:
+        from p2pfl_tpu.exceptions import ProtocolNotStartedError
+
+        stage: Optional[Type[Stage]] = self.start_stage
+        try:
+            while stage is not None:
+                self.history.append(stage.name)
+                log.debug("%s: stage %s", node.addr, stage.name)
+                stage = stage.execute(node)
+        except StopIteration:
+            log.info("%s: learning stopped early", node.addr)
+        except ProtocolNotStartedError:
+            # Node was stopped under our feet; treat as an early stop rather
+            # than letting the exception escape the daemon thread.
+            log.info("%s: protocol stopped mid-workflow — aborting learning", node.addr)
+        except Exception:
+            log.exception("%s: workflow crashed", node.addr)
+            raise

@@ -1,0 +1,1 @@
+"""Utility helpers (topologies, test helpers, singleton)."""

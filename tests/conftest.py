@@ -1,0 +1,49 @@
+"""Test harness config: run JAX on a virtual 8-device CPU mesh.
+
+The container may auto-register a TPU platform plugin at interpreter startup
+(sitecustomize) and pin ``jax_platforms`` to it; unit tests must run on a
+virtual 8-device CPU mesh instead, so we (a) set the XLA host-device-count
+flag before any backend initializes and (b) force the platform config back to
+cpu. Mirrors the reference's ``set_test_settings()`` pattern
+(p2pfl/utils/utils.py:24-40) of shrinking timeouts for in-process multi-node
+tests.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# The sitecustomize plugin calls jax.config.update("jax_platforms", "axon,cpu")
+# at startup; the env var alone no longer wins. No backend is initialized yet
+# at conftest-import time, so this is safe.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fast_settings():
+    from p2pfl_tpu.config import Settings
+    from p2pfl_tpu.utils.utils import set_test_settings
+
+    snap = Settings.snapshot()
+    set_test_settings()
+    yield
+    Settings.restore(snap)
+
+
+@pytest.fixture(autouse=True)
+def _reset_memory_transport():
+    """Each test gets a clean in-memory transport registry."""
+    yield
+    try:
+        from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+
+        InMemoryRegistry.reset()
+    except ImportError:
+        pass

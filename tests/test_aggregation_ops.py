@@ -1,0 +1,86 @@
+"""Aggregation kernel semantics vs. straightforward numpy references."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from p2pfl_tpu.ops import aggregation as agg
+
+
+def _stack(n, seed=0):
+    rng = np.random.default_rng(seed)
+    trees = [
+        {
+            "w": rng.normal(size=(5, 3)).astype(np.float32),
+            "b": rng.normal(size=(3,)).astype(np.float32),
+        }
+        for _ in range(n)
+    ]
+    return trees, agg.tree_stack(trees)
+
+
+def test_fedavg_weighted_mean():
+    trees, stacked = _stack(4)
+    w = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    out = agg.fedavg(stacked, w)
+    expect = sum(wi * t["w"] for wi, t in zip(w, trees)) / w.sum()
+    np.testing.assert_allclose(np.asarray(out["w"]), expect, rtol=1e-5)
+
+
+def test_fedavg_masked_matches_subset():
+    trees, stacked = _stack(6)
+    w = np.full((6,), 10.0, np.float32)
+    mask = np.array([1, 0, 1, 0, 0, 1], np.float32)
+    out = agg.fedavg_masked(stacked, w, mask)
+    subset = agg.tree_stack([trees[0], trees[2], trees[5]])
+    expect = agg.fedavg(subset, np.full((3,), 10.0, np.float32))
+    np.testing.assert_allclose(np.asarray(out["w"]), np.asarray(expect["w"]), rtol=1e-5)
+
+
+def test_fedmedian():
+    trees, stacked = _stack(5)
+    out = agg.fedmedian(stacked)
+    expect = np.median(np.stack([t["b"] for t in trees]), axis=0)
+    np.testing.assert_allclose(np.asarray(out["b"]), expect, rtol=1e-6)
+
+
+def test_trimmed_mean_drops_outliers():
+    trees, stacked = _stack(5)
+    # poison model 0 with huge values
+    poisoned = [dict(t) for t in trees]
+    poisoned[0] = {"w": trees[0]["w"] + 1e6, "b": trees[0]["b"] - 1e6}
+    stacked_p = agg.tree_stack(poisoned)
+    out = agg.trimmed_mean(stacked_p, trim=1)
+    vals = np.stack([t["w"] for t in poisoned])
+    svals = np.sort(vals, axis=0)[1:-1]
+    np.testing.assert_allclose(np.asarray(out["w"]), svals.mean(axis=0), rtol=1e-5)
+    assert np.abs(np.asarray(out["w"])).max() < 1e3
+
+
+def test_krum_excludes_byzantine():
+    rng = np.random.default_rng(1)
+    base = rng.normal(size=(8,)).astype(np.float32)
+    # 5 honest models near base, 2 byzantine far away
+    models = [{"p": base + 0.01 * rng.normal(size=(8,)).astype(np.float32)} for _ in range(5)]
+    models += [{"p": base + 100.0} for _ in range(2)]
+    stacked = agg.tree_stack(models)
+    idx = np.asarray(agg.krum_select(stacked, num_byzantine=2, num_selected=3))
+    assert set(idx.tolist()) <= {0, 1, 2, 3, 4}
+    out = agg.krum(stacked, np.ones((7,), np.float32), num_byzantine=2, num_selected=3)
+    assert np.abs(np.asarray(out["p"]) - base).max() < 1.0
+
+
+def test_scaffold_update():
+    gp = {"w": np.zeros((2, 2), np.float32)}
+    gc = {"w": np.zeros((2, 2), np.float32)}
+    dy = agg.tree_stack([{"w": np.ones((2, 2), np.float32)}, {"w": 3 * np.ones((2, 2), np.float32)}])
+    dc = agg.tree_stack([{"w": np.ones((2, 2), np.float32)}, {"w": np.ones((2, 2), np.float32)}])
+    new_p, new_c = agg.scaffold_update(gp, gc, dy, dc, jnp.float32(1.0), jnp.float32(4.0))
+    np.testing.assert_allclose(np.asarray(new_p["w"]), 2 * np.ones((2, 2)), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(new_c["w"]), 0.5 * np.ones((2, 2)), rtol=1e-6)
+
+
+def test_stack_unstack_roundtrip():
+    trees, stacked = _stack(3)
+    out = agg.tree_unstack(stacked, 3)
+    for a, b in zip(trees, out):
+        np.testing.assert_array_equal(a["w"], np.asarray(b["w"]))

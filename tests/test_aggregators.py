@@ -1,0 +1,175 @@
+"""Host aggregator semantics (mirrors reference test/learning/aggregator_test.py
+and scaffold_test.py:32-79): contributor dedup, trainset checks, completion
+event, partial aggregation, timeout paths."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.learning.aggregators import FedAvg, FedMedian, Krum, Scaffold, TrimmedMean
+from p2pfl_tpu.models.model_handle import ModelHandle
+
+
+def _model(value, contributors, num_samples=10):
+    params = {"w": np.full((4, 4), float(value), np.float32)}
+    return ModelHandle(params, contributors=list(contributors), num_samples=num_samples)
+
+
+def test_fedavg_weighted():
+    agg = FedAvg()
+    agg.set_nodes_to_aggregate(["a", "b"])
+    agg.add_model(_model(1.0, ["a"], num_samples=10))
+    agg.add_model(_model(4.0, ["b"], num_samples=30))
+    out = agg.wait_and_get_aggregation(timeout=1)
+    np.testing.assert_allclose(np.asarray(out.params["w"]), 3.25, rtol=1e-6)
+    assert out.get_contributors() == ["a", "b"]
+    assert out.get_num_samples() == 40
+
+
+def test_duplicate_contribution_ignored():
+    agg = FedAvg()
+    agg.set_nodes_to_aggregate(["a", "b"])
+    agg.add_model(_model(1.0, ["a"]))
+    res = agg.add_model(_model(9.0, ["a"]))  # duplicate
+    assert res == ["a"]
+    agg.add_model(_model(2.0, ["b"]))
+    out = agg.wait_and_get_aggregation(timeout=1)
+    np.testing.assert_allclose(np.asarray(out.params["w"]), 1.5, rtol=1e-6)
+
+
+def test_out_of_trainset_rejected():
+    agg = FedAvg()
+    agg.set_nodes_to_aggregate(["a", "b"])
+    res = agg.add_model(_model(7.0, ["evil"]))
+    assert res == []
+    assert agg.get_aggregated_models() == []
+
+
+def test_completion_event_and_missing():
+    agg = FedAvg()
+    agg.set_nodes_to_aggregate(["a", "b", "c"])
+    agg.add_model(_model(1.0, ["a"]))
+    assert agg.get_missing_models() == ["b", "c"]
+    assert not agg._finish_event.is_set()
+    agg.add_model(_model(1.0, ["b", "c"]))  # partial model covers the rest
+    assert agg._finish_event.is_set()
+
+
+def test_wait_timeout_aggregates_partial():
+    agg = FedAvg()
+    agg.set_nodes_to_aggregate(["a", "b"])
+    agg.add_model(_model(2.0, ["a"]))
+    t0 = time.monotonic()
+    out = agg.wait_and_get_aggregation(timeout=0.2)
+    assert time.monotonic() - t0 >= 0.2
+    np.testing.assert_allclose(np.asarray(out.params["w"]), 2.0, rtol=1e-6)
+
+
+def test_wait_empty_raises():
+    agg = FedAvg()
+    agg.set_nodes_to_aggregate(["a"])
+    with pytest.raises(RuntimeError):
+        agg.wait_and_get_aggregation(timeout=0.05)
+
+
+def test_partial_model_for_gossip():
+    agg = FedAvg()
+    agg.set_nodes_to_aggregate(["a", "b", "c"])
+    agg.add_model(_model(1.0, ["a"], num_samples=10))
+    agg.add_model(_model(3.0, ["b"], num_samples=10))
+    partial = agg.get_partial_model(except_nodes=["a"])
+    assert partial is not None
+    assert partial.get_contributors() == ["b"]
+    both = agg.get_partial_model(except_nodes=[])
+    assert both.get_contributors() == ["a", "b"]
+    np.testing.assert_allclose(np.asarray(both.params["w"]), 2.0, rtol=1e-6)
+    assert agg.get_partial_model(except_nodes=["a", "b"]) is None
+
+
+def test_double_open_raises():
+    agg = FedAvg()
+    agg.set_nodes_to_aggregate(["a"])
+    with pytest.raises(RuntimeError):
+        agg.set_nodes_to_aggregate(["b"])
+    agg.clear()
+    agg.set_nodes_to_aggregate(["b"])  # ok after clear
+
+
+def test_concurrent_adds():
+    agg = FedAvg()
+    members = [f"n{i}" for i in range(16)]
+    agg.set_nodes_to_aggregate(members)
+    threads = [
+        threading.Thread(target=agg.add_model, args=(_model(i, [f"n{i}"]),))
+        for i in range(16)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert agg.get_aggregated_models() == sorted(members)
+    assert agg._finish_event.is_set()
+
+
+def test_fedmedian_rule():
+    agg = FedMedian()
+    agg.set_nodes_to_aggregate(["a", "b", "c"])
+    for v, n in [(1.0, "a"), (2.0, "b"), (100.0, "c")]:
+        agg.add_model(_model(v, [n]))
+    out = agg.wait_and_get_aggregation(timeout=1)
+    np.testing.assert_allclose(np.asarray(out.params["w"]), 2.0, rtol=1e-6)
+
+
+def test_trimmed_mean_rule():
+    agg = TrimmedMean(trim_ratio=0.34)
+    agg.set_nodes_to_aggregate(["a", "b", "c"])
+    for v, n in [(1.0, "a"), (2.0, "b"), (1000.0, "c")]:
+        agg.add_model(_model(v, [n]))
+    out = agg.wait_and_get_aggregation(timeout=1)
+    np.testing.assert_allclose(np.asarray(out.params["w"]), 2.0, rtol=1e-6)
+
+
+def test_krum_rule_picks_clustered():
+    agg = Krum(num_byzantine=1, num_selected=1)
+    agg.set_nodes_to_aggregate(["a", "b", "c", "d"])
+    for v, n in [(1.0, "a"), (1.01, "b"), (0.99, "c"), (500.0, "d")]:
+        agg.add_model(_model(v, [n]))
+    out = agg.wait_and_get_aggregation(timeout=1)
+    assert abs(float(np.asarray(out.params["w"])[0, 0])) < 2.0
+
+
+def test_scaffold_aggregation_roundtrip():
+    agg = Scaffold(global_lr=1.0)
+    agg.set_nodes_to_aggregate(["a", "b"])
+
+    def scaffold_model(value, name, dy, dc):
+        m = _model(value, [name])
+        m.add_info(
+            "scaffold",
+            {
+                "delta_y_i": [np.full((4, 4), dy, np.float32)],
+                "delta_c_i": [np.full((4, 4), dc, np.float32)],
+            },
+        )
+        return m
+
+    # both clients started from global = value - dy
+    agg.add_model(scaffold_model(2.0, "a", dy=1.0, dc=0.5))
+    agg.add_model(scaffold_model(4.0, "b", dy=3.0, dc=0.5))
+    out = agg.wait_and_get_aggregation(timeout=1)
+    # global starts at 2-1=1; update = 1 + mean(1,3) = 3
+    np.testing.assert_allclose(np.asarray(out.params["w"]), 3.0, rtol=1e-6)
+    server_info = out.get_info("scaffold_server")
+    np.testing.assert_allclose(server_info["global_c"][0], 0.5, rtol=1e-6)
+    assert out.get_info("scaffold") is None
+
+
+def test_scaffold_requires_callback_info():
+    agg = Scaffold()
+    agg.set_nodes_to_aggregate(["a"])
+    agg.add_model(_model(1.0, ["a"]))
+    with pytest.raises((ValueError, RuntimeError)):
+        agg.wait_and_get_aggregation(timeout=0.1)
+    assert agg.get_required_callbacks() == ["scaffold"]

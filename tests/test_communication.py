@@ -1,0 +1,164 @@
+"""Communication-layer tests (mirrors reference
+test/communication/communication_test.py): protocol guards, command
+dispatch, neighbor discovery via heartbeats, disconnect reconvergence, and
+abrupt-death detection — over the in-memory transport."""
+
+import time
+from typing import Any
+
+import pytest
+
+from p2pfl_tpu.comm.commands.command import Command
+from p2pfl_tpu.comm.grpc import GrpcCommunicationProtocol
+from p2pfl_tpu.comm.memory.memory_protocol import InMemoryCommunicationProtocol
+from p2pfl_tpu.exceptions import (
+    CommunicationError,
+    NeighborNotConnectedError,
+    ProtocolNotStartedError,
+)
+
+
+class MockCommand(Command):
+    def __init__(self):
+        self.calls = []
+
+    @staticmethod
+    def get_name() -> str:
+        return "mock"
+
+    def execute(self, source: str, round: int, *args: str, **kwargs: Any) -> None:
+        self.calls.append((source, round, args))
+
+
+@pytest.fixture(params=[InMemoryCommunicationProtocol, GrpcCommunicationProtocol])
+def protocol_class(request):
+    """Both transports must satisfy the same behavioral contract (the
+    reference parametrizes identically, communication_test.py:57-195)."""
+    return request.param
+
+
+def _mk(n, cls=InMemoryCommunicationProtocol):
+    protos = [cls() for _ in range(n)]
+    for p in protos:
+        p.start()
+    return protos
+
+
+def _wait(cond, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_not_started_raises():
+    p = InMemoryCommunicationProtocol()
+    with pytest.raises(ProtocolNotStartedError):
+        p.connect("mem://nowhere")
+    with pytest.raises(ProtocolNotStartedError):
+        p.broadcast(p.build_msg("mock"))
+
+
+def test_invalid_connect_raises(protocol_class):
+    (p,) = _mk(1, protocol_class)
+    try:
+        with pytest.raises(CommunicationError):
+            p.connect("mem://does-not-exist" if protocol_class is InMemoryCommunicationProtocol else "127.0.0.1:1")
+    finally:
+        p.stop()
+
+
+def test_send_to_unconnected_raises(protocol_class):
+    a, b = _mk(2, protocol_class)
+    try:
+        with pytest.raises(NeighborNotConnectedError):
+            a.send(b.addr, a.build_msg("mock"))
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_command_dispatch_and_ttl_gossip(protocol_class):
+    a, b, c = _mk(3, protocol_class)
+    cmds = {}
+    for p in (a, b, c):
+        cmd = MockCommand()
+        cmds[p.addr] = cmd
+        p.add_command(cmd)
+    try:
+        # line: a - b - c
+        a.connect(b.addr)
+        b.connect(c.addr)
+        a.broadcast(a.build_msg("mock", args=["x", "y"], round=3))
+        # direct delivery to b, TTL re-gossip to c
+        assert _wait(lambda: cmds[b.addr].calls and cmds[c.addr].calls)
+        src, rnd, args = cmds[c.addr].calls[0]
+        assert src == a.addr and rnd == 3 and args == ("x", "y")
+        # dedup: the same message must be executed exactly once per node
+        time.sleep(0.5)
+        assert len(cmds[b.addr].calls) == 1
+        assert len(cmds[c.addr].calls) == 1
+    finally:
+        for p in (a, b, c):
+            p.stop()
+
+
+def test_neighbor_discovery_via_heartbeats(protocol_class):
+    protos = _mk(5, protocol_class)
+    try:
+        for p in protos[1:]:
+            p.connect(protos[0].addr)
+        # star topology: heartbeat TTL-gossip should reveal everyone
+        assert _wait(
+            lambda: all(len(p.get_neighbors(only_direct=False)) == 4 for p in protos),
+            timeout=8.0,
+        ), {p.addr: p.get_neighbors() for p in protos}
+        # direct neighbors stay as-connected
+        assert len(protos[0].get_neighbors(only_direct=True)) == 4
+        assert all(len(p.get_neighbors(only_direct=True)) == 1 for p in protos[1:])
+    finally:
+        for p in protos:
+            p.stop()
+
+
+def test_disconnect_reconvergence(protocol_class):
+    a, b, c = _mk(3, protocol_class)
+    try:
+        b.connect(a.addr)
+        c.connect(a.addr)
+        assert _wait(lambda: len(a.get_neighbors()) == 2)
+        c.stop()  # abrupt death
+        assert _wait(lambda: c.addr not in a.get_neighbors(), timeout=8.0)
+        assert _wait(lambda: c.addr not in b.get_neighbors(only_direct=False), timeout=8.0)
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_weights_envelope_roundtrip(protocol_class):
+    a, b = _mk(2, protocol_class)
+    received = {}
+
+    class WeightsCmd(Command):
+        @staticmethod
+        def get_name() -> str:
+            return "weights_test"
+
+        def execute(self, source, round, *args, **kwargs):
+            received.update(kwargs, source=source, round=round)
+
+    b.add_command(WeightsCmd())
+    try:
+        a.connect(b.addr)
+        env = a.build_weights("weights_test", 2, b"PAYLOAD", ["a", "b"], 17)
+        a.send(b.addr, env)
+        assert _wait(lambda: received)
+        assert received["weights"] == b"PAYLOAD"
+        assert received["contributors"] == ["a", "b"]
+        assert received["num_samples"] == 17
+        assert received["round"] == 2
+    finally:
+        a.stop()
+        b.stop()

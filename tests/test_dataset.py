@@ -1,0 +1,100 @@
+"""Dataset wrapper + partition strategy tests (mirrors reference
+test/learning/p2pfl_dataset_test.py:93-124)."""
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.learning.dataset import (
+    DirichletPartitionStrategy,
+    FederatedDataset,
+    LabelSkewedPartitionStrategy,
+    PercentageBasedNonIIDPartitionStrategy,
+    RandomIIDPartitionStrategy,
+    synthetic_mnist,
+)
+
+
+@pytest.fixture
+def labels():
+    return np.random.default_rng(0).integers(0, 10, size=1000)
+
+
+def _check_partition(parts, n_total):
+    all_idx = np.concatenate(parts)
+    assert len(all_idx) == len(np.unique(all_idx))  # disjoint
+    assert all_idx.max() < n_total
+
+
+def test_iid_partition(labels):
+    parts = RandomIIDPartitionStrategy.generate(labels, 7, seed=1)
+    _check_partition(parts, len(labels))
+    sizes = [len(p) for p in parts]
+    assert max(sizes) - min(sizes) <= 1
+    assert sum(sizes) == len(labels)
+
+
+def test_iid_deterministic(labels):
+    a = RandomIIDPartitionStrategy.generate(labels, 4, seed=3)
+    b = RandomIIDPartitionStrategy.generate(labels, 4, seed=3)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_dirichlet_partition(labels):
+    parts = DirichletPartitionStrategy.generate(labels, 10, seed=1, alpha=0.1)
+    _check_partition(parts, len(labels))
+    assert sum(len(p) for p in parts) == len(labels)
+    assert min(len(p) for p in parts) >= 2
+    # alpha=0.1 should produce visibly skewed class distributions
+    dists = []
+    for p in parts:
+        hist = np.bincount(labels[p], minlength=10) / max(len(p), 1)
+        dists.append(hist)
+    assert np.std([d.max() for d in dists]) > 0.01
+
+
+def test_label_skewed_partition(labels):
+    parts = LabelSkewedPartitionStrategy.generate(labels, 5, seed=1, classes_per_partition=2)
+    _check_partition(parts, len(labels))
+    for p in parts:
+        assert len(np.unique(labels[p])) <= 2
+
+
+def test_percentage_noniid_partition(labels):
+    parts = PercentageBasedNonIIDPartitionStrategy.generate(labels, 5, seed=1, percentage=0.8)
+    _check_partition(parts, len(labels))
+    # home classes (the top few) should dominate each partition: ~80% of rows
+    # come from the home budget, which may span 2 classes when classes are
+    # smaller than the budget.
+    for p in parts:
+        hist = np.sort(np.bincount(labels[p], minlength=10))[::-1]
+        assert hist[:2].sum() / len(p) > 0.6
+
+
+def test_generate_partitions_end_to_end():
+    ds = synthetic_mnist(n_train=256, n_test=64)
+    parts = ds.generate_partitions(4, RandomIIDPartitionStrategy, seed=0)
+    assert len(parts) == 4
+    assert sum(p.get_num_samples(True) for p in parts) == 256
+    for p in parts:
+        assert p.get_num_samples(False) == 64  # shared test split
+
+
+def test_export_batches_shapes_and_mask():
+    ds = synthetic_mnist(n_train=100, n_test=10)
+    xb, yb, wb = ds.export_batches(32, train=True, seed=0)
+    assert xb.shape == (4, 32, 28, 28)
+    assert yb.shape == (4, 32)
+    assert wb.sum() == 100  # mask covers padding
+    xb, yb, wb = ds.export_batches(32, train=True, drop_remainder=True)
+    assert xb.shape == (3, 32, 28, 28)
+    assert wb.sum() == 96
+
+
+def test_train_test_split_from_arrays():
+    x = np.zeros((100, 4), np.float32)
+    y = np.arange(100) % 3
+    ds = FederatedDataset.from_arrays(x, y)
+    ds.generate_train_test_split(test_size=0.25, seed=0)
+    assert ds.get_num_samples(True) == 75
+    assert ds.get_num_samples(False) == 25

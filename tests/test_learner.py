@@ -1,0 +1,86 @@
+"""JaxLearner behavior: single-learner train smoke tests (mirrors reference
+test/learning/frameworks_test.py:322-385) plus scaffold/fedprox specifics."""
+
+import numpy as np
+
+from p2pfl_tpu.learning.dataset import synthetic_mnist
+from p2pfl_tpu.learning.learner import JaxLearner, LearnerFactory
+from p2pfl_tpu.models import mlp_model
+
+
+def _learner(**kw):
+    model = mlp_model(seed=0)
+    data = synthetic_mnist(n_train=512, n_test=256)
+    return JaxLearner(model=model, data=data, self_addr="n0", batch_size=64, **kw)
+
+
+def test_fit_improves_accuracy():
+    lrn = _learner(lr=3e-3)
+    lrn.set_epochs(2)
+    before = lrn.evaluate()["test_acc"]
+    lrn.fit()
+    after = lrn.evaluate()["test_acc"]
+    assert after > max(before, 0.5), (before, after)
+
+
+def test_fit_sets_contribution():
+    lrn = _learner()
+    lrn.set_epochs(1)
+    model = lrn.fit()
+    assert model.get_contributors() == ["n0"]
+    assert model.get_num_samples() == 512
+
+
+def test_interrupt_before_fit_skips_training():
+    lrn = _learner()
+    lrn.set_epochs(1)
+    before = lrn.get_model().get_parameters()
+    lrn.fit()  # warms things up
+    p_after_first = lrn.get_model().get_parameters()
+    assert any(np.abs(a - b).max() > 0 for a, b in zip(before, p_after_first))
+
+
+def test_scaffold_callback_produces_deltas():
+    lrn = _learner(callbacks=["scaffold"])
+    lrn.set_epochs(1)
+    model = lrn.fit()
+    info = model.get_info("scaffold")
+    assert info is not None
+    n_leaves = len(model.get_parameters())
+    assert len(info["delta_y_i"]) == n_leaves
+    assert len(info["delta_c_i"]) == n_leaves
+    # delta_y must equal final - initial params
+    assert any(np.abs(d).max() > 0 for d in info["delta_y_i"])
+
+
+def test_fedprox_keeps_params_closer_to_anchor():
+    lrn_plain = _learner(lr=1e-2, seed=7)
+    lrn_prox = _learner(lr=1e-2, fedprox_mu=1.0, seed=7)
+    start = [p.copy() for p in lrn_plain.get_model().get_parameters()]
+    lrn_plain.set_epochs(1)
+    lrn_prox.set_epochs(1)
+    lrn_plain.fit()
+    lrn_prox.fit()
+
+    def drift(lrn):
+        return sum(
+            float(np.abs(a - b).sum())
+            for a, b in zip(lrn.get_model().get_parameters(), start)
+        )
+
+    assert drift(lrn_prox) < drift(lrn_plain)
+
+
+def test_metric_reporter_called():
+    lrn = _learner()
+    seen = []
+    lrn.metric_reporter = lambda name, value, step=None: seen.append(name)
+    lrn.set_epochs(1)
+    lrn.fit()
+    lrn.evaluate()
+    assert "train_loss" in seen and "test_acc" in seen
+
+
+def test_learner_factory():
+    model = mlp_model(seed=0)
+    assert LearnerFactory.create_learner(model) is JaxLearner

@@ -1,0 +1,149 @@
+"""End-to-end convergence tests (mirrors reference test/node_test.py:79-135):
+multi-node training over the in-memory transport asserting (a) exact stage
+history per round, (b) equal models across nodes, (c) final accuracy > 0.5
+(reference asserts the same bar on real MNIST; we use the synthetic learnable
+MNIST stand-in — zero egress)."""
+
+import time
+
+import pytest
+
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.models import mlp_model
+from p2pfl_tpu.node import Node
+from p2pfl_tpu.utils.utils import check_equal_models, wait_convergence
+
+
+def _spawn(n, batch_size=32):
+    data = synthetic_mnist(n_train=256 * n, n_test=128)
+    parts = data.generate_partitions(n, RandomIIDPartitionStrategy)
+    nodes = [Node(mlp_model(seed=i), parts[i], batch_size=batch_size) for i in range(n)]
+    for node in nodes:
+        node.start()
+    return nodes
+
+
+def _wait_finished(nodes, timeout=240.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if all(
+            not n.learning_in_progress() and n.learning_workflow is not None
+            for n in nodes
+        ):
+            return
+        time.sleep(0.2)
+    raise TimeoutError("learning did not finish")
+
+
+def _expected_history(rounds, trained_flags):
+    hist = ["StartLearningStage"]
+    for r in range(rounds):
+        hist.append("VoteTrainSetStage")
+        hist.append("TrainStage" if trained_flags[r] else "WaitAggregatedModelsStage")
+        hist.append("GossipModelStage")
+        hist.append("RoundFinishedStage")
+    return hist
+
+
+@pytest.mark.parametrize("n_nodes,rounds", [(2, 2)])
+def test_e2e_convergence_small(n_nodes, rounds):
+    Settings.RESOURCE_MONITOR_PERIOD = 0
+    nodes = _spawn(n_nodes)
+    try:
+        nodes[1].connect(nodes[0].addr)
+        wait_convergence(nodes, n_nodes - 1, wait=5)
+        nodes[0].set_start_learning(rounds=rounds, epochs=1)
+        _wait_finished(nodes)
+        for node in nodes:
+            hist = node.learning_workflow.history
+            # every round is Vote -> (Train|WaitAgg) -> Gossip -> RoundFinished
+            trained = [h == "TrainStage" for h in hist if h in ("TrainStage", "WaitAggregatedModelsStage")]
+            assert hist == _expected_history(rounds, trained)
+        check_equal_models(nodes)
+        accs = [
+            v
+            for exp in logger.get_global_logs().values()
+            for node_metrics in exp.values()
+            for name, vals in node_metrics.items()
+            if name == "test_acc"
+            for _, v in vals
+        ]
+        assert accs and max(accs) > 0.5
+    finally:
+        for node in nodes:
+            node.stop()
+
+
+def test_e2e_line_topology_with_non_trainers():
+    """6 nodes, line connection, committee of 4 — some nodes must take the
+    WaitAggregatedModelsStage path and still converge (reference 6x3 case)."""
+    Settings.RESOURCE_MONITOR_PERIOD = 0
+    n_nodes, rounds = 4, 2
+    with Settings.overridden(TRAIN_SET_SIZE=2):
+        nodes = _spawn(n_nodes)
+        try:
+            for i in range(1, n_nodes):
+                nodes[i].connect(nodes[i - 1].addr)
+            wait_convergence(nodes, n_nodes - 1, wait=8)
+            nodes[0].set_start_learning(rounds=rounds, epochs=1)
+            _wait_finished(nodes)
+            waiters = sum(
+                "WaitAggregatedModelsStage" in n.learning_workflow.history for n in nodes
+            )
+            assert waiters >= 1  # committee smaller than population
+            check_equal_models(nodes)
+        finally:
+            for node in nodes:
+                node.stop()
+
+
+def test_stop_learning_mid_run():
+    Settings.RESOURCE_MONITOR_PERIOD = 0
+    nodes = _spawn(2)
+    try:
+        nodes[1].connect(nodes[0].addr)
+        wait_convergence(nodes, 1, wait=5)
+        nodes[0].set_start_learning(rounds=50, epochs=1)
+        time.sleep(1.0)
+        nodes[0].set_stop_learning()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if all(not n.learning_in_progress() for n in nodes):
+                break
+            time.sleep(0.2)
+        assert all(not n.learning_in_progress() for n in nodes)
+    finally:
+        for node in nodes:
+            node.stop()
+
+
+def test_e2e_over_grpc_transport():
+    """Full convergence over the real gRPC transport (reference runs its e2e
+    matrix over both transports, node_test.py:79)."""
+    from p2pfl_tpu.comm.grpc import GrpcCommunicationProtocol
+
+    Settings.RESOURCE_MONITOR_PERIOD = 0
+    data = synthetic_mnist(n_train=256, n_test=64)
+    parts = data.generate_partitions(2, RandomIIDPartitionStrategy)
+    nodes = [
+        Node(
+            mlp_model(seed=i),
+            parts[i],
+            batch_size=32,
+            protocol=GrpcCommunicationProtocol,
+        )
+        for i in range(2)
+    ]
+    for node in nodes:
+        node.start()
+    try:
+        nodes[1].connect(nodes[0].addr)
+        wait_convergence(nodes, 1, wait=5)
+        nodes[0].set_start_learning(rounds=1, epochs=1)
+        _wait_finished(nodes, timeout=120)
+        check_equal_models(nodes)
+    finally:
+        for node in nodes:
+            node.stop()

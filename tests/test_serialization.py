@@ -1,0 +1,57 @@
+"""Wire-format round-trip and safety tests (no pickle anywhere)."""
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.exceptions import DecodingParamsError
+from p2pfl_tpu.ops.serialization import deserialize_arrays, serialize_arrays
+
+
+def test_roundtrip_basic():
+    arrays = [
+        np.arange(12, dtype=np.float32).reshape(3, 4),
+        np.ones((2, 2, 2), dtype=np.float16),
+        np.array(7, dtype=np.int64),
+        np.zeros((0, 5), dtype=np.float32),
+    ]
+    meta = {"contributors": ["a", "b"], "num_samples": 128, "nested": {"x": [1, 2.5]}}
+    buf = serialize_arrays(arrays, meta)
+    out, meta2 = deserialize_arrays(buf)
+    assert len(out) == len(arrays)
+    for a, b in zip(arrays, out):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+    assert meta2 == meta
+
+
+def test_roundtrip_bfloat16_via_ml_dtypes():
+    import jax.numpy as jnp
+
+    a = np.asarray(jnp.ones((4, 4), dtype=jnp.bfloat16))
+    buf = serialize_arrays([a], {})
+    out, _ = deserialize_arrays(buf)
+    assert out[0].dtype == a.dtype
+    np.testing.assert_array_equal(np.asarray(a, np.float32), np.asarray(out[0], np.float32))
+
+
+def test_metadata_ndarray():
+    c = np.random.default_rng(0).normal(size=(3, 3)).astype(np.float32)
+    buf = serialize_arrays([], {"global_c": c})
+    _, meta = deserialize_arrays(buf)
+    np.testing.assert_array_equal(meta["global_c"], c)
+
+
+def test_bad_magic_raises():
+    with pytest.raises(DecodingParamsError):
+        deserialize_arrays(b"NOPE" + b"\0" * 64)
+
+
+def test_truncated_raises():
+    buf = serialize_arrays([np.ones((10, 10), np.float32)], {})
+    with pytest.raises(DecodingParamsError):
+        deserialize_arrays(buf[: len(buf) // 2])
+
+
+def test_rejects_unserializable_metadata():
+    with pytest.raises(TypeError):
+        serialize_arrays([], {"fn": lambda: None})

@@ -1,0 +1,7 @@
+"""``python -m p2pfl_tpu`` → the CLI."""
+
+import sys
+
+from p2pfl_tpu.cli import main
+
+sys.exit(main())

@@ -1,0 +1,89 @@
+"""Command-line interface (reference p2pfl/cli.py:72-230).
+
+Stdlib :mod:`argparse` (the reference uses typer, which is not a framework
+dependency here). Subcommands:
+
+* ``experiment list`` — table of runnable examples,
+* ``experiment help <name>`` — an example's flags,
+* ``experiment run <name> [args...]`` — run it in a subprocess (like the
+  reference, cli.py:200-230, so a crashed experiment can't take the CLI
+  down),
+* ``bench`` — run the repo's north-star benchmark,
+* ``login`` / ``remote`` / ``launch`` — reserved (the reference ships these
+  as "not implemented yet" stubs, cli.py:72-95).
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+
+from p2pfl_tpu.examples import EXAMPLES
+
+
+def _cmd_experiment(args: argparse.Namespace) -> int:
+    if args.action == "list":
+        width = max(len(n) for n in EXAMPLES)
+        print("Available experiments:")
+        for name, (_, desc) in sorted(EXAMPLES.items()):
+            print(f"  {name:<{width}}  {desc}")
+        return 0
+
+    name = args.name
+    if name not in EXAMPLES:
+        print(f"unknown experiment {name!r}; try 'experiment list'", file=sys.stderr)
+        return 2
+    module = EXAMPLES[name][0]
+    if args.action == "help":
+        return subprocess.call([sys.executable, "-m", module, "--help"])
+    return subprocess.call([sys.executable, "-m", module, *args.extra])
+
+
+def _cmd_bench(args: argparse.Namespace) -> int:
+    import pathlib
+
+    import p2pfl_tpu
+
+    bench = pathlib.Path(p2pfl_tpu.__file__).resolve().parent.parent / "bench.py"
+    if not bench.exists():
+        print(f"bench.py not found at {bench}", file=sys.stderr)
+        return 2
+    return subprocess.call([sys.executable, str(bench)])
+
+
+def _cmd_stub(args: argparse.Namespace) -> int:
+    print(f"{args.command}: not implemented yet (reserved, as in the reference CLI)")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="p2pfl-tpu", description="TPU-native P2P federated learning")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    exp = sub.add_parser("experiment", help="list/inspect/run example experiments")
+    exp_sub = exp.add_subparsers(dest="action", required=True)
+    exp_sub.add_parser("list", help="list available experiments")
+    h = exp_sub.add_parser("help", help="show an experiment's flags")
+    h.add_argument("name")
+    r = exp_sub.add_parser("run", help="run an experiment in a subprocess")
+    r.add_argument("name")
+    r.add_argument("extra", nargs=argparse.REMAINDER, help="flags forwarded to the experiment")
+    exp.set_defaults(fn=_cmd_experiment)
+
+    b = sub.add_parser("bench", help="run the north-star benchmark (bench.py)")
+    b.set_defaults(fn=_cmd_bench)
+
+    for stub in ("login", "remote", "launch"):
+        s = sub.add_parser(stub, help="reserved (not implemented yet)")
+        s.set_defaults(fn=_cmd_stub)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

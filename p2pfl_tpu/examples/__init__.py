@@ -1,0 +1,29 @@
+"""Runnable example experiments (reference p2pfl/examples/).
+
+Each entry maps a name to (module, description). The CLI's ``experiment``
+subcommands (cli.py) discover examples from this registry, mirroring the
+reference CLI's behavior of listing/running scripts from ``p2pfl/examples/``
+(reference cli.py:138-230) — but via ``python -m`` module execution instead
+of path-based subprocess scripts.
+"""
+
+from __future__ import annotations
+
+EXAMPLES = {
+    "mnist": (
+        "p2pfl_tpu.examples.mnist",
+        "N-node MNIST federation: --nodes/--rounds/--epochs/--topology/"
+        "--protocol/--aggregator/--mode (mesh = one sharded XLA program, "
+        "nodes = full async gossip protocol).",
+    ),
+    "node1": (
+        "p2pfl_tpu.examples.node1",
+        "Two-process gRPC quickstart, process 1 (waits for node2, then trains).",
+    ),
+    "node2": (
+        "p2pfl_tpu.examples.node2",
+        "Two-process gRPC quickstart, process 2 (connects to node1).",
+    ),
+}
+
+__all__ = ["EXAMPLES"]

@@ -1,0 +1,209 @@
+"""MNIST federation example (reference p2pfl/examples/mnist.py:121-210).
+
+Two execution modes (SURVEY.md §7 "hard parts"):
+
+* ``--mode mesh`` (default): the TPU-native path — the whole population is a
+  stacked pytree sharded over a device mesh and an experiment is one XLA
+  program (:class:`~p2pfl_tpu.parallel.simulation.MeshSimulation`).
+* ``--mode nodes``: capability-parity path — real :class:`~p2pfl_tpu.node.Node`
+  objects running the async gossip protocol (in-memory or gRPC transport),
+  exactly like the reference example.
+
+Profiling uses stdlib :mod:`cProfile` (the reference wires yappi,
+examples/mnist.py:264-297); output goes under ``profile/mnist/``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+import uuid
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="p2pfl-tpu experiment run mnist", description=__doc__
+    )
+    p.add_argument("--nodes", type=int, default=4, help="population size")
+    p.add_argument("--rounds", type=int, default=2)
+    p.add_argument("--epochs", type=int, default=1, help="local epochs per round")
+    p.add_argument(
+        "--topology",
+        choices=["line", "ring", "star", "full"],
+        default="line",
+        help="overlay topology (nodes mode)",
+    )
+    p.add_argument(
+        "--protocol",
+        choices=["memory", "grpc"],
+        default="memory",
+        help="transport (nodes mode)",
+    )
+    p.add_argument(
+        "--aggregator",
+        choices=["fedavg", "fedmedian", "scaffold", "krum", "trimmed_mean"],
+        default="fedavg",
+    )
+    p.add_argument("--mode", choices=["mesh", "nodes"], default="mesh")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--train-set-size", type=int, default=4, help="committee size")
+    p.add_argument("--samples-per-node", type=int, default=300)
+    p.add_argument("--measure-time", action="store_true")
+    p.add_argument("--profiling", action="store_true", help="cProfile the run")
+    p.add_argument("--seed", type=int, default=42)
+    return p
+
+
+def _make_aggregator(name: str):
+    from p2pfl_tpu.learning.aggregators import (
+        FedAvg,
+        FedMedian,
+        Krum,
+        Scaffold,
+        TrimmedMean,
+    )
+
+    return {
+        "fedavg": FedAvg,
+        "fedmedian": FedMedian,
+        "scaffold": Scaffold,
+        "krum": Krum,
+        "trimmed_mean": TrimmedMean,
+    }[name]()
+
+
+def run_mesh(args: argparse.Namespace) -> dict:
+    from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.ops import aggregation as agg_ops
+    from p2pfl_tpu.parallel.simulation import MeshSimulation
+
+    agg_fn = {
+        "fedavg": agg_ops.fedavg,
+        "fedmedian": lambda stacked, w: agg_ops.fedmedian(stacked),
+    }.get(args.aggregator)
+    if agg_fn is None:
+        print(
+            f"aggregator {args.aggregator!r} has no mesh kernel; using nodes mode",
+            file=sys.stderr,
+        )
+        return run_nodes(args)
+
+    data = synthetic_mnist(
+        n_train=args.nodes * args.samples_per_node, n_test=1024, seed=args.seed
+    )
+    parts = data.generate_partitions(args.nodes, RandomIIDPartitionStrategy)
+    sim = MeshSimulation(
+        mlp_model(seed=0),
+        parts,
+        train_set_size=args.train_set_size,
+        batch_size=args.batch_size,
+        seed=args.seed,
+        aggregate_fn=agg_fn,
+    )
+    res = sim.run(rounds=args.rounds, epochs=args.epochs, warmup=True)
+    return {
+        "mode": "mesh",
+        "sec_per_round": res.seconds_per_round,
+        "final_test_acc": res.test_acc[-1] if res.test_acc else None,
+    }
+
+
+def run_nodes(args: argparse.Namespace) -> dict:
+    import numpy as np
+
+    from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.node import Node
+    from p2pfl_tpu.utils.topologies import TopologyFactory, TopologyType
+    from p2pfl_tpu.utils.utils import (
+        check_equal_models,
+        wait_convergence,
+        wait_to_finish,
+    )
+
+    if args.protocol == "grpc":
+        from p2pfl_tpu.comm.grpc.grpc_protocol import GrpcCommunicationProtocol
+
+        protocol = GrpcCommunicationProtocol
+        addr = lambda i: "127.0.0.1"  # noqa: E731 — random free port
+    else:
+        from p2pfl_tpu.comm.memory.memory_protocol import InMemoryCommunicationProtocol
+
+        protocol = InMemoryCommunicationProtocol
+        addr = lambda i: None  # noqa: E731
+
+    data = synthetic_mnist(
+        n_train=args.nodes * args.samples_per_node, n_test=512, seed=args.seed
+    )
+    parts = data.generate_partitions(args.nodes, RandomIIDPartitionStrategy)
+    nodes = [
+        Node(
+            mlp_model(seed=0),
+            parts[i],
+            addr=addr(i),
+            aggregator=_make_aggregator(args.aggregator),
+            batch_size=args.batch_size,
+        )
+        for i in range(args.nodes)
+    ]
+    for n in nodes:
+        n.start()
+    try:
+        matrix = TopologyFactory.generate_matrix(
+            TopologyType(args.topology), args.nodes
+        )
+        TopologyFactory.connect_nodes(matrix, nodes)
+        wait_convergence(nodes, args.nodes - 1, only_direct=False, wait=60)
+
+        nodes[0].set_start_learning(rounds=args.rounds, epochs=args.epochs)
+        wait_to_finish(nodes, timeout=3600)
+        check_equal_models(nodes)
+
+        accs = []
+        for n in nodes:
+            m = n.learner.evaluate()
+            if "test_acc" in m:
+                accs.append(m["test_acc"])
+        return {
+            "mode": "nodes",
+            "final_test_acc": float(np.mean(accs)) if accs else None,
+        }
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    prof = None
+    if args.profiling:
+        import cProfile
+
+        prof = cProfile.Profile()
+        prof.enable()
+
+    t0 = time.time()
+    result = run_mesh(args) if args.mode == "mesh" else run_nodes(args)
+    elapsed = time.time() - t0
+
+    if prof is not None:
+        import pathlib
+
+        prof.disable()
+        out = pathlib.Path("profile") / "mnist"
+        out.mkdir(parents=True, exist_ok=True)
+        path = out / f"{uuid.uuid4().hex}.pstat"
+        prof.dump_stats(str(path))
+        print(f"profile written to {path}", file=sys.stderr)
+
+    if args.measure_time:
+        result["total_elapsed_s"] = round(elapsed, 3)
+    print(result)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

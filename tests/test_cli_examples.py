@@ -1,0 +1,84 @@
+"""CLI + examples tests (reference has no CLI tests; example coverage via
+the e2e node tests — this adds direct coverage for the registry and both
+execution modes of the mnist example)."""
+
+from __future__ import annotations
+
+import pytest
+
+from p2pfl_tpu.cli import build_parser
+from p2pfl_tpu.examples import EXAMPLES
+from p2pfl_tpu.examples.mnist import build_parser as mnist_parser, run_mesh, run_nodes
+
+
+def test_examples_registry():
+    assert {"mnist", "node1", "node2"} <= set(EXAMPLES)
+
+
+def test_cli_parser_subcommands():
+    p = build_parser()
+    args = p.parse_args(["experiment", "run", "mnist", "--nodes", "2"])
+    assert args.command == "experiment" and args.name == "mnist"
+    assert args.extra == ["--nodes", "2"]
+    args = p.parse_args(["experiment", "list"])
+    assert args.action == "list"
+    for stub in ("login", "remote", "launch"):
+        assert build_parser().parse_args([stub]).command == stub
+
+
+def test_cli_experiment_list(capsys):
+    from p2pfl_tpu.cli import main
+
+    assert main(["experiment", "list"]) == 0
+    out = capsys.readouterr().out
+    assert "mnist" in out and "node1" in out
+
+
+def test_cli_unknown_experiment(capsys):
+    from p2pfl_tpu.cli import main
+
+    assert main(["experiment", "help", "nope"]) == 2
+
+
+@pytest.mark.parametrize("name", ["mnist", "node1", "node2"])
+def test_cli_help_subprocess_dispatch(name):
+    """`experiment help <name>` must exit cleanly for EVERY registered
+    example (the examples parse args before touching any jax backend)."""
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "-m", "p2pfl_tpu", "experiment", "help", name],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "usage:" in out.stdout
+
+
+def test_mnist_example_mesh_mode():
+    args = mnist_parser().parse_args(
+        ["--nodes", "4", "--rounds", "1", "--samples-per-node", "32", "--batch-size", "16"]
+    )
+    res = run_mesh(args)
+    assert res["mode"] == "mesh"
+    assert res["sec_per_round"] > 0
+
+
+@pytest.mark.parametrize("aggregator", ["fedavg", "fedmedian", "scaffold", "krum", "trimmed_mean"])
+def test_mnist_example_nodes_mode(aggregator):
+    args = mnist_parser().parse_args(
+        [
+            "--mode", "nodes",
+            "--nodes", "2",
+            "--rounds", "1",
+            "--samples-per-node", "48",
+            "--batch-size", "16",
+            "--topology", "full",
+            "--aggregator", aggregator,
+        ]
+    )
+    res = run_nodes(args)
+    assert res["mode"] == "nodes"
+    assert res["final_test_acc"] is not None

@@ -1,1 +1,13 @@
-"""Management: logging, metrics, monitoring, telemetry."""
+"""Management: logging, metrics, monitoring, telemetry, checkpointing."""
+
+__all__ = ["FLCheckpointer", "attach_node_checkpointing"]
+
+
+def __getattr__(name: str):
+    # Lazy: checkpoint.py imports orbax, which must not become an
+    # import-time dependency of the logger/Node/CLI paths.
+    if name in __all__:
+        from p2pfl_tpu.management import checkpoint
+
+        return getattr(checkpoint, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

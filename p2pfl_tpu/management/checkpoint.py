@@ -1,0 +1,172 @@
+"""Checkpoint / resume subsystem (orbax-backed).
+
+The reference has NO checkpointing — Lightning checkpoints are explicitly
+disabled (reference lightning_learner.py:66) and model state only survives
+inside the gossip protocol (SURVEY.md §5). This module is the TPU build's
+upgrade: async orbax snapshots of
+
+* a single :class:`~p2pfl_tpu.models.model_handle.ModelHandle` (federation
+  mode — one node's model + contributor metadata per round), and
+* an entire :class:`~p2pfl_tpu.parallel.simulation.MeshSimulation` population
+  (stacked params + optimizer state + round counter), restored with the
+  original shardings so a resumed run stays on-mesh.
+
+Orbax writes from device memory (no host staging of the whole tree at once)
+and keeps the last ``max_to_keep`` steps.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+Pytree = Any
+
+
+class FLCheckpointer:
+    """Round-indexed checkpoint store.
+
+    Args:
+        directory: checkpoint root (created if missing; made absolute —
+            orbax requires absolute paths).
+        max_to_keep: retained snapshots (oldest pruned).
+        save_interval: only save when ``round % save_interval == 0``.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: int = 3,
+        save_interval: int = 1,
+    ) -> None:
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.save_interval = max(1, int(save_interval))
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=True,
+            ),
+        )
+
+    # --- generic pytree + metadata ------------------------------------------
+
+    def save(self, step: int, state: Pytree, meta: Optional[Dict[str, Any]] = None) -> bool:
+        """Save ``state`` (pytree of arrays) + JSON-able ``meta`` at ``step``.
+
+        Returns False (and skips) when the step is off the save interval.
+        """
+        if step % self.save_interval != 0:
+            return False
+        self._mngr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state),
+                meta=ocp.args.JsonSave(meta or {}),
+            ),
+        )
+        return True
+
+    def restore(self, template: Pytree, step: Optional[int] = None):
+        """Restore (state, meta) at ``step`` (default: latest).
+
+        ``template`` supplies structure/shapes/shardings: device arrays in it
+        are restored onto their existing shardings (a resumed mesh run stays
+        sharded over the same mesh).
+        """
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        restored = self._mngr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(template),
+                meta=ocp.args.JsonRestore(),
+            ),
+        )
+
+        # Orbax restores some leaves (e.g. replicated scalars) onto a single
+        # device; re-place every array onto its template sharding so a
+        # resumed mesh computation sees consistent placements.
+        def replace(t, r):
+            if isinstance(t, jax.Array) and isinstance(r, (jax.Array, np.ndarray)):
+                return jax.device_put(r, t.sharding)
+            return r
+
+        state = jax.tree.map(replace, template, restored["state"])
+        return state, dict(restored["meta"] or {})
+
+    # --- ModelHandle convenience --------------------------------------------
+
+    def save_model(self, step: int, model) -> bool:
+        """Snapshot a ModelHandle: params + federation metadata."""
+        meta = {
+            "contributors": list(model.contributors),
+            "num_samples": int(model.num_samples),
+            "additional_info": _jsonable(model.additional_info),
+        }
+        return self.save(step, model.params, meta)
+
+    def restore_model(self, template_model, step: Optional[int] = None):
+        """Restore into a copy of ``template_model`` (same apply_fn/def)."""
+        params, meta = self.restore(template_model.params, step)
+        out = template_model.build_copy(params=params)
+        out.contributors = list(meta.get("contributors", []))
+        out.num_samples = int(meta.get("num_samples", 1))
+        out.additional_info = dict(meta.get("additional_info", {}))
+        return out
+
+    # --- bookkeeping ---------------------------------------------------------
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def all_steps(self) -> List[int]:
+        return list(self._mngr.all_steps())
+
+    def wait(self) -> None:
+        """Block until in-flight async saves land."""
+        self._mngr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mngr.wait_until_finished()
+        self._mngr.close()
+
+    def __enter__(self) -> "FLCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def attach_node_checkpointing(node, checkpointer: FLCheckpointer) -> None:
+    """Federation mode: snapshot the node's model at every round end.
+
+    Hooks the node's ``round_end_hooks`` (fired by RoundFinishedStage via
+    ``log_round_finished``); the saved step is the just-finished round.
+    """
+
+    def hook(n) -> None:
+        r = n.state.round
+        finished = (r - 1) if r is not None else 0
+        checkpointer.save_model(max(finished, 0), n.learner.get_model())
+
+    node.round_end_hooks.append(hook)
+
+
+def _jsonable(d: Dict[str, Any]) -> Dict[str, Any]:
+    """Drop/convert values JSON can't carry (arrays -> lists)."""
+    out: Dict[str, Any] = {}
+    for k, v in d.items():
+        if isinstance(v, np.generic):  # np.float32(..) etc. — not a Python float
+            out[k] = v.item()
+        elif isinstance(v, (np.ndarray, jax.Array)):
+            out[k] = np.asarray(v).tolist()
+        elif isinstance(v, (str, int, float, bool, list, dict, type(None))):
+            out[k] = v
+    return out

@@ -81,6 +81,9 @@ class Node:
         self._workflow: Optional[LearningWorkflow] = None
         self._learning_thread: Optional[threading.Thread] = None
         self._running = False
+        # Fired (with this node) after each round completes; used by e.g.
+        # checkpoint.attach_node_checkpointing.
+        self.round_end_hooks: List = []
 
         # Register the command handlers (reference node.py:121-134).
         self.protocol.add_command(
@@ -233,3 +236,8 @@ class Node:
     def log_round_finished(self) -> None:
         r = self.state.round
         logger.round_finished_info(self.addr, (r - 1) if r is not None else -1)
+        for hook in self.round_end_hooks:
+            try:
+                hook(self)
+            except Exception as e:  # a failing hook must not kill the round loop
+                logger.warning(self.addr, f"round_end_hook failed: {e!r}")

@@ -186,7 +186,27 @@ class MeshSimulation:
             )
 
         self.params_stack = broadcast_population(template)
-        self.opt_stack = jax.jit(jax.vmap(self.optimizer.init))(self.params_stack)
+
+        # Optimizer state gets explicit shardings too, mirroring the param
+        # layout: leading-N leaves over ``nodes``, param-shaped moments also
+        # TP-sharded on their output dim, everything else replicated.
+        # Without out_shardings XLA may commit small leaves (e.g. adam's
+        # count) to one device, which later conflicts with checkpoint-
+        # restored placements.
+        def opt_sharding(x) -> NamedSharding:
+            spec = [None] * x.ndim
+            if x.ndim >= 1 and x.shape[0] == n and n % self.mesh.shape["nodes"] == 0:
+                spec[0] = "nodes"
+            tp = self.mesh.shape.get("model", 1)
+            if tp > 1 and x.ndim >= 3 and x.shape[-1] % tp == 0:
+                spec[-1] = "model"  # param-shaped moments follow the kernels
+            return NamedSharding(self.mesh, P(*spec))
+
+        opt_shapes = jax.eval_shape(jax.vmap(self.optimizer.init), self.params_stack)
+        opt_shardings = jax.tree.map(opt_sharding, opt_shapes)
+        self.opt_stack = jax.jit(
+            jax.vmap(self.optimizer.init), out_shardings=opt_shardings
+        )(self.params_stack)
 
         def shard_stacked(x) -> jax.Array:
             spec = P("nodes") if x.shape[0] % self.mesh.shape["nodes"] == 0 else P()
@@ -198,6 +218,11 @@ class MeshSimulation:
         self.num_samples = jnp.sum(jnp.asarray(self.sample_mask), axis=1)  # [N]
 
         self._round_history: List[Dict[str, float]] = []
+        # Rounds already executed (advanced by run(); restored by
+        # load_from()). Round r's RNG key is fold_in(base, r), so resuming
+        # from a checkpoint replays the exact key sequence regardless of how
+        # rounds are chunked into compiled calls.
+        self.completed_rounds = 0
 
     # --- jitted round body ---------------------------------------------------
 
@@ -274,8 +299,13 @@ class MeshSimulation:
         return (params_stack, opt_stack), (committee, losses.mean(), loss, acc)
 
     @partial(jax.jit, static_argnames=("self", "rounds", "epochs"))
-    def _run_jit(self, params_stack, opt_stack, data, key, *, rounds: int, epochs: int):
-        keys = jax.random.split(key, rounds)
+    def _run_jit(self, params_stack, opt_stack, data, start_round, *, rounds: int, epochs: int):
+        # Per-round keys are position-independent (fold_in on the absolute
+        # round index): chunking and checkpoint-resume replay identically.
+        base = jax.random.key(self.seed)
+        keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+            start_round + jnp.arange(rounds)
+        )
         (params_stack, opt_stack), (committees, train_loss, test_loss, test_acc) = jax.lax.scan(
             lambda c, k: self._round_body(c, k, data, epochs), (params_stack, opt_stack), keys
         )
@@ -289,6 +319,8 @@ class MeshSimulation:
         epochs: int = 1,
         warmup: bool = True,
         rounds_per_call: int = 1,
+        checkpointer=None,
+        checkpoint_every: int = 1,
     ) -> SimulationResult:
         """Execute ``rounds`` federated rounds on the mesh.
 
@@ -300,20 +332,26 @@ class MeshSimulation:
 
         With ``warmup`` (default) one extra call triggers XLA compilation
         before timing, so the timed run measures steady-state throughput.
+
+        With a ``checkpointer`` (:class:`~p2pfl_tpu.management.checkpoint.
+        FLCheckpointer`), population state is snapshotted every
+        ``checkpoint_every`` completed chunks; a later ``load_from`` +
+        ``run`` resumes bit-identically (round keys are absolute-indexed).
         """
         xt = jnp.asarray(self.x_test) if self.x_test is not None else None
         yt = jnp.asarray(self.y_test) if self.y_test is not None else None
         data = (self.x, self.y, self.sample_mask, self.num_samples, xt, yt)
         rounds_per_call = max(1, min(rounds_per_call, rounds))
+        checkpoint_every = max(1, int(checkpoint_every))
         # Full chunks + a remainder chunk so exactly `rounds` rounds execute.
         chunks = [rounds_per_call] * (rounds // rounds_per_call)
         if rounds % rounds_per_call:
             chunks.append(rounds % rounds_per_call)
-        keys = list(jax.random.split(jax.random.key(self.seed), len(chunks)))
+        start = self.completed_rounds
 
         if warmup:
             out = self._run_jit(
-                self.params_stack, self.opt_stack, data, keys[0],
+                self.params_stack, self.opt_stack, data, jnp.int32(start),
                 rounds=chunks[0], epochs=epochs,
             )
             jax.block_until_ready(out[0])
@@ -321,18 +359,30 @@ class MeshSimulation:
         params_stack, opt_stack = self.params_stack, self.opt_stack
         committees, test_loss, test_acc = [], [], []
         t0 = time.monotonic()
-        for key, chunk in zip(keys, chunks):
+        done = 0
+        for i, chunk in enumerate(chunks):
             params_stack, opt_stack, comm, _tr, tl, ta = self._run_jit(
-                params_stack, opt_stack, data, key, rounds=chunk, epochs=epochs
+                params_stack, opt_stack, data, jnp.int32(start + done),
+                rounds=chunk, epochs=epochs,
             )
             committees.append(comm)
             test_loss.append(tl)
             test_acc.append(ta)
+            done += chunk
+            # Save on the cadence, and always after the final chunk so the
+            # end-of-run state is never memory-only.
+            if checkpointer is not None and (
+                (i + 1) % checkpoint_every == 0 or i == len(chunks) - 1
+            ):
+                self.params_stack, self.opt_stack = params_stack, opt_stack
+                self.completed_rounds = start + done
+                self.save_to(checkpointer)
         jax.block_until_ready(params_stack)
         dt = time.monotonic() - t0
         total_rounds = sum(chunks)
 
         self.params_stack, self.opt_stack = params_stack, opt_stack
+        self.completed_rounds = start + total_rounds
         return SimulationResult(
             rounds=total_rounds,
             seconds_total=dt,
@@ -346,6 +396,36 @@ class MeshSimulation:
         """Extract one node's model (they're all equal after diffusion)."""
         params = jax.tree.map(lambda a: a[node], self.params_stack)
         return self.model.build_copy(params=params)
+
+    # --- checkpoint / resume -------------------------------------------------
+
+    def state_dict(self) -> Pytree:
+        """Checkpointable population state (device arrays, shardings kept)."""
+        return {"params_stack": self.params_stack, "opt_stack": self.opt_stack}
+
+    def save_to(self, checkpointer) -> bool:
+        """Snapshot population state at the current completed-round count."""
+        return checkpointer.save(
+            self.completed_rounds,
+            self.state_dict(),
+            {"completed_rounds": self.completed_rounds, "seed": self.seed},
+        )
+
+    def load_from(self, checkpointer, step: Optional[int] = None) -> int:
+        """Restore population state (latest step by default) onto the
+        existing shardings; returns the restored round count.
+
+        The checkpointed RNG seed is adopted too — round keys are
+        ``fold_in(key(seed), round)``, so resuming under a different seed
+        would silently diverge from the original run's key sequence.
+        """
+        state, meta = checkpointer.restore(self.state_dict(), step)
+        self.params_stack = state["params_stack"]
+        self.opt_stack = state["opt_stack"]
+        self.completed_rounds = int(meta.get("completed_rounds", 0))
+        if "seed" in meta and int(meta["seed"]) != self.seed:
+            self.seed = int(meta["seed"])
+        return self.completed_rounds
 
 
 def _stack_partitions(

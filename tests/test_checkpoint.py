@@ -1,0 +1,185 @@
+"""Checkpoint/resume tests.
+
+The reference has no checkpointing (SURVEY.md §5 — Lightning checkpoints
+disabled, lightning_learner.py:66); this subsystem is the TPU build's
+upgrade, so these tests define its contract: round-trip fidelity, retention,
+bit-identical simulation resume, and federation-mode per-round snapshots.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+from p2pfl_tpu.management.checkpoint import FLCheckpointer, attach_node_checkpointing
+from p2pfl_tpu.models import mlp_model
+from p2pfl_tpu.parallel.simulation import MeshSimulation
+
+
+@pytest.fixture
+def parts8():
+    data = synthetic_mnist(n_train=8 * 32, n_test=64)
+    return data.generate_partitions(8, RandomIIDPartitionStrategy)
+
+
+def _trees_equal(a, b):
+    import jax
+
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_model_roundtrip(tmp_path):
+    model = mlp_model(seed=3)
+    model.contributors = ["a", "b"]
+    model.num_samples = 17
+    model.additional_info = {"tag": "x", "vec": np.arange(3.0)}
+    with FLCheckpointer(str(tmp_path / "ck")) as ck:
+        assert ck.save_model(0, model)
+        ck.wait()
+        restored = ck.restore_model(mlp_model(seed=0))
+    _trees_equal(restored.params, model.params)
+    assert restored.contributors == ["a", "b"]
+    assert restored.num_samples == 17
+    assert restored.additional_info["tag"] == "x"
+    assert restored.additional_info["vec"] == [0.0, 1.0, 2.0]
+
+
+def test_retention_and_interval(tmp_path):
+    model = mlp_model(seed=0)
+    with FLCheckpointer(str(tmp_path / "ck"), max_to_keep=2, save_interval=2) as ck:
+        for step in range(5):
+            saved = ck.save_model(step, model)
+            assert saved == (step % 2 == 0)
+        ck.wait()
+        assert ck.latest_step() == 4
+        assert len(ck.all_steps()) <= 2
+
+
+def test_restore_missing_raises(tmp_path):
+    with FLCheckpointer(str(tmp_path / "empty")) as ck:
+        with pytest.raises(FileNotFoundError):
+            ck.restore_model(mlp_model(seed=0))
+
+
+def test_simulation_resume_bit_identical(tmp_path, parts8):
+    """4 straight rounds == 2 rounds + checkpoint + restore + 2 rounds."""
+    kw = dict(train_set_size=4, batch_size=16, seed=5)
+
+    sim_full = MeshSimulation(mlp_model(seed=0), parts8, **kw)
+    res_full = sim_full.run(rounds=4, epochs=1, warmup=False)
+
+    sim_a = MeshSimulation(mlp_model(seed=0), parts8, **kw)
+    sim_a.run(rounds=2, epochs=1, warmup=False)
+    with FLCheckpointer(str(tmp_path / "sim")) as ck:
+        sim_a.save_to(ck)
+        ck.wait()
+
+        sim_b = MeshSimulation(mlp_model(seed=0), parts8, **kw)
+        assert sim_b.load_from(ck) == 2
+    res_b = sim_b.run(rounds=2, epochs=1, warmup=False)
+
+    _trees_equal(sim_full.params_stack, sim_b.params_stack)
+    assert res_full.test_acc[2:] == pytest.approx(res_b.test_acc, abs=1e-6)
+    assert sim_b.completed_rounds == 4
+
+
+def test_simulation_run_with_checkpointer(tmp_path, parts8):
+    sim = MeshSimulation(mlp_model(seed=0), parts8, train_set_size=4, batch_size=16, seed=1)
+    with FLCheckpointer(str(tmp_path / "auto")) as ck:
+        sim.run(rounds=3, epochs=1, warmup=False, checkpointer=ck)
+        ck.wait()
+        assert ck.latest_step() == 3
+        assert len(ck.all_steps()) >= 1
+
+
+def test_simulation_final_round_always_saved(tmp_path, parts8):
+    """Off-cadence final chunk still lands on disk (and checkpoint_every=0
+    must not crash — it's clamped)."""
+    sim = MeshSimulation(mlp_model(seed=0), parts8, train_set_size=4, batch_size=16, seed=1)
+    with FLCheckpointer(str(tmp_path / "cad")) as ck:
+        sim.run(rounds=3, epochs=1, warmup=False, checkpointer=ck, checkpoint_every=2)
+        ck.wait()
+        assert ck.latest_step() == 3  # 2 (cadence) and 3 (final)
+    sim2 = MeshSimulation(mlp_model(seed=0), parts8, train_set_size=4, batch_size=16, seed=1)
+    with FLCheckpointer(str(tmp_path / "zero")) as ck:
+        sim2.run(rounds=2, epochs=1, warmup=False, checkpointer=ck, checkpoint_every=0)
+        ck.wait()
+        assert ck.latest_step() == 2
+
+
+def test_simulation_resume_adopts_checkpoint_seed(tmp_path, parts8):
+    """Resuming under a different constructor seed must not diverge: the
+    checkpointed seed wins (round keys are fold_in(key(seed), round))."""
+    kw = dict(train_set_size=4, batch_size=16)
+    sim_full = MeshSimulation(mlp_model(seed=0), parts8, seed=5, **kw)
+    sim_full.run(rounds=3, epochs=1, warmup=False)
+
+    sim_a = MeshSimulation(mlp_model(seed=0), parts8, seed=5, **kw)
+    sim_a.run(rounds=1, epochs=1, warmup=False)
+    with FLCheckpointer(str(tmp_path / "seed")) as ck:
+        sim_a.save_to(ck)
+        ck.wait()
+        sim_b = MeshSimulation(mlp_model(seed=0), parts8, seed=999, **kw)
+        sim_b.load_from(ck)
+    assert sim_b.seed == 5
+    sim_b.run(rounds=2, epochs=1, warmup=False)
+    _trees_equal(sim_full.params_stack, sim_b.params_stack)
+
+
+def test_jsonable_numpy_scalars(tmp_path):
+    model = mlp_model(seed=0)
+    model.additional_info = {"acc": np.float32(0.91), "n": np.int64(7)}
+    with FLCheckpointer(str(tmp_path / "scal")) as ck:
+        ck.save_model(0, model)
+        ck.wait()
+        restored = ck.restore_model(mlp_model(seed=0))
+    assert restored.additional_info["acc"] == pytest.approx(0.91)
+    assert restored.additional_info["n"] == 7
+
+
+def test_orbax_not_imported_by_core():
+    """Core import paths (Node/logger/CLI) must not pull in orbax."""
+    import subprocess
+    import sys
+
+    code = (
+        "import sys\n"
+        "import p2pfl_tpu.node, p2pfl_tpu.cli, p2pfl_tpu.management\n"
+        "assert not any(m.startswith('orbax') for m in sys.modules), 'orbax imported'\n"
+        "print('ok')\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, timeout=120
+    )
+    assert out.returncode == 0, out.stderr
+
+
+def test_node_round_end_checkpointing(tmp_path):
+    from p2pfl_tpu.node import Node
+    from p2pfl_tpu.utils.utils import wait_convergence, wait_to_finish
+
+    parts = synthetic_mnist(n_train=256, n_test=64).generate_partitions(
+        2, RandomIIDPartitionStrategy
+    )
+    nodes = [Node(mlp_model(seed=i), parts[i], batch_size=16) for i in range(2)]
+    with FLCheckpointer(str(tmp_path / "node0"), max_to_keep=5) as ck:
+        attach_node_checkpointing(nodes[0], ck)
+        for n in nodes:
+            n.start()
+        try:
+            nodes[1].connect(nodes[0].addr)
+            wait_convergence(nodes, 1, wait=10)
+            nodes[0].set_start_learning(rounds=2, epochs=1)
+            wait_to_finish(nodes, timeout=120)
+        finally:
+            for n in nodes:
+                n.stop()
+        ck.wait()
+        steps = ck.all_steps()
+        assert len(steps) >= 2  # one snapshot per finished round
+        restored = ck.restore_model(mlp_model(seed=0))
+    _trees_equal(restored.params, nodes[0].learner.get_model().params)

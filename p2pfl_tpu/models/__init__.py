@@ -4,5 +4,25 @@ from p2pfl_tpu.models.model_handle import ModelHandle  # noqa: F401
 from p2pfl_tpu.models.mlp import MLP, mlp_model  # noqa: F401
 from p2pfl_tpu.models.cnn import CNN, cnn_model  # noqa: F401
 from p2pfl_tpu.models.resnet import ResNet18, resnet18_model  # noqa: F401
+from p2pfl_tpu.models.transformer import (  # noqa: F401
+    TransformerClassifier,
+    TransformerLM,
+    causal_lm_loss,
+    transformer_classifier_model,
+    transformer_lm_model,
+)
 
-__all__ = ["ModelHandle", "MLP", "mlp_model", "CNN", "cnn_model", "ResNet18", "resnet18_model"]
+__all__ = [
+    "ModelHandle",
+    "MLP",
+    "mlp_model",
+    "CNN",
+    "cnn_model",
+    "ResNet18",
+    "resnet18_model",
+    "TransformerLM",
+    "TransformerClassifier",
+    "transformer_lm_model",
+    "transformer_classifier_model",
+    "causal_lm_loss",
+]

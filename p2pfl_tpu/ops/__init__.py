@@ -1,1 +1,9 @@
-"""Compute and wire-format primitives: serialization, aggregation kernels."""
+"""Compute and wire-format primitives: serialization, aggregation kernels,
+attention (blockwise / Pallas flash / ring)."""
+
+from p2pfl_tpu.ops.attention import (  # noqa: F401
+    blockwise_attention,
+    dense_attention,
+    flash_attention,
+)
+from p2pfl_tpu.ops.ring_attention import ring_attention  # noqa: F401

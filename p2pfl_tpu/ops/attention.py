@@ -1,0 +1,320 @@
+"""Attention kernels: dense reference, blockwise (online-softmax), and a
+Pallas TPU flash-attention kernel.
+
+The reference framework has no attention anywhere (models are MNIST MLPs,
+flax_model.py:171-195) — long-context support is green-field TPU capability
+for this framework. Design:
+
+* ``dense_attention`` — O(S^2) memory reference implementation; ground truth
+  for tests and fine for short sequences.
+* ``blockwise_attention`` — FlashAttention-style online softmax as a pure JAX
+  ``lax.scan`` over key/value blocks: O(S) memory, differentiable, XLA fuses
+  the inner matmuls onto the MXU. Used as the per-chunk compute of ring
+  attention (:mod:`p2pfl_tpu.ops.ring_attention`) and as the autodiff
+  backward for the Pallas forward.
+* ``flash_attention`` — Pallas kernel (grid over [batch, head, q-block],
+  ``fori_loop`` over k-blocks with m/l/acc accumulators in VMEM); forward on
+  the MXU in the input dtype with float32 accumulation. Backward is a
+  rematerialized blockwise pass via ``jax.custom_vjp`` (standard
+  flash-attention practice: recompute instead of storing S^2 probabilities).
+
+All functions take ``[batch, seq, heads, head_dim]`` ("BSHD") tensors and an
+optional additive position offset pair so callers (ring attention) can apply
+*global* causal masks to *local* sequence shards.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on CPU builds too; guard for safety
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _causal_mask(
+    scores: jax.Array, q_offset: jax.Array | int, kv_offset: jax.Array | int
+) -> jax.Array:
+    """Mask ``scores [..., Sq, Sk]`` where global q position < kv position."""
+    sq, sk = scores.shape[-2], scores.shape[-1]
+    q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+    k_pos = kv_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+    return jnp.where(q_pos >= k_pos, scores, DEFAULT_MASK_VALUE)
+
+
+def dense_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    q_offset: jax.Array | int = 0,
+    kv_offset: jax.Array | int = 0,
+) -> jax.Array:
+    """Materialized-softmax attention (reference implementation).
+
+    Args:
+        q: ``[B, Sq, H, D]``; k/v: ``[B, Sk, H, D]``.
+        causal: apply a causal mask over *global* positions.
+        q_offset / kv_offset: global position of the first row of q / k.
+    """
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        s = _causal_mask(s, q_offset, kv_offset)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v).astype(q.dtype)
+
+
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_k: int = 512,
+    q_offset: jax.Array | int = 0,
+    kv_offset: jax.Array | int = 0,
+) -> jax.Array:
+    """Online-softmax attention: ``lax.scan`` over key/value blocks.
+
+    Never materializes the ``[Sq, Sk]`` score matrix for more than one key
+    block, so activation memory is O(Sq * block_k). Fully differentiable
+    (the scan's VJP rematerializes per-block).
+    """
+    m, l, acc = init_carry(q.shape)
+    m, l, acc = blockwise_update(
+        (m, l, acc), q, k, v, causal=causal, block_k=block_k,
+        q_offset=q_offset, kv_offset=kv_offset,
+    )
+    return finalize_carry((m, l, acc), q.dtype)
+
+
+def init_carry(q_shape: tuple) -> tuple:
+    """Fresh online-softmax carry for queries of shape ``[B, Sq, H, D]``:
+    running row max ``m [B, H, Sq]``, denominator ``l [B, H, Sq]``, and
+    unnormalized output ``acc [B, Sq, H, D]`` (all float32)."""
+    b, sq, h, d = q_shape
+    m = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, h, sq), jnp.float32)
+    acc = jnp.zeros((b, sq, h, d), jnp.float32)
+    return m, l, acc
+
+
+def finalize_carry(carry: tuple, dtype) -> jax.Array:
+    """Normalize an online-softmax carry into the attention output."""
+    m, l, acc = carry
+    l_safe = jnp.einsum("bhq->bqh", jnp.maximum(l, 1e-30))[..., None]
+    return (acc / l_safe).astype(dtype)
+
+
+def blockwise_update(
+    carry: tuple,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool,
+    block_k: int,
+    q_offset: jax.Array | int,
+    kv_offset: jax.Array | int,
+) -> tuple:
+    """Fold one key/value chunk into an online-softmax carry, blockwise.
+
+    Ring attention chains this across rotating kv chunks (each with its own
+    global ``kv_offset``); :func:`blockwise_attention` calls it once.
+    """
+    m, l, acc = carry
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_k = min(block_k, sk)
+    num_blocks = sk // block_k
+    rem = sk - num_blocks * block_k
+    scale = 1.0 / math.sqrt(d)
+
+    qf = q.astype(jnp.float32) * scale
+
+    def step(carry, inputs):
+        m, l, acc = carry
+        kb, vb, k_off = inputs
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kb.astype(jnp.float32))
+        if causal:
+            s = _causal_mask(s, q_offset, k_off)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = corr * l + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, vb.astype(jnp.float32))
+        acc = jnp.einsum("bhq->bqh", corr)[..., None] * acc + pv
+        return (m_new, l, acc), None
+
+    if num_blocks:
+        kb = k[:, : num_blocks * block_k].reshape(b, num_blocks, block_k, h, d)
+        vb = v[:, : num_blocks * block_k].reshape(b, num_blocks, block_k, h, d)
+        offs = kv_offset + jnp.arange(num_blocks, dtype=jnp.int32) * block_k
+        (m, l, acc), _ = jax.lax.scan(
+            step,
+            (m, l, acc),
+            (jnp.moveaxis(kb, 1, 0), jnp.moveaxis(vb, 1, 0), offs),
+        )
+    if rem:  # tail block (static shape — rem is a Python int)
+        (m, l, acc), _ = step(
+            (m, l, acc),
+            (k[:, -rem:], v[:, -rem:], kv_offset + num_blocks * block_k),
+        )
+    return m, l, acc
+
+
+# --- Pallas flash attention ---------------------------------------------------
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, causal: bool
+):
+    """One (batch, head, q-block, k-block) program.
+
+    The k-block axis is the innermost grid dimension — on TPU the grid runs
+    sequentially, so the online-softmax statistics for the current q block
+    persist in VMEM scratch across its k-block programs. Only one
+    ``[block_q, d]`` q tile and one ``[block_k, d]`` k/v tile are resident
+    at a time: VMEM stays O(block) regardless of sequence length.
+    """
+    q = q_ref[0, 0].astype(jnp.float32)  # [block_q, d]
+    block_q, d = q.shape
+    block_k = k_ref.shape[2]
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _fold():
+        kb = k_ref[0, 0].astype(jnp.float32)
+        vb = v_ref[0, 0].astype(jnp.float32)
+        s = jnp.dot(q * (1.0 / math.sqrt(d)), kb.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+        # m/l scratch carry the per-row stats broadcast across the 128-lane
+        # minor dim (TPU-friendly tile shape); column 0 is authoritative.
+        m = m_ref[:, :1]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(
+            corr * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
+        )
+        acc_ref[:] = corr * acc_ref[:] + jnp.dot(p, vb, preferred_element_type=jnp.float32)
+
+    if causal:
+        # Skip k blocks that lie entirely in this q block's future.
+        pl.when(k_start < q_start + block_q)(_fold)
+    else:
+        _fold()
+
+    @pl.when(ki == pl.num_programs(3) - 1)
+    def _finalize():
+        o_ref[0, 0] = (
+            acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def _pick_block(n: int, target: int) -> int:
+    """Largest divisor of ``n`` that is <= ``target`` (so odd sequence
+    lengths degrade gracefully instead of erroring)."""
+    b = min(n, target)
+    while n % b:
+        b -= 1
+    return b
+
+
+def _flash_forward(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+) -> jax.Array:
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
+    # kernel layout [B, H, S, D]
+    qt = jnp.moveaxis(q, 2, 1)
+    kt = jnp.moveaxis(k, 2, 1)
+    vt = jnp.moveaxis(v, 2, 1)
+    kernel = functools.partial(_flash_kernel, causal=causal)
+    grid = (b, h, sq // block_q, sk // block_k)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max m (lane-bcast)
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running denom l (lane-bcast)
+            pltpu.VMEM((block_q, d), jnp.float32),  # unnormalized acc
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return jnp.moveaxis(out, 1, 2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Pallas TPU flash attention over ``[B, S, H, D]`` tensors.
+
+    On non-TPU backends (tests run on a virtual CPU mesh) the kernel runs in
+    Pallas interpret mode automatically. Backward rematerializes through
+    :func:`blockwise_attention` (no S^2 residuals).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal, block_k=block_k),
+        q,
+        k,
+        v,
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -1,0 +1,89 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+Long-context support is green-field for this framework (the reference has no
+attention or sequence dimension anywhere — SURVEY.md §5 "Long-context ...
+absent"); this is the TPU-native design: the sequence axis is sharded over a
+mesh axis, each device holds a ``[B, S/n, H, D]`` shard of q/k/v, and key/value
+chunks rotate around the ring with ``jax.lax.ppermute`` (which XLA lowers to
+ICI neighbor exchanges) while each device folds the visiting chunk into its
+queries' online-softmax carry (:func:`p2pfl_tpu.ops.attention.blockwise_update`).
+
+After ``n`` steps every query has attended to every key — exact attention,
+O(S/n) memory per device, with communication overlappable against the chunk
+matmuls (XLA schedules the ppermute DMA concurrently with compute since the
+next step's matmul doesn't depend on it until the fold).
+
+Causal masking is *global*: chunk origins ride along the ring so each fold
+masks by absolute positions. Fully-masked (future) chunks contribute exactly
+zero to the carry (see the finite mask-value analysis in ops/attention.py).
+
+The functions here are ``shard_map`` collectives — call them inside
+``jax.shard_map`` with the sequence axis mapped (see
+:func:`p2pfl_tpu.parallel.sequence.sequence_parallel_attention` for the
+wrapped convenience form).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from p2pfl_tpu.ops.attention import blockwise_update, finalize_carry, init_carry
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = True,
+    block_k: int = 512,
+) -> jax.Array:
+    """Exact attention over a sequence sharded on ``axis_name``.
+
+    Must be called inside ``shard_map`` (or an equivalent SPMD context) with
+    ``q/k/v`` of local shape ``[B, S_local, H, D]``, the global sequence laid
+    out contiguously along the axis (device ``i`` holds positions
+    ``[i*S_local, (i+1)*S_local)``).
+
+    Args:
+        axis_name: mesh axis the sequence is sharded over.
+        causal: apply a global causal mask.
+        block_k: key-block size of the per-chunk blockwise fold.
+
+    Returns:
+        Local output shard ``[B, S_local, H, D]``.
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[1]
+    q_offset = idx * s_local
+
+    # Each device sends its current kv chunk to its left neighbor, so chunk
+    # origins visit in order idx, idx+1, ..., wrapping — the diagonal
+    # (self) chunk is folded first, which keeps the online-softmax carry
+    # well-conditioned under causal masking (every row sees a real key in
+    # step 0).
+    perm = [(i, (i - 1) % n) for i in range(n)]
+
+    def step(carry, _):
+        (m, l, acc), kc, vc, origin = carry
+        m, l, acc = blockwise_update(
+            (m, l, acc), q, kc, vc,
+            causal=causal, block_k=block_k,
+            q_offset=q_offset, kv_offset=origin * s_local,
+        )
+        kc, vc, origin = jax.lax.ppermute((kc, vc, origin), axis_name, perm)
+        return ((m, l, acc), kc, vc, origin), None
+
+    # The fresh carry is device-invariant; mark it varying over the ring axis
+    # so the scan's carry types line up under shard_map's vma checking.
+    carry0 = (
+        jax.tree.map(
+            lambda x: jax.lax.pcast(x, (axis_name,), to="varying"), init_carry(q.shape)
+        ),
+        k,
+        v,
+        idx,
+    )
+    (carry, _, _, _), _ = jax.lax.scan(step, carry0, None, length=n)
+    return finalize_carry(carry, q.dtype)

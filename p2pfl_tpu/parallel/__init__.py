@@ -1,4 +1,11 @@
-"""Mesh-scale execution: sharded population simulation, mesh helpers."""
+"""Mesh-scale execution: sharded population simulation, mesh helpers,
+sequence/context parallelism."""
 
 from p2pfl_tpu.parallel.mesh import make_mesh  # noqa: F401
 from p2pfl_tpu.parallel.simulation import MeshSimulation  # noqa: F401
+from p2pfl_tpu.parallel.sequence import (  # noqa: F401
+    make_sequence_parallel_train_step,
+    sequence_parallel_apply,
+    sequence_parallel_attention,
+    sequence_parallel_lm_loss,
+)

@@ -1,0 +1,145 @@
+"""Sequence/context parallelism: run a model over sequences sharded across a
+mesh axis.
+
+Green-field TPU capability (SURVEY.md §5: the reference has no sequence
+dimension at all). The design follows the scaling-book recipe: pick a mesh,
+map the sequence axis, and let the only cross-position op — attention — ride
+the ring (:mod:`p2pfl_tpu.ops.ring_attention`). Everything else in the
+transformer is per-position, so the same flax module runs unmodified inside
+``shard_map``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Pytree = Any
+
+
+def sequence_parallel_attention(
+    mesh: Mesh,
+    seq_axis: str = "seq",
+    causal: bool = True,
+    block_k: int = 512,
+) -> Callable:
+    """Return ``f(q, k, v) -> out`` computing exact attention with
+    ``[B, S, H, D]`` inputs sharded over ``seq_axis`` on dim 1."""
+    from p2pfl_tpu.ops.ring_attention import ring_attention
+
+    spec = P(None, seq_axis, None, None)
+    return jax.shard_map(
+        partial(ring_attention, axis_name=seq_axis, causal=causal, block_k=block_k),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+
+
+def sequence_parallel_apply(
+    model_apply: Callable,
+    mesh: Mesh,
+    seq_axis: str = "seq",
+    batch_axis: Optional[str] = None,
+) -> Callable:
+    """Wrap ``model_apply(params, tokens) -> logits`` in a ``shard_map`` that
+    shards tokens/logits over ``seq_axis`` (and optionally batch over
+    ``batch_axis``); params replicated.
+
+    The model must use ``attention_kind='ring'`` with ``axis_name=seq_axis``
+    (e.g. :class:`~p2pfl_tpu.models.transformer.TransformerLM`).
+    """
+    tok_spec = P(batch_axis, seq_axis)
+    out_spec = P(batch_axis, seq_axis, None)
+    return jax.shard_map(
+        model_apply,
+        mesh=mesh,
+        in_specs=(P(), tok_spec),
+        out_specs=out_spec,
+        check_vma=False,
+    )
+
+
+def sequence_parallel_lm_loss(
+    model_apply: Callable,
+    mesh: Mesh,
+    seq_axis: str = "seq",
+    batch_axis: Optional[str] = None,
+) -> Callable:
+    """Return ``loss_fn(params, tokens) -> scalar`` — next-token cross
+    entropy computed under sequence parallelism.
+
+    The shift-by-one crossing between sequence shards is handled by rolling
+    the *targets* left around the ring (ppermute), so no shard ever needs its
+    neighbor's logits: shard ``i`` scores positions ``[i*S, (i+1)*S)`` against
+    targets ``[i*S+1, (i+1)*S+1)``; the final global position is masked.
+    """
+
+    def local_loss(params: Pytree, tokens: jax.Array) -> jax.Array:
+        n = jax.lax.psum(1, seq_axis)
+        idx = jax.lax.axis_index(seq_axis)
+        logits = model_apply(params, tokens)  # [B, S_loc, V]
+        s_loc = tokens.shape[1]
+        # targets: tokens shifted left by one across the ring
+        first_of_next = jax.lax.ppermute(
+            tokens[:, :1], seq_axis, [(i, (i - 1) % n) for i in range(n)]
+        )
+        targets = jnp.concatenate([tokens[:, 1:], first_of_next], axis=1)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, targets.astype(jnp.int32)[..., None], axis=-1
+        )[..., 0]
+        # mask the global last position (its "target" wrapped around)
+        pos = idx * s_loc + jnp.arange(s_loc)[None, :]
+        total = n * s_loc
+        mask = (pos < total - 1).astype(jnp.float32)  # [1, S_loc], broadcasts
+        loss_sum = jax.lax.psum(jnp.sum(nll * mask), seq_axis)
+        count = jax.lax.psum(nll.shape[0] * jnp.sum(mask), seq_axis)
+        if batch_axis is not None:
+            loss_sum = jax.lax.psum(loss_sum, batch_axis)
+            count = jax.lax.psum(count, batch_axis)
+        return loss_sum / jnp.maximum(count, 1.0)
+
+    tok_spec = P(batch_axis, seq_axis)
+    return jax.shard_map(
+        local_loss,
+        mesh=mesh,
+        in_specs=(P(), tok_spec),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+
+def make_sequence_parallel_train_step(
+    model_apply: Callable,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    seq_axis: str = "seq",
+    batch_axis: Optional[str] = None,
+) -> Callable:
+    """Jitted LM train step under sequence parallelism.
+
+    Returns ``step(params, opt_state, tokens) -> (params, opt_state, loss)``
+    with tokens sharded over ``seq_axis`` (dim 1) and params replicated.
+    """
+    loss_fn = sequence_parallel_lm_loss(model_apply, mesh, seq_axis, batch_axis)
+
+    @jax.jit
+    def step(params: Pytree, opt_state: Pytree, tokens: jax.Array):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return step
+
+
+def shard_tokens(tokens, mesh: Mesh, seq_axis: str = "seq", batch_axis=None):
+    """Place a ``[B, S]`` token batch with S sharded over ``seq_axis``."""
+    return jax.device_put(
+        tokens, NamedSharding(mesh, P(batch_axis, seq_axis))
+    )

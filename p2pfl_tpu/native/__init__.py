@@ -1,0 +1,112 @@
+"""Native (C++) runtime components, loaded through ctypes.
+
+The reference is pure Python (SURVEY.md §2: "no C++/Rust/CUDA components");
+this framework keeps the TPU compute path in JAX/XLA/Pallas and implements
+the host runtime hot spots natively. Currently: the PFLT wire-codec
+(framing + aligned copies + CRC32) used by every weights gossip message.
+
+The library is compiled on first use with the in-image ``g++`` (pybind11
+isn't available, so the ABI is a C ``extern`` surface via ctypes). If
+compilation fails — or ``P2PFL_TPU_NO_NATIVE=1`` — callers transparently
+fall back to the pure-Python implementations, which produce byte-identical
+output.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+log = logging.getLogger("p2pfl_tpu")
+
+_DIR = Path(__file__).resolve().parent
+_SRC = _DIR / "pflt_codec.cpp"
+_LIB = _DIR / "_libpflt.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _compile() -> bool:
+    # Link into a process-unique temp path, then atomically rename into
+    # place: concurrent cold-start processes (e.g. the node1/node2
+    # quickstart) must never dlopen a half-written .so or re-link a file
+    # another process has already mapped.
+    tmp = _LIB.with_name(f"_libpflt.{os.getpid()}.tmp.so")
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", str(_SRC), "-o", str(tmp)]
+    try:
+        res = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if res.returncode != 0:
+            log.warning("native codec build failed:\n%s", res.stderr[-2000:])
+            return False
+        os.replace(tmp, _LIB)
+        return True
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        log.debug("native codec build failed to launch: %s", exc)
+        return False
+    finally:
+        tmp.unlink(missing_ok=True)
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.pflt_packed_size.restype = ctypes.c_size_t
+    lib.pflt_packed_size.argtypes = [
+        ctypes.POINTER(ctypes.c_size_t),
+        ctypes.c_size_t,
+        ctypes.c_size_t,
+    ]
+    lib.pflt_pack.restype = ctypes.c_int64
+    lib.pflt_pack.argtypes = [
+        ctypes.c_char_p,          # dst
+        ctypes.c_size_t,          # dst_cap
+        ctypes.c_uint16,          # version
+        ctypes.c_uint32,          # crc32 (0 = unchecked)
+        ctypes.c_char_p,          # header
+        ctypes.c_size_t,          # header_len
+        ctypes.POINTER(ctypes.c_void_p),  # srcs
+        ctypes.POINTER(ctypes.c_size_t),  # sizes
+        ctypes.c_size_t,          # n
+    ]
+    return lib
+
+
+def get_lib(rebuild: bool = False) -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it on first call; None if
+    unavailable (disabled, no compiler, or build failure)."""
+    global _lib, _tried
+    if os.environ.get("P2PFL_TPU_NO_NATIVE") == "1":
+        return None
+    with _lock:
+        if rebuild:
+            _lib, _tried = None, False
+            _LIB.unlink(missing_ok=True)
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            stale = not _LIB.exists() or (
+                _SRC.exists() and _LIB.stat().st_mtime < _SRC.stat().st_mtime
+            )
+        except OSError:
+            stale = not _LIB.exists()
+        if stale and not _compile():
+            # A prebuilt .so without the source still loads below; anything
+            # else falls back to the pure-Python codec.
+            if not _LIB.exists():
+                return None
+        try:
+            _lib = _bind(ctypes.CDLL(str(_LIB)))
+        except OSError as exc:
+            log.warning("native codec load failed: %s", exc)
+            _lib = None
+        return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None

@@ -1,0 +1,75 @@
+// Native implementation of the PFLT weight wire format hot path.
+//
+// The reference framework ships weights as pickled numpy lists inside gRPC
+// messages (p2pfl/learning/frameworks/p2pfl_model.py:71-101) and has no
+// native code at all. Here the byte-level frame assembly — framing and
+// aligned tensor block copies — is a small C++ library called through
+// ctypes (pybind11 isn't in the image). The Python fallback in
+// ops/serialization.py produces byte-identical buffers. The payload CRC is
+// computed by zlib.crc32 on the Python side (zlib's slice-by-N is already
+// optimal); the codec just embeds the caller-provided value.
+//
+// Layout v2 (must match ops/serialization.py exactly):
+//   "PFLT" | u16 version | u32 header_len | u32 crc32 | header | pad to 64
+//   | tensor0 bytes | pad to 64 | tensor1 bytes | pad to 64 | ...
+// crc32 covers header bytes + raw tensor bytes (no padding); 0 = unchecked.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr size_t kAlign = 64;
+constexpr size_t kPrefix = 4 + 2 + 4 + 4;  // magic + version + hlen + crc
+constexpr char kMagic[4] = {'P', 'F', 'L', 'T'};
+
+inline size_t pad_to_align(size_t n) { return (kAlign - (n % kAlign)) % kAlign; }
+
+}  // namespace
+
+extern "C" {
+
+// Total encoded size for a header of `header_len` bytes plus n tensors.
+size_t pflt_packed_size(const size_t* sizes, size_t n, size_t header_len) {
+  size_t off = kPrefix + header_len;
+  off += pad_to_align(off);
+  for (size_t i = 0; i < n; i++) {
+    off += sizes[i];
+    off += pad_to_align(off);
+  }
+  return off;
+}
+
+// Single-pass frame assembly into a caller-allocated buffer of exactly
+// pflt_packed_size() bytes. Returns bytes written, or -1 on overflow.
+int64_t pflt_pack(uint8_t* dst, size_t dst_cap, uint16_t version, uint32_t crc,
+                  const uint8_t* header, size_t header_len,
+                  const uint8_t* const* srcs, const size_t* sizes, size_t n) {
+  if (pflt_packed_size(sizes, n, header_len) > dst_cap) return -1;
+  size_t off = 0;
+  std::memcpy(dst, kMagic, 4);
+  off += 4;
+  std::memcpy(dst + off, &version, 2);  // little-endian on all TPU hosts
+  off += 2;
+  uint32_t hlen32 = static_cast<uint32_t>(header_len);
+  std::memcpy(dst + off, &hlen32, 4);
+  off += 4;
+  std::memcpy(dst + off, &crc, 4);
+  off += 4;
+  std::memcpy(dst + off, header, header_len);
+  off += header_len;
+  size_t p = pad_to_align(off);
+  std::memset(dst + off, 0, p);
+  off += p;
+  for (size_t i = 0; i < n; i++) {
+    std::memcpy(dst + off, srcs[i], sizes[i]);
+    off += sizes[i];
+    p = pad_to_align(off);
+    std::memset(dst + off, 0, p);
+    off += p;
+  }
+  return static_cast<int64_t>(off);
+}
+
+}  // extern "C"

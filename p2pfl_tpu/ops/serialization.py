@@ -5,7 +5,8 @@ and unpickles network payloads (p2pfl/learning/frameworks/p2pfl_model.py:71-101)
 — an RCE risk called out in SURVEY.md §7. This module replaces pickle with a
 flat self-describing buffer:
 
-    magic "PFLT" | u16 version | u32 header_len | msgpack header | raw array bytes
+    "PFLT" | u16 version | u32 header_len | u32 crc32 | msgpack header
+    | raw array bytes (each 64-byte aligned)
 
 The header carries dtype/shape per tensor plus a metadata dict (contributors,
 num_samples, aggregator extra-info). Raw tensor bytes are laid out back to
@@ -13,21 +14,30 @@ back, 64-byte aligned, so deserialization is ``np.frombuffer`` views — no
 copies, no code execution. Metadata is msgpack (no arbitrary objects); numpy
 arrays inside metadata (e.g. SCAFFOLD control variates, scaffold.py:59-140 in
 the reference) are encoded recursively with the same dtype/shape tagging.
+The crc32 (zlib polynomial) covers header bytes + raw tensor bytes, so both
+metadata and weights corruption fail loudly; 0 means "not checked".
+
+Frame assembly goes through the native C++ codec (:mod:`p2pfl_tpu.native`,
+pflt_codec.cpp) when available, with a byte-identical pure-Python fallback.
 """
 
 from __future__ import annotations
 
+import ctypes
 import struct
+import zlib
 from typing import Any, Dict, List, Sequence, Tuple
 
 import msgpack
 import numpy as np
 
+from p2pfl_tpu import native
 from p2pfl_tpu.exceptions import DecodingParamsError
 
 _MAGIC = b"PFLT"
-_VERSION = 1
+_VERSION = 2
 _ALIGN = 64
+_PREFIX = 14  # magic(4) + version(2) + header_len(4) + crc32(4)
 
 # Sentinel key marking a msgpack map as an encoded ndarray.
 _NDARRAY_KEY = "__pflt_ndarray__"
@@ -90,10 +100,33 @@ def _pad(n: int) -> int:
     return (-n) % _ALIGN
 
 
+def _frame_crc(header_bytes: bytes, np_arrays: Sequence[np.ndarray]) -> int:
+    """Chained CRC32 (zlib polynomial) over header bytes + raw tensor bytes."""
+    crc = zlib.crc32(header_bytes)
+    for a in np_arrays:
+        # uint8 view: ml_dtypes types (bfloat16 etc.) don't implement the
+        # buffer protocol directly; 0-d arrays can't be viewed, so copy those.
+        crc = zlib.crc32(a.view(np.uint8).data if a.ndim else a.tobytes(), crc)
+    # reserve 0 as the "not checked" sentinel
+    return crc if crc else 1
+
+
 def serialize_arrays(
-    arrays: Sequence[np.ndarray], metadata: Dict[str, Any] | None = None
+    arrays: Sequence[np.ndarray],
+    metadata: Dict[str, Any] | None = None,
+    checksum: bool = True,
 ) -> bytes:
-    """Encode a flat list of arrays + metadata dict into one buffer."""
+    """Encode a flat list of arrays + metadata dict into one buffer.
+
+    With ``checksum`` (default) the frame carries a CRC32 of header +
+    tensor payload which :func:`deserialize_arrays` verifies — corruption of
+    either weights or metadata in transit fails loudly instead of silently
+    training on garbage.
+
+    Returns bytes (Python path) or a ``bytearray`` (native path — single
+    C++ pass into one buffer with no trailing copy; both satisfy the buffer
+    protocol used by the transports).
+    """
     # np.asarray(order="C") rather than ascontiguousarray: the latter promotes
     # 0-d arrays to 1-d (numpy >= 2.0), which would corrupt scalar leaves.
     np_arrays = [np.asarray(a, order="C") for a in arrays]
@@ -102,8 +135,25 @@ def serialize_arrays(
         "meta": _encode_meta_value(metadata or {}),
     }
     header_bytes = msgpack.packb(header, use_bin_type=True)
-    parts = [_MAGIC, struct.pack("<HI", _VERSION, len(header_bytes)), header_bytes]
-    offset = len(_MAGIC) + 6 + len(header_bytes)
+    crc = _frame_crc(header_bytes, np_arrays) if checksum else 0
+
+    lib = native.get_lib()
+    if lib is not None:
+        n = len(np_arrays)
+        srcs = (ctypes.c_void_p * n)(*[a.ctypes.data for a in np_arrays])
+        sizes = (ctypes.c_size_t * n)(*[a.nbytes for a in np_arrays])
+        total = lib.pflt_packed_size(sizes, n, len(header_bytes))
+        buf = bytearray(total)
+        written = lib.pflt_pack(
+            (ctypes.c_char * total).from_buffer(buf), total, _VERSION, crc,
+            header_bytes, len(header_bytes), srcs, sizes, n,
+        )
+        if written == total:
+            return buf
+        # fall through to the Python path on any native-side size mismatch
+
+    parts = [_MAGIC, struct.pack("<HII", _VERSION, len(header_bytes), crc), header_bytes]
+    offset = _PREFIX + len(header_bytes)
     parts.append(b"\0" * _pad(offset))
     offset += _pad(offset)
     for a in np_arrays:
@@ -122,13 +172,14 @@ def deserialize_arrays(buf: bytes) -> Tuple[List[np.ndarray], Dict[str, Any]]:
     alignment allows (always, by construction).
     """
     try:
-        if buf[:4] != _MAGIC:
+        if bytes(buf[:4]) != _MAGIC:  # buf may be bytes, bytearray, memoryview
             raise DecodingParamsError("bad magic — not a p2pfl_tpu weights buffer")
-        version, header_len = struct.unpack_from("<HI", buf, 4)
+        version, header_len, crc = struct.unpack_from("<HII", buf, 4)
         if version != _VERSION:
             raise DecodingParamsError(f"unsupported wire version {version}")
-        header_end = 10 + header_len
-        header = msgpack.unpackb(buf[10:header_end], raw=False)
+        header_end = _PREFIX + header_len
+        header_bytes = buf[_PREFIX:header_end]
+        header = msgpack.unpackb(header_bytes, raw=False)
         offset = header_end + _pad(header_end)
         arrays: List[np.ndarray] = []
         for t in header["tensors"]:
@@ -139,6 +190,8 @@ def deserialize_arrays(buf: bytes) -> Tuple[List[np.ndarray], Dict[str, Any]]:
             arr = np.frombuffer(buf, dtype=dtype, count=count, offset=offset)
             arrays.append(arr.reshape(shape))
             offset += nbytes + _pad(offset + nbytes)
+        if crc and _frame_crc(header_bytes, arrays) != crc:
+            raise DecodingParamsError("weights frame failed CRC32 integrity check")
         meta = _decode_meta_value(header.get("meta", {}))
         return arrays, meta
     except DecodingParamsError:

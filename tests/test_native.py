@@ -1,0 +1,95 @@
+"""Native (C++) wire codec: build, byte-identity with the Python path,
+CRC32 integrity (weights AND metadata), and graceful fallback."""
+
+import ctypes
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu import native
+from p2pfl_tpu.exceptions import DecodingParamsError
+from p2pfl_tpu.ops.serialization import deserialize_arrays, serialize_arrays
+
+
+def _arrays():
+    rng = np.random.default_rng(0)
+    return [
+        rng.normal(size=(17, 33)).astype(np.float32),
+        rng.integers(0, 255, size=(5,)).astype(np.uint8),
+        np.float32(3.25),  # 0-d leaf
+        rng.normal(size=(128, 64)).astype(np.float16),
+    ]
+
+
+def test_native_builds_and_loads():
+    lib = native.get_lib()
+    assert lib is not None, "g++ is in the image; the codec must build"
+
+
+def test_native_and_python_paths_byte_identical(monkeypatch):
+    arrays = _arrays()
+    meta = {"contributors": ["a", "b"], "num_samples": 7}
+    assert native.get_lib() is not None
+    buf_native = serialize_arrays(arrays, meta)
+    assert isinstance(buf_native, bytearray)  # single-copy native path
+    monkeypatch.setenv("P2PFL_TPU_NO_NATIVE", "1")
+    buf_python = serialize_arrays(arrays, meta)
+    assert isinstance(buf_python, bytes)
+    assert bytes(buf_native) == buf_python
+
+
+def test_roundtrip_with_checksum():
+    arrays = _arrays()
+    buf = serialize_arrays(arrays, {"k": 1})
+    out, meta = deserialize_arrays(buf)
+    assert meta == {"k": 1}
+    for a, b in zip(arrays, out):
+        np.testing.assert_array_equal(np.asarray(a), b)
+
+
+def test_tensor_corruption_detected():
+    buf = bytearray(serialize_arrays(_arrays(), {}))
+    buf[-3] ^= 0xFF  # flip a bit in the last tensor's bytes
+    with pytest.raises(DecodingParamsError, match="CRC32"):
+        deserialize_arrays(bytes(buf))
+
+
+def test_metadata_corruption_detected():
+    buf = bytearray(serialize_arrays(_arrays(), {"num_samples": 7}))
+    # flip a bit inside the msgpack header region (right after the prefix)
+    buf[20] ^= 0x01
+    with pytest.raises(DecodingParamsError):
+        deserialize_arrays(bytes(buf))
+
+
+def test_checksum_optional():
+    buf = bytearray(serialize_arrays(_arrays(), {}, checksum=False))
+    buf[-3] ^= 0xFF
+    out, _ = deserialize_arrays(bytes(buf))  # crc=0 -> unchecked
+    assert len(out) == 4
+
+
+def test_packed_size_matches_python_framing():
+    lib = native.get_lib()
+    assert lib is not None
+    sizes = [17 * 33 * 4, 5, 4, 128 * 64 * 2]
+    n = len(sizes)
+    c_sizes = (ctypes.c_size_t * n)(*sizes)
+    header_len = 123
+    total = lib.pflt_packed_size(c_sizes, n, header_len)
+    off = 14 + header_len  # magic + version + header_len + crc32
+    off += (-off) % 64
+    for s in sizes:
+        off += s
+        off += (-off) % 64
+    assert total == off
+
+
+def test_python_fallback_when_disabled(monkeypatch):
+    monkeypatch.setenv("P2PFL_TPU_NO_NATIVE", "1")
+    arrays = _arrays()
+    buf = serialize_arrays(arrays, {"x": [1, 2]})
+    out, meta = deserialize_arrays(buf)
+    assert meta == {"x": [1, 2]}
+    for a, b in zip(arrays, out):
+        np.testing.assert_array_equal(np.asarray(a), b)

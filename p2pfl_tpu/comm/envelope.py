@@ -63,7 +63,9 @@ class Envelope:
             round=round,
             ttl=0,
             msg_id=secrets.randbits(63),
-            payload=payload,
+            # coerce once: the native codec hands out bytearray, and the
+            # envelope is reused across gossip fan-out (bytes(bytes) is free)
+            payload=bytes(payload),
             contributors=list(contributors),
             num_samples=int(num_samples),
         )

@@ -14,6 +14,7 @@ Parity with reference communication/protocols/gossiper.py:31-239:
 
 from __future__ import annotations
 
+import logging
 import random
 import threading
 from collections import OrderedDict, deque
@@ -21,6 +22,8 @@ from typing import Any, Callable, List, Optional, Tuple
 
 from p2pfl_tpu.comm.envelope import Envelope
 from p2pfl_tpu.config import Settings
+
+log = logging.getLogger("p2pfl_tpu")
 
 
 class Gossiper:
@@ -96,7 +99,10 @@ class Gossiper:
                     try:
                         self._send(t, env)
                     except Exception:
-                        pass  # peer may be gone; failure detector handles it
+                        # transport failures are already swallowed and logged
+                        # by protocol.send (raise_error=False); this guard
+                        # only keeps the gossip thread alive on local bugs
+                        log.exception("gossip send to %s failed unexpectedly", t)
                 budget -= len(targets) or 1
 
     # --- sync model gossip (reference gossiper.py:163-239) ------------------
@@ -149,6 +155,6 @@ class Gossiper:
                 try:
                     self._send(nei, env)
                 except Exception:
-                    pass
+                    log.exception("model gossip to %s failed unexpectedly", nei)
             if ticker.wait(period):  # plain sleep, interruptible-style
                 return

@@ -38,7 +38,8 @@ _SERVICE = "p2pfl_tpu.NodeService"
 def _env_to_pb(env: Envelope) -> node_pb2.Envelope:
     pb = node_pb2.Envelope(source=env.source, cmd=env.cmd, round=env.round)
     if env.is_weights:
-        pb.weights.payload = env.payload
+        # protobuf only accepts bytes; the native codec hands out bytearray
+        pb.weights.payload = bytes(env.payload)
         pb.weights.contributors.extend(env.contributors)
         pb.weights.num_samples = env.num_samples
     else:

@@ -13,6 +13,7 @@ one tested code path.
 from __future__ import annotations
 
 import functools
+import logging
 import threading
 from typing import Any, Callable, List, Optional
 
@@ -26,6 +27,8 @@ from p2pfl_tpu.exceptions import (
     NeighborNotConnectedError,
     ProtocolNotStartedError,
 )
+
+log = logging.getLogger("p2pfl_tpu")
 
 
 def running(fn: Callable) -> Callable:
@@ -176,6 +179,14 @@ class CommunicationProtocol:
                 return
         try:
             self._transport_send(nei, env)
+        except (TypeError, AttributeError):
+            # Local programming error (e.g. bad payload type), not a peer
+            # failure: keep the neighbor and surface it loudly instead of
+            # masking it as a CommunicationError. (ValueError stays on the
+            # transport path: grpc raises it for closed-channel races.)
+            log.exception("send to %s failed with a local error", nei)
+            if raise_error:
+                raise
         except Exception as exc:
             if remove_on_error:
                 self.neighbors.remove(nei, notify=False)

@@ -1,0 +1,22 @@
+"""Framework interop: run non-JAX learners inside the federation.
+
+Capability parity with the reference's pluggable ML frameworks
+(p2pfl/learning/frameworks/: LightningLearner for torch, KerasLearner for
+TF, FlaxLearner — learner_factory.py:24-56): the federation protocol only
+moves flat numpy weight lists, so any framework that can load/dump its
+parameters as numpy can join. The TPU-native :class:`JaxLearner` stays the
+first-class path; interop backends let reference users migrate
+incrementally (bring a torch nn.Module today, port to flax when ready).
+
+Backends register themselves with :class:`LearnerFactory` on import when
+their framework is importable; TensorFlow isn't in this image, so only the
+torch backend is live (gate pattern per the environment constraints).
+"""
+
+from p2pfl_tpu.learning.interop.torch_backend import (  # noqa: F401
+    TorchLearner,
+    TorchModelHandle,
+    jax_mlp_params_to_torch,
+    torch_mlp_model,
+    torch_state_dict_to_jax_mlp,
+)

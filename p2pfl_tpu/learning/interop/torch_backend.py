@@ -1,0 +1,277 @@
+"""PyTorch interop: a torch-backed ModelHandle + Learner for the federation.
+
+Parity with the reference's PyTorch backend (p2pfl/learning/frameworks/
+pytorch/lightning_model.py:37-116 state_dict<->numpy, lightning_learner.py:
+43-137 fit/evaluate): a ``torch.nn.Module``'s state_dict is the parameter
+pytree, so the gossip/aggregation machinery (numpy weight lists over the
+PFLT wire format) is shared unchanged with JAX nodes. Training runs eager
+torch on host CPU — this is the *migration* path for reference users; the
+TPU-native path is :class:`~p2pfl_tpu.learning.learner.JaxLearner`.
+
+Also provides exact weight translation between the torch MLP and the flax
+MLP of the model zoo (``Linear.weight`` is ``[out, in]``; flax ``Dense``
+kernels are ``[in, out]``), so a federation can be migrated mid-experiment
+from torch to the jitted TPU learner without losing the model.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from p2pfl_tpu.learning.dataset.dataset import FederatedDataset
+from p2pfl_tpu.learning.learner import Learner, LearnerFactory
+from p2pfl_tpu.models.model_handle import ModelHandle
+
+try:  # torch (CPU) is in the image; gate anyway per environment rules
+    import torch
+    from torch import nn
+
+    TORCH_AVAILABLE = True
+except ImportError:  # pragma: no cover
+    torch = None
+    nn = None
+    TORCH_AVAILABLE = False
+
+
+def _require_torch() -> None:
+    if not TORCH_AVAILABLE:
+        raise ImportError(
+            "PyTorch is not available; install torch or use the JAX backend"
+        )
+
+
+def copy_module(module: "nn.Module") -> "nn.Module":
+    """Independent clone of a torch module (weights included)."""
+    import copy as _copy
+
+    return _copy.deepcopy(module)
+
+
+class TorchModelHandle(ModelHandle):
+    """ModelHandle whose parameters are a torch module's state_dict.
+
+    The pytree is ``{name: np.ndarray}`` in state_dict order; ``apply_fn``
+    runs the module forward under ``torch.no_grad`` on numpy batches, so
+    evaluation works through the same interface as JAX handles.
+    """
+
+    framework = "pytorch"
+
+    def __init__(self, module: "nn.Module", **kwargs: Any) -> None:
+        _require_torch()
+        self.module = module
+        params = {
+            k: v.detach().cpu().numpy().copy() for k, v in module.state_dict().items()
+        }
+
+        def apply_fn(params: Dict[str, np.ndarray], x: np.ndarray) -> np.ndarray:
+            self._load(params)
+            with torch.no_grad():
+                out = module(torch.from_numpy(np.asarray(x, np.float32)))
+            return out.numpy()
+
+        super().__init__(params=params, apply_fn=apply_fn, model_def=module, **kwargs)
+
+    def _load(self, params: Optional[Dict[str, np.ndarray]] = None) -> None:
+        """Push the handle's numpy params into the live torch module."""
+        params = self.params if params is None else params
+
+        def as_tensor(v: np.ndarray) -> "torch.Tensor":
+            a = np.ascontiguousarray(v)
+            if not a.flags.writeable:  # wire-decoded views are read-only
+                a = a.copy()
+            return torch.from_numpy(a)
+
+        self.module.load_state_dict({k: as_tensor(v) for k, v in params.items()})
+
+    def pull_from_module(self) -> None:
+        """Refresh the handle's numpy params from the live torch module."""
+        self.params = {
+            k: v.detach().cpu().numpy().copy()
+            for k, v in self.module.state_dict().items()
+        }
+
+    def build_copy(self, params=None, contributors=None, num_samples=None):
+        # Each copy gets its own module: apply_fn pushes the handle's params
+        # into its module, so sharing one would let copies clobber each other
+        # (and a learner mid-fit) through load_state_dict.
+        copy = TorchModelHandle(
+            copy_module(self.module),
+            contributors=contributors if contributors is not None else list(self.contributors),
+            num_samples=num_samples if num_samples is not None else self.num_samples,
+            additional_info=dict(self.additional_info),
+        )
+        copy.set_parameters(self.params if params is None else params)
+        return copy
+
+
+class TorchLearner(Learner):
+    """Eager torch CPU trainer with the reference learner's contract
+    (fit updates the handle in place with params + contribution metadata;
+    interrupt_fit takes effect between epochs — reference
+    lightning_learner.py:98-104 uses trainer.should_stop the same way)."""
+
+    SUPPORTED_CALLBACKS: Sequence[str] = ()
+
+    def __init__(
+        self,
+        model: Optional[ModelHandle] = None,
+        data: Optional[FederatedDataset] = None,
+        self_addr: str = "unknown-node",
+        lr: float = 1e-3,
+        batch_size: int = 64,
+        seed: int = 0,
+        callbacks: Optional[List[str]] = None,
+    ) -> None:
+        _require_torch()
+        super().__init__(model, data, self_addr)
+        self.lr = float(lr)
+        self.batch_size = int(batch_size)
+        self.seed = int(seed)
+        if callbacks:
+            raise ValueError(
+                f"callbacks {callbacks!r} are not supported by TorchLearner "
+                "(use JaxLearner)"
+            )
+        self._interrupt = threading.Event()
+        self._fit_count = 0
+
+    def get_framework(self) -> str:
+        return "pytorch"
+
+    def interrupt_fit(self) -> None:
+        self._interrupt.set()
+
+    def _handle(self) -> TorchModelHandle:
+        model = self.get_model()
+        if not isinstance(model, TorchModelHandle):
+            raise TypeError("TorchLearner requires a TorchModelHandle")
+        return model
+
+    def fit(self) -> ModelHandle:
+        model = self._handle()
+        self._interrupt.clear()
+        t0 = time.monotonic()
+        torch.manual_seed(self.seed + self._fit_count)
+        epoch_seed = self.seed + 1000 * self._fit_count
+        self._fit_count += 1
+
+        model._load()
+        module = model.module
+        module.train()
+        opt = torch.optim.Adam(module.parameters(), lr=self.lr)
+        loss_fn = nn.CrossEntropyLoss(reduction="none")
+
+        for epoch in range(self.epochs):
+            if self._interrupt.is_set():
+                break
+            xb, yb, wb = self.get_data().export_batches(
+                self.batch_size, train=True, seed=epoch_seed + epoch
+            )
+            losses = []
+            for x, y, w in zip(xb, yb, wb):
+                opt.zero_grad()
+                logits = module(torch.from_numpy(np.asarray(x, np.float32)))
+                per = loss_fn(logits, torch.from_numpy(np.asarray(y, np.int64)))
+                wt = torch.from_numpy(np.asarray(w, np.float32))
+                loss = (per * wt).sum() / wt.sum().clamp(min=1.0)
+                loss.backward()
+                opt.step()
+                losses.append(loss.item())
+            self.report("train_loss", float(np.mean(losses)), step=epoch)
+
+        model.pull_from_module()
+        model.set_contribution([self._self_addr], self.get_data().get_num_samples(True))
+        self.report("fit_time_s", time.monotonic() - t0)
+        return model
+
+    def evaluate(self) -> Dict[str, float]:
+        model = self._handle()
+        try:
+            xb, yb, wb = self.get_data().export_batches(
+                self.batch_size, train=False, seed=0
+            )
+        except KeyError:
+            return {}
+        model._load()
+        module = model.module
+        module.eval()
+        loss_fn = nn.CrossEntropyLoss(reduction="none")
+        tot_loss = tot_correct = tot_n = 0.0
+        with torch.no_grad():
+            for x, y, w in zip(xb, yb, wb):
+                logits = module(torch.from_numpy(np.asarray(x, np.float32)))
+                yt = torch.from_numpy(np.asarray(y, np.int64))
+                wt = torch.from_numpy(np.asarray(w, np.float32))
+                per = loss_fn(logits, yt)
+                tot_loss += float((per * wt).sum())
+                tot_correct += float(((logits.argmax(-1) == yt).float() * wt).sum())
+                tot_n += float(wt.sum())
+        tot_n = max(tot_n, 1.0)
+        metrics = {"test_loss": tot_loss / tot_n, "test_acc": tot_correct / tot_n}
+        for k, v in metrics.items():
+            self.report(k, v)
+        return metrics
+
+
+# --- model zoo translation ----------------------------------------------------
+
+
+def torch_mlp_model(
+    seed: int = 0,
+    hidden_sizes: Sequence[int] = (256, 128),
+    out_channels: int = 10,
+    in_features: int = 784,
+) -> TorchModelHandle:
+    """Torch twin of :func:`p2pfl_tpu.models.mlp_model` (same architecture as
+    the reference's per-framework MLPs, lightning_model.py:118+)."""
+    _require_torch()
+    torch.manual_seed(seed)
+    layers: List[nn.Module] = [nn.Flatten()]
+    prev = in_features
+    for h in hidden_sizes:
+        layers += [nn.Linear(prev, h), nn.ReLU()]
+        prev = h
+    layers.append(nn.Linear(prev, out_channels))
+    return TorchModelHandle(nn.Sequential(*layers))
+
+
+def torch_state_dict_to_jax_mlp(state: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """Translate a torch MLP state_dict into flax MLP params.
+
+    ``Linear.weight`` is ``[out, in]``; flax ``Dense`` kernels are
+    ``[in, out]`` — transpose and re-nest into the linen naming scheme.
+    """
+    weights = sorted(
+        (k for k in state if k.endswith(".weight")),
+        key=lambda k: int(k.split(".")[0]),
+    )
+    params: Dict[str, Any] = {}
+    for i, wk in enumerate(weights):
+        bk = wk.rsplit(".", 1)[0] + ".bias"
+        params[f"Dense_{i}"] = {
+            "kernel": np.asarray(state[wk]).T.copy(),
+            "bias": np.asarray(state[bk]).copy(),
+        }
+    return {"params": params}
+
+
+def jax_mlp_params_to_torch(params: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`torch_state_dict_to_jax_mlp` for the torch twin
+    built by :func:`torch_mlp_model` (nn.Sequential indices: Flatten at 0,
+    Linear at 1, 3, 5, ...)."""
+    inner = params.get("params", params)
+    state: Dict[str, np.ndarray] = {}
+    for i, name in enumerate(sorted(inner, key=lambda n: int(n.split("_")[1]))):
+        idx = 1 + 2 * i
+        state[f"{idx}.weight"] = np.asarray(inner[name]["kernel"]).T.copy()
+        state[f"{idx}.bias"] = np.asarray(inner[name]["bias"]).copy()
+    return state
+
+
+if TORCH_AVAILABLE:
+    LearnerFactory.register("pytorch", TorchLearner)

@@ -1,0 +1,36 @@
+import sys, time, json
+sys.argv=["bench"]
+import bench as B
+from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy
+from p2pfl_tpu.models import mlp_model
+from p2pfl_tpu.parallel.simulation import MeshSimulation
+import jax, jax.numpy as jnp
+
+x = None
+# reuse bench's data maker
+import numpy as np
+make = None
+# inline from bench
+import importlib
+fn = B.bench_tpu.__code__
+# simpler: replicate minimal
+NUM_NODES, ROUNDS, COMMITTEE, BATCH, SPN, TS = B.NUM_NODES, B.ROUNDS, B.COMMITTEE, B.BATCH, B.SAMPLES_PER_NODE, B.TEST_SAMPLES
+@jax.jit
+def make_data(key):
+    kt, ky, kn, kyt, knt = jax.random.split(key, 5)
+    templates = jax.random.uniform(kt, (10, 28, 28), jnp.float32)
+    y = jax.random.randint(ky, (NUM_NODES, SPN), 0, 10)
+    xx = jnp.clip(templates[y] + 0.35 * jax.random.normal(kn, (NUM_NODES, SPN, 28, 28)), 0.0, 1.0)
+    mask = jnp.ones((NUM_NODES, SPN), jnp.float32)
+    yt = jax.random.randint(kyt, (TS,), 0, 10)
+    xt = jnp.clip(templates[yt] + 0.35 * jax.random.normal(knt, (TS, 28, 28)), 0.0, 1.0)
+    return xx, y.astype(jnp.int32), mask, xt, yt.astype(jnp.int32)
+x, y, mask, xt, yt = make_data(jax.random.key(42))
+jax.block_until_ready(x)
+for rpc in (10,):
+    print(f"building sim rpc={rpc}", flush=True)
+    sim = MeshSimulation(mlp_model(seed=0), (x, y, mask), test_data=(xt, yt),
+                         train_set_size=COMMITTEE, batch_size=BATCH, seed=1)
+    t0=time.monotonic(); print("starting run (compile)", flush=True)
+    res = sim.run(rounds=ROUNDS, epochs=1, warmup=True, rounds_per_call=rpc)
+    print(f"rounds_per_call={rpc}: {res.seconds_per_round*1000:.2f} ms/round (total wall incl warmup {time.monotonic()-t0:.1f}s)")

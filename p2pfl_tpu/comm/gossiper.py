@@ -22,6 +22,7 @@ from typing import Any, Callable, List, Optional, Tuple
 
 from p2pfl_tpu.comm.envelope import Envelope
 from p2pfl_tpu.config import Settings
+from p2pfl_tpu.exceptions import ProtocolNotStartedError
 
 log = logging.getLogger("p2pfl_tpu")
 
@@ -98,6 +99,8 @@ class Gossiper:
                 for t in targets:
                     try:
                         self._send(t, env)
+                    except ProtocolNotStartedError:
+                        return  # protocol stopping under us — normal shutdown
                     except Exception:
                         # transport failures are already swallowed and logged
                         # by protocol.send (raise_error=False); this guard
@@ -154,6 +157,8 @@ class Gossiper:
                     continue
                 try:
                     self._send(nei, env)
+                except ProtocolNotStartedError:
+                    return  # protocol stopping under us — normal shutdown
                 except Exception:
                     log.exception("model gossip to %s failed unexpectedly", nei)
             if ticker.wait(period):  # plain sleep, interruptible-style

@@ -105,6 +105,17 @@ def softmax_cross_entropy(logits: jax.Array, labels: jax.Array, mask: jax.Array)
     return jnp.sum(nll * mask) / denom
 
 
+def masked_lm_loss(logits: jax.Array, tokens: jax.Array, seq_mask: jax.Array) -> jax.Array:
+    """Next-token CE over ``logits [B, L, V]`` / ``tokens [B, L]`` with a
+    per-sequence validity mask ``[B]`` (padded rows of a stacked federated
+    partition contribute zero). Thin wrapper broadcasting the sequence mask
+    into :func:`p2pfl_tpu.models.transformer.causal_lm_loss`."""
+    from p2pfl_tpu.models.transformer import causal_lm_loss
+
+    mask = jnp.broadcast_to(seq_mask[:, None], tokens.shape)
+    return causal_lm_loss(logits, tokens, mask)
+
+
 class JaxLearner(Learner):
     """Fully-jitted local trainer.
 

@@ -4,6 +4,12 @@ from p2pfl_tpu.models.model_handle import ModelHandle  # noqa: F401
 from p2pfl_tpu.models.mlp import MLP, mlp_model  # noqa: F401
 from p2pfl_tpu.models.cnn import CNN, cnn_model  # noqa: F401
 from p2pfl_tpu.models.resnet import ResNet18, resnet18_model  # noqa: F401
+from p2pfl_tpu.models.moe import (  # noqa: F401
+    MoETransformerLM,
+    moe_lm_apply_with_aux,
+    moe_lm_model,
+    shard_moe_params,
+)
 from p2pfl_tpu.models.transformer import (  # noqa: F401
     TransformerClassifier,
     TransformerLM,
@@ -25,4 +31,8 @@ __all__ = [
     "transformer_lm_model",
     "transformer_classifier_model",
     "causal_lm_loss",
+    "MoETransformerLM",
+    "moe_lm_model",
+    "moe_lm_apply_with_aux",
+    "shard_moe_params",
 ]

@@ -1,0 +1,234 @@
+"""Mixture-of-Experts transformer with expert parallelism.
+
+Green-field TPU capability (the reference has no MoE or any model
+parallelism — SURVEY.md §2). Switch-transformer-style top-1 routing with
+static shapes throughout (capacity-limited dispatch/combine one-hot
+einsums), so the whole layer jits cleanly:
+
+* expert FFN weights are *stacked* ``[num_experts, ...]``; sharding that
+  leading axis over an ``expert`` mesh axis
+  (:func:`shard_moe_params`) makes XLA lower the dispatch/combine einsums
+  to all-to-all exchanges over ICI — expert parallelism without any
+  hand-written collective,
+* router load-balance auxiliary loss (Shazeer et al. 2017 / Fedus et al.
+  2021) is returned through a mutable "losses" collection so training can
+  add it to the objective,
+* tokens overflowing an expert's capacity fall through the residual (the
+  standard switch behavior).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from p2pfl_tpu.models.model_handle import ModelHandle
+from p2pfl_tpu.models.transformer import Block, SelfAttention
+
+
+class MoEMLP(nn.Module):
+    """Capacity-limited top-1 routed expert FFN over ``[B, S, E]``."""
+
+    num_experts: int = 4
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, s, e = x.shape
+        t = b * s
+        nx = self.num_experts
+        cap = max(1, int(self.capacity_factor * t / nx))
+        tokens = x.reshape(t, e)
+
+        # --- router (float32 for a stable softmax/argmax) -------------------
+        logits = nn.Dense(nx, use_bias=False, dtype=jnp.float32, name="router")(
+            tokens.astype(jnp.float32)
+        )
+        probs = jax.nn.softmax(logits, axis=-1)          # [T, X]
+        gate = jnp.max(probs, axis=-1)                   # [T]
+        expert = jnp.argmax(probs, axis=-1)              # [T]
+
+        # load-balance aux loss: X * Σ_x fraction_x * mean_prob_x
+        onehot = jax.nn.one_hot(expert, nx, dtype=jnp.float32)  # [T, X]
+        fraction = jnp.mean(onehot, axis=0)
+        mean_prob = jnp.mean(probs, axis=0)
+        self.sow("losses", "moe_aux", nx * jnp.sum(fraction * mean_prob))
+
+        # --- capacity-limited dispatch (static shapes) ----------------------
+        # position of each token within its expert's queue
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot        # [T, X]
+        in_cap = (pos < cap) & (onehot > 0)                      # [T, X] bool
+        pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32)
+        dispatch = in_cap[..., None] * pos_oh                    # [T, X, C]
+        combine = dispatch * gate[:, None, None]                 # [T, X, C]
+
+        # --- expert FFN over the stacked expert axis ------------------------
+        m = self.mlp_ratio * e
+        wi = self.param(
+            "wi", nn.initializers.lecun_normal(), (nx, e, m), jnp.float32
+        )
+        wo = self.param(
+            "wo", nn.initializers.lecun_normal(), (nx, m, e), jnp.float32
+        )
+        cd = self.compute_dtype
+        xe = jnp.einsum("txc,te->xce", dispatch.astype(cd), tokens.astype(cd))
+        h = nn.gelu(jnp.einsum("xce,xem->xcm", xe, wi.astype(cd)))
+        out_e = jnp.einsum("xcm,xme->xce", h, wo.astype(cd))
+        out = jnp.einsum("txc,xce->te", combine.astype(cd), out_e)
+        return out.reshape(b, s, e).astype(x.dtype)
+
+
+class MoEBlock(nn.Module):
+    """Pre-LN block: attention + routed MoE FFN."""
+
+    num_heads: int
+    num_experts: int = 4
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    attention_kind: str = "blockwise"
+    axis_name: Optional[str] = None
+    block_k: int = 512
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        h = SelfAttention(
+            num_heads=self.num_heads,
+            attention_kind=self.attention_kind,
+            axis_name=self.axis_name,
+            block_k=self.block_k,
+            compute_dtype=self.compute_dtype,
+            name="attn",
+        )(h.astype(self.compute_dtype))
+        x = x + h.astype(x.dtype)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = MoEMLP(
+            num_experts=self.num_experts,
+            mlp_ratio=self.mlp_ratio,
+            capacity_factor=self.capacity_factor,
+            compute_dtype=self.compute_dtype,
+            name="moe",
+        )(h.astype(self.compute_dtype))
+        return x + h.astype(x.dtype)
+
+
+class MoETransformerLM(nn.Module):
+    """Decoder-only LM alternating dense and MoE blocks (every 2nd block is
+    routed, the switch-transformer layout)."""
+
+    vocab_size: int = 256
+    num_layers: int = 4
+    num_heads: int = 4
+    embed_dim: int = 256
+    num_experts: int = 4
+    capacity_factor: float = 1.25
+    attention_kind: str = "blockwise"
+    axis_name: Optional[str] = None
+    block_k: int = 512
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        x = nn.Embed(self.vocab_size, self.embed_dim, dtype=self.compute_dtype, name="embed")(
+            tokens.astype(jnp.int32)
+        )
+        for i in range(self.num_layers):
+            if i % 2 == 1:
+                x = MoEBlock(
+                    num_heads=self.num_heads,
+                    num_experts=self.num_experts,
+                    capacity_factor=self.capacity_factor,
+                    attention_kind=self.attention_kind,
+                    axis_name=self.axis_name,
+                    block_k=self.block_k,
+                    compute_dtype=self.compute_dtype,
+                    name=f"block{i}",
+                )(x)
+            else:
+                x = Block(
+                    num_heads=self.num_heads,
+                    attention_kind=self.attention_kind,
+                    axis_name=self.axis_name,
+                    block_k=self.block_k,
+                    compute_dtype=self.compute_dtype,
+                    name=f"block{i}",
+                )(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        logits = nn.Dense(
+            self.vocab_size, use_bias=False, dtype=self.compute_dtype, name="lm_head"
+        )(x.astype(self.compute_dtype))
+        return logits.astype(jnp.float32)
+
+
+def moe_lm_apply_with_aux(module: MoETransformerLM):
+    """Return ``f(params, tokens) -> (logits, aux_loss)`` where ``aux_loss``
+    is the summed router load-balance loss of all MoE blocks."""
+
+    def apply(params: Any, tokens: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        logits, state = module.apply(params, tokens, mutable=["losses"])
+        aux = sum(jax.tree.leaves(state.get("losses", {})), jnp.float32(0))
+        return logits, aux
+
+    return apply
+
+
+def moe_lm_model(
+    seed: int = 0,
+    seq_len: int = 128,
+    vocab_size: int = 256,
+    num_layers: int = 4,
+    num_heads: int = 4,
+    embed_dim: int = 256,
+    num_experts: int = 4,
+    attention_kind: str = "blockwise",
+    axis_name: Optional[str] = None,
+) -> ModelHandle:
+    """Initialize a :class:`MoETransformerLM` wrapped in a ModelHandle.
+
+    ``apply_fn`` returns logits only (aux loss dropped) for interface
+    compatibility; training should use :func:`moe_lm_apply_with_aux`.
+    """
+    module = MoETransformerLM(
+        vocab_size=vocab_size,
+        num_layers=num_layers,
+        num_heads=num_heads,
+        embed_dim=embed_dim,
+        num_experts=num_experts,
+        attention_kind=attention_kind,
+        axis_name=axis_name,
+    )
+    init_module = module if axis_name is None else module.copy(
+        attention_kind="blockwise", axis_name=None
+    )
+    params = init_module.init(jax.random.key(seed), jnp.zeros((1, seq_len), jnp.int32))
+
+    def apply_fn(p: Any, tokens: jax.Array) -> jax.Array:
+        logits, _ = module.apply(p, tokens, mutable=["losses"])
+        return logits
+
+    return ModelHandle(params=params, apply_fn=apply_fn, model_def=module)
+
+
+def shard_moe_params(params: Any, mesh: Mesh, expert_axis: str = "expert") -> Any:
+    """Place expert-stacked leaves (leading dim == num_experts of any MoE
+    layer) on ``P(expert_axis)``, replicate the rest. Seeding the param
+    sharding is all XLA needs to turn the dispatch/combine einsums into
+    all-to-all exchanges over the ``expert`` axis."""
+    n_exp = mesh.shape[expert_axis]
+
+    def place(path: Any, x: jax.Array) -> jax.Array:
+        keys = [getattr(k, "key", str(k)) for k in path]
+        is_expert_leaf = any("moe" in k for k in keys) and (
+            keys[-1] in ("wi", "wo") and x.ndim == 3 and x.shape[0] % n_exp == 0
+        )
+        spec = P(expert_axis) if is_expert_leaf else P()
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(place, params)

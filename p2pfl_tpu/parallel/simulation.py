@@ -41,7 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from p2pfl_tpu.config import Settings
 from p2pfl_tpu.learning.dataset.dataset import FederatedDataset
-from p2pfl_tpu.learning.learner import softmax_cross_entropy
+from p2pfl_tpu.learning.learner import masked_lm_loss, softmax_cross_entropy
 from p2pfl_tpu.models.model_handle import ModelHandle
 from p2pfl_tpu.ops import aggregation as agg_ops
 from p2pfl_tpu.parallel.mesh import make_mesh
@@ -108,6 +108,11 @@ class MeshSimulation:
         mesh: device mesh (default: all devices on the ``nodes`` axis).
         tp_rules: optional callable mapping a params pytree to a pytree of
             ``PartitionSpec`` suffixes for tensor parallelism.
+        task: ``"classification"`` (default; per-sample labels in ``y``) or
+            ``"lm"`` — federated causal-LM fine-tuning: ``x`` holds token
+            sequences ``[N, S, L]``, the target is the next token, and
+            eval reports token-level loss/accuracy. Long-context federated
+            fine-tuning runs the transformer family through this path.
     """
 
     def __init__(
@@ -123,7 +128,11 @@ class MeshSimulation:
         mesh: Optional[Mesh] = None,
         aggregate_fn: Optional[Callable[[Pytree, jax.Array], Pytree]] = None,
         per_node_init: bool = False,
+        task: str = "classification",
     ) -> None:
+        if task not in ("classification", "lm"):
+            raise ValueError(f"unknown task {task!r}")
+        self.task = task
         self.model = model
         self.apply_fn = model.apply_fn
         self.batch_size = int(batch_size)
@@ -143,6 +152,11 @@ class MeshSimulation:
         )
         if test_data is not None:
             self.x_test, self.y_test = test_data
+            if self.y_test is None and task == "classification" and self.x_test is not None:
+                raise ValueError(
+                    "test_data labels are required for task='classification' "
+                    "(y_test=None is only valid for task='lm')"
+                )
         elif not isinstance(partitions, tuple):
             self.x_test, self.y_test = partitions[0].export_arrays(train=False)
         else:
@@ -226,6 +240,14 @@ class MeshSimulation:
 
     # --- jitted round body ---------------------------------------------------
 
+    def _batch_loss(
+        self, params: Pytree, bx: jax.Array, by: jax.Array, bw: jax.Array
+    ) -> jax.Array:
+        logits = self.apply_fn(params, bx)
+        if self.task == "lm":
+            return masked_lm_loss(logits, bx, bw)
+        return softmax_cross_entropy(logits, by, bw)
+
     def _local_train(
         self, params: Pytree, opt_state: Pytree, key: jax.Array, x: jax.Array,
         y: jax.Array, w: jax.Array, epochs: int
@@ -246,7 +268,7 @@ class MeshSimulation:
                 bx, by, bw = batch
 
                 def loss_fn(pp):
-                    return softmax_cross_entropy(self.apply_fn(pp, bx), by, bw)
+                    return self._batch_loss(pp, bx, by, bw)
 
                 loss, grads = jax.value_and_grad(loss_fn)(p)
                 updates, s2 = self.optimizer.update(grads, s, p)
@@ -289,7 +311,12 @@ class MeshSimulation:
         opt_stack = jax.tree.map(lambda a, u: a.at[committee].set(u), opt_stack, o_k)
 
         # Evaluate the aggregated model on the shared test split.
-        if xt is not None:
+        if xt is not None and self.task == "lm":
+            logits = self.apply_fn(agg, xt)  # [T, L, V]
+            loss = masked_lm_loss(logits, xt, jnp.ones(xt.shape[0], jnp.float32))
+            pred = jnp.argmax(logits[:, :-1], axis=-1)
+            acc = jnp.mean((pred == xt[:, 1:]).astype(jnp.float32))
+        elif xt is not None:
             logits = self.apply_fn(agg, xt)
             loss = softmax_cross_entropy(logits, yt, jnp.ones_like(yt, jnp.float32))
             acc = jnp.mean((jnp.argmax(logits, -1) == yt).astype(jnp.float32))

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 from typing import List
 
+import jax
 import jax.numpy as jnp
 
 from p2pfl_tpu.learning.aggregators.base import Aggregator
@@ -55,8 +56,12 @@ class Krum(Aggregator):
         sel = min(self.num_selected, n)
         stacked = agg_ops.tree_stack([m.params for m in models])
         weights = jnp.asarray([m.get_num_samples() for m in models], jnp.float32)
-        out = agg_ops.krum(
+        out, idx = agg_ops.krum(
             stacked, weights, num_byzantine=min(self.num_byzantine, n - 1), num_selected=sel
         )
-        contributors, total = self._merge_metadata(models)
+        # Provenance: only the *selected* models contributed to the output —
+        # stamping the full union would make downstream partial-aggregation
+        # dedup (base.py add_model) treat discarded Byzantine nodes as merged.
+        chosen = [models[i] for i in idx.tolist()]
+        contributors, total = self._merge_metadata(chosen)
         return models[0].build_copy(params=out, contributors=contributors, num_samples=total)

@@ -133,11 +133,16 @@ def krum_select(stacked: Pytree, num_byzantine: int, num_selected: int = 1) -> j
 
 
 @partial(jax.jit, static_argnames=("num_byzantine", "num_selected"))
-def krum(stacked: Pytree, weights: jax.Array, num_byzantine: int, num_selected: int = 1) -> Pytree:
-    """Multi-Krum aggregation: average the selected models (sample-weighted)."""
+def krum(
+    stacked: Pytree, weights: jax.Array, num_byzantine: int, num_selected: int = 1
+) -> tuple[Pytree, jax.Array]:
+    """Multi-Krum aggregation: average the selected models (sample-weighted).
+
+    Returns ``(aggregated, selected_indices)`` — callers need the indices
+    for contributor provenance (only the selected models contributed)."""
     idx = krum_select(stacked, num_byzantine, num_selected)
     sel = jax.tree.map(lambda x: x[idx], stacked)
-    return fedavg(sel, jnp.asarray(weights, dtype=jnp.float32)[idx])
+    return fedavg(sel, jnp.asarray(weights, dtype=jnp.float32)[idx]), idx
 
 
 @jax.jit

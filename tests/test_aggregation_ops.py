@@ -65,8 +65,9 @@ def test_krum_excludes_byzantine():
     stacked = agg.tree_stack(models)
     idx = np.asarray(agg.krum_select(stacked, num_byzantine=2, num_selected=3))
     assert set(idx.tolist()) <= {0, 1, 2, 3, 4}
-    out = agg.krum(stacked, np.ones((7,), np.float32), num_byzantine=2, num_selected=3)
+    out, sel = agg.krum(stacked, np.ones((7,), np.float32), num_byzantine=2, num_selected=3)
     assert np.abs(np.asarray(out["p"]) - base).max() < 1.0
+    np.testing.assert_array_equal(np.sort(np.asarray(sel)), np.sort(idx))
 
 
 def test_scaffold_update():

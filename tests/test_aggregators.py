@@ -140,6 +140,21 @@ def test_krum_rule_picks_clustered():
     assert abs(float(np.asarray(out.params["w"])[0, 0])) < 2.0
 
 
+def test_krum_contributors_are_selected_only():
+    """Krum output provenance must cover only the models that were actually
+    averaged — stamping discarded Byzantine nodes as contributors would make
+    partial-aggregation dedup treat them as merged."""
+    agg = Krum(num_byzantine=1, num_selected=2)
+    agg.set_nodes_to_aggregate(["a", "b", "c", "d"])
+    for v, n in [(1.0, "a"), (1.01, "b"), (0.99, "c"), (500.0, "d")]:
+        agg.add_model(_model(v, [n], num_samples=10))
+    out = agg.wait_and_get_aggregation(timeout=1)
+    contributors = out.get_contributors()
+    assert len(contributors) == 2
+    assert "d" not in contributors  # the outlier must not be stamped
+    assert out.get_num_samples() == 20  # sum over selected models only
+
+
 def test_scaffold_aggregation_roundtrip():
     agg = Scaffold(global_lr=1.0)
     agg.set_nodes_to_aggregate(["a", "b"])

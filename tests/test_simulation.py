@@ -66,13 +66,38 @@ def test_simulation_rounds_chunking_equivalent(parts16):
 
 
 def test_simulation_on_explicit_tp_mesh(parts16):
-    """nodes x model mesh: population DP + tensor parallelism compile+run."""
+    """nodes x model mesh: population DP + tensor parallelism compile+run,
+    with the kernels *actually* partitioned over the ``model`` axis (a silent
+    fallback to full replication must fail this test)."""
     mesh = make_mesh((4, 2), ("nodes", "model"))
     sim = MeshSimulation(
         mlp_model(seed=0), parts16, train_set_size=4, batch_size=32, seed=1, mesh=mesh
     )
+    # At least one dense kernel must be sharded over the model axis: its
+    # addressable shards must cover only 1/tp of the output dim.
+    tp_leaves = [
+        leaf
+        for leaf in jax.tree.leaves(sim.params_stack)
+        if leaf.ndim >= 3 and "model" in leaf.sharding.spec
+    ]
+    assert tp_leaves, "no parameter leaf is partitioned over the model axis"
+    for leaf in tp_leaves:
+        shard_shape = leaf.addressable_shards[0].data.shape
+        assert shard_shape[-1] == leaf.shape[-1] // 2, (
+            f"leaf {leaf.shape} shard {shard_shape}: output dim not split over model axis"
+        )
+        assert shard_shape[0] == leaf.shape[0] // 4  # nodes axis split too
+
     res = sim.run(rounds=1, epochs=1, warmup=False)
     assert np.isfinite(res.test_loss[-1])
+    # Population state must still be TP-sharded after the round (the round
+    # body must not have gathered everything onto every device).
+    post = [
+        leaf
+        for leaf in jax.tree.leaves(sim.params_stack)
+        if leaf.ndim >= 3 and "model" in leaf.sharding.spec
+    ]
+    assert post, "round body dropped the model-axis sharding"
 
 
 def test_simulation_all_nodes_equal_after_diffusion(parts16):

@@ -64,6 +64,14 @@ class Settings:
     VOTE_TIMEOUT: float = _env_override("VOTE_TIMEOUT", 60.0)
     AGGREGATION_TIMEOUT: float = _env_override("AGGREGATION_TIMEOUT", 300.0)
 
+    # --- nodes-mode learner executor ----------------------------------------
+    # Concurrent fit/eval jobs across all in-process nodes (the reference
+    # sizes its Ray actor pool from cluster resources,
+    # simulation/utils.py:33-96). 0 disables wrapping (inline fit).
+    EXECUTOR_MAX_WORKERS: int = _env_override(
+        "EXECUTOR_MAX_WORKERS", max(2, min(32, os.cpu_count() or 4))
+    )
+
     # --- observability ------------------------------------------------------
     LOG_LEVEL: str = _env_override("LOG_LEVEL", "INFO")
     LOG_DIR: str = _env_override("LOG_DIR", "logs")

@@ -32,6 +32,7 @@ from p2pfl_tpu.comm.commands.impl import (
 )
 from p2pfl_tpu.comm.memory.memory_protocol import InMemoryCommunicationProtocol
 from p2pfl_tpu.comm.protocol import CommunicationProtocol
+from p2pfl_tpu.config import Settings
 from p2pfl_tpu.exceptions import LearningRunningException, ZeroRoundsException
 from p2pfl_tpu.learning.aggregators import Aggregator, FedAvg
 from p2pfl_tpu.learning.dataset.dataset import FederatedDataset
@@ -52,6 +53,12 @@ class Node:
         learner: learner class (default :class:`JaxLearner`).
         aggregator: aggregation rule instance (default :class:`FedAvg`).
         protocol: communication protocol class (default in-memory).
+        executor: fit/eval execution venue. ``True`` (default) submits jobs
+            to the process-shared :class:`~p2pfl_tpu.parallel.executor.
+            LearnerExecutor` (capacity-bounded, crash-isolated — the
+            reference wraps learners in Ray virtual learners the same way,
+            simulation/__init__.py:14-31); pass a ``LearnerExecutor`` to
+            share an explicit pool, or ``False`` for inline fit.
         learner_kwargs: forwarded to the learner constructor.
     """
 
@@ -63,6 +70,7 @@ class Node:
         learner: Type[Learner] = JaxLearner,
         aggregator: Optional[Aggregator] = None,
         protocol: Type[CommunicationProtocol] = InMemoryCommunicationProtocol,
+        executor=True,
         **learner_kwargs,
     ) -> None:
         self.protocol = protocol(addr)
@@ -75,6 +83,11 @@ class Node:
         self.learner: Learner = learner(
             model=model, data=data, self_addr=self.addr, **learner_kwargs
         )
+        if executor and Settings.EXECUTOR_MAX_WORKERS > 0:
+            from p2pfl_tpu.parallel.executor import LearnerExecutor, VirtualNodeLearner
+
+            pool = executor if isinstance(executor, LearnerExecutor) else None
+            self.learner = VirtualNodeLearner(self.learner, pool, addr=self.addr)
         self.state.learner = self.learner
         self.learner.metric_reporter = self._report_learner_metric
 
@@ -162,6 +175,15 @@ class Node:
             self.protocol.build_msg(
                 StartLearningCommand.get_name(), args=[str(rounds), str(epochs)]
             )
+        )
+        # The initiator's weights seed the federation: mark our model
+        # initialized and announce it; every other node adopts these weights
+        # via InitModelCommand before round 0 (reference node.py:366-368 +
+        # init_model_command.py:31-97) — a common round-0 starting point is
+        # what SCAFFOLD's control-variate math assumes.
+        self.state.model_initialized_event.set()
+        self.protocol.broadcast(
+            self.protocol.build_msg(ModelInitializedCommand.get_name())
         )
         self.start_learning_thread(rounds, epochs)
 

@@ -1,6 +1,7 @@
 """Mesh-scale execution: sharded population simulation, mesh helpers,
-sequence/context parallelism."""
+sequence/context parallelism, nodes-mode learner executor."""
 
+from p2pfl_tpu.parallel.executor import LearnerExecutor, VirtualNodeLearner  # noqa: F401
 from p2pfl_tpu.parallel.mesh import make_mesh  # noqa: F401
 from p2pfl_tpu.parallel.simulation import MeshSimulation  # noqa: F401
 from p2pfl_tpu.parallel.sequence import (  # noqa: F401

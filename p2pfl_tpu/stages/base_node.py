@@ -42,9 +42,26 @@ class StartLearningStage(Stage):
     @staticmethod
     def execute(node: "Node") -> Optional[Type[Stage]]:
         state = node.state
-        # Our nodes are constructed with a model; announce it.
-        state.model_initialized_event.set()
-        node.protocol.broadcast(node.protocol.build_msg(ModelInitializedCommand.get_name()))
+        # Wait until this node holds an initialized model: the initiator set
+        # the event in set_start_learning; everyone else adopts the
+        # initiator's weights via InitModelCommand (which announces for us).
+        # Mirrors the reference's model_initialized_lock wait
+        # (start_learning_stage.py:44-84) — a shared round-0 starting model
+        # is required for SCAFFOLD and for meaningful FedAvg round counts.
+        deadline = time.time() + Settings.VOTE_TIMEOUT
+        while not state.model_initialized_event.wait(timeout=0.5):
+            if check_early_stop(node):
+                return None
+            if time.time() >= deadline:
+                log.warning(
+                    "%s: init-model wait timed out — proceeding with local weights",
+                    node.addr,
+                )
+                state.model_initialized_event.set()
+                node.protocol.broadcast(
+                    node.protocol.build_msg(ModelInitializedCommand.get_name())
+                )
+                break
         # Let heartbeats propagate membership before voting
         # (reference start_learning_stage.py:78-84).
         time.sleep(Settings.WAIT_HEARTBEATS_CONVERGENCE)

@@ -1,0 +1,188 @@
+"""Nodes-mode learner executor (replaces the reference's Ray actor-pool
+tests, test/simulation/actor_pool_test.py:183-232 and
+virtual_node_learner_test.py:32-126): capacity bounds, queueing, crash
+isolation, wrapper delegation, and a 20-node in-memory federation."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+from p2pfl_tpu.learning.learner import JaxLearner, Learner
+from p2pfl_tpu.models import mlp_model
+from p2pfl_tpu.parallel.executor import LearnerExecutor, VirtualNodeLearner
+
+
+class SlowLearner(Learner):
+    """Test double: fit sleeps; records concurrency."""
+
+    active = 0
+    peak = 0
+    _class_lock = threading.Lock()
+
+    def __init__(self, delay=0.3, fail=False):
+        super().__init__()
+        self.delay = delay
+        self.fail = fail
+        self.fits = 0
+
+    def fit(self):
+        with SlowLearner._class_lock:
+            SlowLearner.active += 1
+            SlowLearner.peak = max(SlowLearner.peak, SlowLearner.active)
+        try:
+            if self.fail:
+                raise RuntimeError("boom")
+            time.sleep(self.delay)
+            self.fits += 1
+            return None
+        finally:
+            with SlowLearner._class_lock:
+                SlowLearner.active -= 1
+
+    def interrupt_fit(self):
+        pass
+
+    def evaluate(self):
+        return {"test_acc": 1.0}
+
+    def get_framework(self):
+        return "test"
+
+
+def test_capacity_bound_and_queueing():
+    SlowLearner.active = SlowLearner.peak = 0
+    ex = LearnerExecutor(max_workers=2)
+    try:
+        learners = [SlowLearner(delay=0.2) for _ in range(6)]
+        for i, ln in enumerate(learners):
+            ex.submit("fit", f"n{i}", ln)
+        for i in range(6):
+            ex.get_result(f"n{i}", timeout=10)
+        assert SlowLearner.peak <= 2  # capacity bound held
+        assert all(ln.fits == 1 for ln in learners)
+        assert ex.stats()["jobs_done"] == 6
+    finally:
+        ex.shutdown()
+
+
+def test_crash_isolation():
+    """A raising learner fails only its own future; the pool keeps serving."""
+    ex = LearnerExecutor(max_workers=2)
+    try:
+        ex.submit("fit", "bad", SlowLearner(fail=True))
+        with pytest.raises(RuntimeError, match="boom"):
+            ex.get_result("bad", timeout=10)
+        ok = SlowLearner(delay=0.05)
+        ex.submit("fit", "good", ok)
+        ex.get_result("good", timeout=10)
+        assert ok.fits == 1
+        stats = ex.stats()
+        assert stats["jobs_failed"] == 1 and stats["jobs_done"] == 2
+    finally:
+        ex.shutdown()
+
+
+def test_virtual_learner_delegates_and_executes():
+    data = synthetic_mnist(n_train=256, n_test=64)
+    ex = LearnerExecutor(max_workers=2)
+    try:
+        inner = JaxLearner(mlp_model(seed=0), data, "v0", batch_size=32)
+        virt = VirtualNodeLearner(inner, ex, addr="v0")
+        virt.set_epochs(1)
+        assert virt.epochs == 1 and inner.epochs == 1
+        virt.fit()
+        assert virt.get_model() is inner.get_model()
+        assert virt.get_model().get_contributors() == ["v0"]
+        metrics = virt.evaluate()
+        assert "test_acc" in metrics
+        assert virt.get_framework() == "jax"
+        virt.interrupt_fit()  # must not raise (upgrade over reference)
+    finally:
+        ex.shutdown()
+
+
+def test_device_placement_round_robin():
+    """Jobs are pinned round-robin onto JAX devices (TPU-native analogue of
+    per-actor device fractions)."""
+    import jax
+
+    devices = jax.devices()[:4]
+    ex = LearnerExecutor(max_workers=4, devices=devices)
+    try:
+        data = synthetic_mnist(n_train=128, n_test=32)
+        learners = [JaxLearner(mlp_model(seed=i), data, f"d{i}", batch_size=32) for i in range(4)]
+        for i, ln in enumerate(learners):
+            ex.submit("fit", f"d{i}", ln)
+        for i in range(4):
+            ex.get_result(f"d{i}", timeout=60)
+        for ln in learners:
+            assert ln.get_model().get_contributors()
+    finally:
+        ex.shutdown()
+
+
+def test_20_node_federation_bounded_and_crash_tolerant():
+    """20 nodes share one capacity-8 executor; per-round wall-clock stays
+    bounded and the federation survives a learner raising mid-fit
+    (VERDICT round-2 ask #2 done-condition)."""
+    from p2pfl_tpu.node import Node
+    from p2pfl_tpu.utils.utils import wait_convergence, wait_to_finish
+
+    n_nodes = 20
+    Settings.RESOURCE_MONITOR_PERIOD = 0
+    data = synthetic_mnist(n_train=64 * n_nodes, n_test=64)
+    parts = data.generate_partitions(n_nodes, RandomIIDPartitionStrategy)
+    ex = LearnerExecutor(max_workers=8)
+
+    crashed = {"done": False}
+    crash_lock = threading.Lock()
+
+    class CrashingLearner(JaxLearner):
+        """First fit in the whole federation raises; everyone else trains."""
+
+        def fit(self):
+            with crash_lock:
+                first = not crashed["done"]
+                crashed["done"] = True
+            if first:
+                raise RuntimeError("injected mid-fit crash")
+            return super().fit()
+
+    nodes = []
+    try:
+        with Settings.overridden(TRAIN_SET_SIZE=6):
+            for i in range(n_nodes):
+                nodes.append(
+                    Node(
+                        mlp_model(seed=i),
+                        parts[i],
+                        learner=CrashingLearner,
+                        executor=ex,
+                        batch_size=32,
+                    )
+                )
+            for n in nodes:
+                n.start()
+            for i in range(1, n_nodes):
+                nodes[i].connect(nodes[0].addr)
+            wait_convergence(nodes, n_nodes - 1, wait=15)
+            t0 = time.monotonic()
+            nodes[0].set_start_learning(rounds=1, epochs=1)
+            wait_to_finish(nodes, timeout=180)
+            elapsed = time.monotonic() - t0
+            assert crashed["done"]
+            # capacity-8 pool, committee of 6: one fit wave + the 30s
+            # aggregation-timeout worst case for peers of the crashed node
+            assert elapsed < 120, f"round took {elapsed}s"
+            stats = ex.stats()
+            assert stats["peak_active"] <= 8
+            assert stats["jobs_done"] >= 6
+            assert stats["jobs_failed"] == 1  # pool survived the crash
+    finally:
+        for n in nodes:
+            n.stop()
+        ex.shutdown()

@@ -6,17 +6,29 @@ TF, FlaxLearner — learner_factory.py:24-56): the federation protocol only
 moves flat numpy weight lists, so any framework that can load/dump its
 parameters as numpy can join. The TPU-native :class:`JaxLearner` stays the
 first-class path; interop backends let reference users migrate
-incrementally (bring a torch nn.Module today, port to flax when ready).
+incrementally (bring a torch nn.Module or keras.Model today, port to flax
+when ready).
 
 Backends register themselves with :class:`LearnerFactory` on import when
-their framework is importable; TensorFlow isn't in this image, so only the
-torch backend is live (gate pattern per the environment constraints).
+their framework is importable (gate pattern per the environment
+constraints); both torch (CPU) and TensorFlow/Keras are live in this image.
 """
 
+from p2pfl_tpu.learning.interop.keras_backend import (  # noqa: F401
+    KerasLearner,
+    KerasModelHandle,
+    jax_mlp_params_to_keras,
+    keras_mlp_from_wire,
+    keras_mlp_model,
+    keras_mlp_to_wire,
+    keras_weights_to_jax_mlp,
+)
 from p2pfl_tpu.learning.interop.torch_backend import (  # noqa: F401
     TorchLearner,
     TorchModelHandle,
     jax_mlp_params_to_torch,
+    torch_mlp_from_wire,
     torch_mlp_model,
+    torch_mlp_to_wire,
     torch_state_dict_to_jax_mlp,
 )

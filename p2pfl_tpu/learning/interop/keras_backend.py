@@ -1,0 +1,374 @@
+"""TensorFlow/Keras interop: a keras-backed ModelHandle + Learner.
+
+Parity with the reference's TensorFlow backend (p2pfl/learning/frameworks/
+tensorflow/keras_model.py:44-119 get/set_weights<->numpy, keras_learner.py:
+36-124 fit/evaluate): ``keras.Model.get_weights()`` is the parameter pytree
+(a flat list of numpy arrays), so the gossip/aggregation machinery — numpy
+weight lists over the PFLT wire format — is shared unchanged with JAX and
+torch nodes. Training runs an eager GradientTape loop on host CPU; this is
+the migration path for reference Keras users, while the TPU-native path
+stays :class:`~p2pfl_tpu.learning.learner.JaxLearner`.
+
+SCAFFOLD is supported in the same loop (gradient correction ``g + c - c_i``
+per step, delta emission at fit end) — exceeding the reference, whose Keras
+SCAFFOLD needs a separate optimizer-wrapper class
+(tensorflow/callbacks/scaffold_callback.py:30-163).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from p2pfl_tpu.learning.dataset.dataset import FederatedDataset
+from p2pfl_tpu.learning.learner import Learner, LearnerFactory
+from p2pfl_tpu.models.model_handle import ModelHandle
+
+try:  # TF/keras are in the image; gate anyway per environment rules
+    import keras
+    import tensorflow as tf
+
+    KERAS_AVAILABLE = True
+except ImportError:  # pragma: no cover
+    keras = None
+    tf = None
+    KERAS_AVAILABLE = False
+
+
+def _require_keras() -> None:
+    if not KERAS_AVAILABLE:
+        raise ImportError(
+            "TensorFlow/Keras is not available; install tensorflow or use "
+            "the JAX backend"
+        )
+
+
+class KerasModelHandle(ModelHandle):
+    """ModelHandle whose parameters are a keras model's weight list.
+
+    The pytree is the flat ``get_weights()`` list (stable variable order —
+    reference keras_model.py:44-66 uses the same contract); ``apply_fn``
+    runs the model forward on numpy batches so evaluation works through the
+    same interface as JAX handles.
+    """
+
+    framework = "tensorflow"
+
+    def __init__(
+        self,
+        model: "keras.Model",
+        to_wire: Optional[Any] = None,
+        from_wire: Optional[Any] = None,
+        **kwargs: Any,
+    ) -> None:
+        _require_keras()
+        self.keras_model = model
+        self._to_wire = to_wire
+        self._from_wire = from_wire
+        params = [np.asarray(w).copy() for w in model.get_weights()]
+
+        def apply_fn(params: List[np.ndarray], x: np.ndarray) -> np.ndarray:
+            self._load(params)
+            out = model(np.asarray(x, np.float32), training=False)
+            return np.asarray(out)
+
+        super().__init__(params=params, apply_fn=apply_fn, model_def=model, **kwargs)
+
+    def _load(self, params: Optional[List[np.ndarray]] = None) -> None:
+        """Push the handle's numpy params into the live keras model."""
+        params = self.params if params is None else params
+        self.keras_model.set_weights([np.asarray(p) for p in params])
+
+    def pull_from_model(self) -> None:
+        """Refresh the handle's numpy params from the live keras model."""
+        self.params = [np.asarray(w).copy() for w in self.keras_model.get_weights()]
+
+    # --- canonical wire layout (heterogeneous federations) -------------------
+
+    def encode_parameters(self) -> bytes:
+        if self._to_wire is None:
+            return super().encode_parameters()
+        if "scaffold" in self.additional_info or "scaffold_server" in self.additional_info:
+            raise ValueError(
+                "SCAFFOLD payloads cannot cross the canonical wire: their "
+                "leaves are framework-layout specific (use a homogeneous "
+                "federation for the Scaffold aggregator)"
+            )
+        from p2pfl_tpu.ops.serialization import serialize_arrays
+
+        return serialize_arrays(
+            [np.asarray(a) for a in self._to_wire(self.params)],
+            {
+                "contributors": self.contributors,
+                "num_samples": self.num_samples,
+                "additional_info": self.additional_info,
+            },
+        )
+
+    def set_parameters(self, params) -> None:
+        if self._from_wire is not None and isinstance(
+            params, (bytes, bytearray, memoryview)
+        ):
+            from p2pfl_tpu.ops.serialization import deserialize_arrays
+
+            arrays, meta = deserialize_arrays(bytes(params))
+            self.contributors = list(meta.get("contributors", self.contributors))
+            self.num_samples = int(meta.get("num_samples", self.num_samples))
+            self.additional_info.update(meta.get("additional_info", {}))
+            return super().set_parameters(self._from_wire(list(arrays)))
+        return super().set_parameters(params)
+
+    def build_copy(self, params=None, contributors=None, num_samples=None):
+        # Each copy gets its own keras model: apply_fn pushes the handle's
+        # params into its model, so sharing one would let copies clobber each
+        # other (and a learner mid-fit) through set_weights.
+        clone = keras.models.clone_model(self.keras_model)
+        if not clone.built and self.keras_model.built:
+            clone.build(self.keras_model.input_shape)
+        clone.set_weights(self.keras_model.get_weights())
+        copy = KerasModelHandle(
+            clone,
+            to_wire=self._to_wire,
+            from_wire=self._from_wire,
+            contributors=contributors if contributors is not None else list(self.contributors),
+            num_samples=num_samples if num_samples is not None else self.num_samples,
+            additional_info=dict(self.additional_info),
+        )
+        copy.set_parameters(self.params if params is None else params)
+        return copy
+
+
+class KerasLearner(Learner):
+    """Eager TF trainer with the reference learner's contract (fit updates
+    the handle in place with params + contribution metadata; interrupt_fit
+    takes effect between epochs — reference keras_learner.py:36-124).
+
+    Supports the ``scaffold`` callback: per-step gradient correction
+    ``g + c - c_i`` and delta_y/delta_c emission into ``additional_info``
+    (same contract as ``JaxLearner.fit``).
+    """
+
+    SUPPORTED_CALLBACKS: Sequence[str] = ("scaffold",)
+
+    def __init__(
+        self,
+        model: Optional[ModelHandle] = None,
+        data: Optional[FederatedDataset] = None,
+        self_addr: str = "unknown-node",
+        lr: float = 1e-3,
+        batch_size: int = 64,
+        seed: int = 0,
+        callbacks: Optional[List[str]] = None,
+    ) -> None:
+        _require_keras()
+        super().__init__(model, data, self_addr)
+        self.lr = float(lr)
+        self.batch_size = int(batch_size)
+        self.seed = int(seed)
+        self.callbacks = list(callbacks or [])
+        for cb in self.callbacks:
+            if cb not in self.SUPPORTED_CALLBACKS:
+                raise ValueError(f"unsupported callback {cb!r}")
+        self._scaffold = "scaffold" in self.callbacks
+        self._scaffold_c_i: Optional[List[np.ndarray]] = None
+        self._interrupt = threading.Event()
+        self._fit_count = 0
+
+    def get_framework(self) -> str:
+        return "tensorflow"
+
+    def interrupt_fit(self) -> None:
+        self._interrupt.set()
+
+    def _handle(self) -> KerasModelHandle:
+        model = self.get_model()
+        if not isinstance(model, KerasModelHandle):
+            raise TypeError("KerasLearner requires a KerasModelHandle")
+        return model
+
+    def fit(self) -> ModelHandle:
+        model = self._handle()
+        self._interrupt.clear()
+        t0 = time.monotonic()
+        keras.utils.set_random_seed(self.seed + self._fit_count)
+        epoch_seed = self.seed + 1000 * self._fit_count
+        self._fit_count += 1
+
+        model._load()
+        km = model.keras_model
+        opt = keras.optimizers.Adam(self.lr)
+        # get_weights() order == km.weights order; grads come per trainable
+        # variable, so map each trainable var to its weight-list index.
+        weight_index = {id(v): i for i, v in enumerate(km.weights)}
+
+        if self._scaffold:
+            if model._to_wire is not None:
+                raise ValueError(
+                    "SCAFFOLD is not supported on canonical-wire (heterogeneous"
+                    " federation) handles: control-variate payloads are"
+                    " framework-layout specific"
+                )
+            anchor = [np.asarray(w, np.float32).copy() for w in km.get_weights()]
+            c_global = [np.zeros_like(a) for a in anchor]
+            if self._scaffold_c_i is None:
+                self._scaffold_c_i = [np.zeros_like(a) for a in anchor]
+            server = model.get_info("scaffold_server", {}) or {}
+            if "global_c" in server:
+                c_global = [np.asarray(a, np.float32) for a in server["global_c"]]
+            corrections = [
+                tf.constant(c - ci) for c, ci in zip(c_global, self._scaffold_c_i)
+            ]
+
+        total_steps = 0
+        for epoch in range(self.epochs):
+            if self._interrupt.is_set():
+                break
+            xb, yb, wb = self.get_data().export_batches(
+                self.batch_size, train=True, seed=epoch_seed + epoch
+            )
+            losses = []
+            for x, y, w in zip(xb, yb, wb):
+                xt = tf.constant(np.asarray(x, np.float32))
+                yt = tf.constant(np.asarray(y, np.int32))
+                wt = tf.constant(np.asarray(w, np.float32))
+                with tf.GradientTape() as tape:
+                    logits = km(xt, training=True)
+                    per = tf.nn.sparse_softmax_cross_entropy_with_logits(
+                        labels=yt, logits=logits
+                    )
+                    loss = tf.reduce_sum(per * wt) / tf.maximum(
+                        tf.reduce_sum(wt), 1.0
+                    )
+                grads = tape.gradient(loss, km.trainable_variables)
+                if self._scaffold:
+                    grads = [
+                        g + corrections[weight_index[id(v)]]
+                        for g, v in zip(grads, km.trainable_variables)
+                    ]
+                opt.apply_gradients(zip(grads, km.trainable_variables))
+                losses.append(float(loss))
+                total_steps += 1
+            self.report("train_loss", float(np.mean(losses)), step=epoch)
+
+        model.pull_from_model()
+        model.set_contribution([self._self_addr], self.get_data().get_num_samples(True))
+
+        if self._scaffold and total_steps > 0:
+            # c_i' = c_i - c + (x - y)/(K*lr); deltas ride in additional_info
+            # (contract of the Scaffold aggregator, aggregators/scaffold.py).
+            scale = 1.0 / (total_steps * self.lr)
+            final = [np.asarray(w, np.float32) for w in model.params]
+            delta_y = [f - a for f, a in zip(final, anchor)]
+            c_i_new = [
+                ci - c - dy * scale
+                for ci, c, dy in zip(self._scaffold_c_i, c_global, delta_y)
+            ]
+            delta_c = [n - o for n, o in zip(c_i_new, self._scaffold_c_i)]
+            self._scaffold_c_i = c_i_new
+            model.add_info("scaffold", {"delta_y_i": delta_y, "delta_c_i": delta_c})
+
+        self.report("fit_time_s", time.monotonic() - t0)
+        return model
+
+    def evaluate(self) -> Dict[str, float]:
+        model = self._handle()
+        try:
+            xb, yb, wb = self.get_data().export_batches(
+                self.batch_size, train=False, seed=0
+            )
+        except KeyError:
+            return {}
+        model._load()
+        km = model.keras_model
+        tot_loss = tot_correct = tot_n = 0.0
+        for x, y, w in zip(xb, yb, wb):
+            logits = np.asarray(km(np.asarray(x, np.float32), training=False))
+            yt = np.asarray(y, np.int64)
+            wt = np.asarray(w, np.float32)
+            logp = logits - logits.max(-1, keepdims=True)
+            logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
+            per = -logp[np.arange(len(yt)), yt]
+            tot_loss += float((per * wt).sum())
+            tot_correct += float(((logits.argmax(-1) == yt) * wt).sum())
+            tot_n += float(wt.sum())
+        tot_n = max(tot_n, 1.0)
+        metrics = {"test_loss": tot_loss / tot_n, "test_acc": tot_correct / tot_n}
+        for k, v in metrics.items():
+            self.report(k, v)
+        return metrics
+
+
+# --- model zoo translation ----------------------------------------------------
+
+
+def keras_mlp_to_wire(weights: Sequence[np.ndarray]) -> List[np.ndarray]:
+    """Canonical (flax-leaf-order) wire layout for the keras MLP twin: per
+    Dense layer ``bias, kernel`` (keras kernels are already ``[in, out]``)."""
+    leaves: List[np.ndarray] = []
+    for i in range(len(weights) // 2):
+        leaves += [np.asarray(weights[2 * i + 1]), np.asarray(weights[2 * i])]
+    return leaves
+
+
+def keras_mlp_from_wire(leaves: Sequence[np.ndarray]) -> List[np.ndarray]:
+    """Inverse of :func:`keras_mlp_to_wire`."""
+    weights: List[np.ndarray] = []
+    for i in range(len(leaves) // 2):
+        weights += [np.asarray(leaves[2 * i + 1]), np.asarray(leaves[2 * i])]
+    return weights
+
+
+def keras_mlp_model(
+    seed: int = 0,
+    hidden_sizes: Sequence[int] = (256, 128),
+    out_channels: int = 10,
+    in_shape: Sequence[int] = (28, 28),
+    canonical: bool = False,
+) -> KerasModelHandle:
+    """Keras twin of :func:`p2pfl_tpu.models.mlp_model` (same architecture as
+    the reference's per-framework MLPs, keras_model.py:121-168).
+
+    With ``canonical=True`` the handle speaks the flax-layout wire format so
+    it can federate with JAX and torch MLP nodes (heterogeneous federation).
+    """
+    _require_keras()
+    keras.utils.set_random_seed(seed)
+    layers: List[Any] = [keras.Input(shape=tuple(in_shape)), keras.layers.Flatten()]
+    for h in hidden_sizes:
+        layers.append(keras.layers.Dense(h, activation="relu"))
+    layers.append(keras.layers.Dense(out_channels))
+    return KerasModelHandle(
+        keras.Sequential(layers),
+        to_wire=keras_mlp_to_wire if canonical else None,
+        from_wire=keras_mlp_from_wire if canonical else None,
+    )
+
+
+def keras_weights_to_jax_mlp(weights: Sequence[np.ndarray]) -> Dict[str, Any]:
+    """Translate keras MLP weights into flax MLP params. Keras ``Dense``
+    kernels are already ``[in, out]`` (flax convention) — only re-nesting
+    into the linen naming scheme is needed."""
+    params: Dict[str, Any] = {}
+    for i in range(len(weights) // 2):
+        params[f"Dense_{i}"] = {
+            "kernel": np.asarray(weights[2 * i]).copy(),
+            "bias": np.asarray(weights[2 * i + 1]).copy(),
+        }
+    return {"params": params}
+
+
+def jax_mlp_params_to_keras(params: Dict[str, Any]) -> List[np.ndarray]:
+    """Inverse of :func:`keras_weights_to_jax_mlp`."""
+    inner = params.get("params", params)
+    out: List[np.ndarray] = []
+    for name in sorted(inner, key=lambda n: int(n.split("_")[1])):
+        out.append(np.asarray(inner[name]["kernel"]).copy())
+        out.append(np.asarray(inner[name]["bias"]).copy())
+    return out
+
+
+if KERAS_AVAILABLE:
+    LearnerFactory.register("tensorflow", KerasLearner)

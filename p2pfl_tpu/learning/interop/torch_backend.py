@@ -57,13 +57,28 @@ class TorchModelHandle(ModelHandle):
     The pytree is ``{name: np.ndarray}`` in state_dict order; ``apply_fn``
     runs the module forward under ``torch.no_grad`` on numpy batches, so
     evaluation works through the same interface as JAX handles.
+
+    ``to_wire`` / ``from_wire`` optionally translate between the native
+    state_dict leaves and a *canonical* cross-framework wire layout, letting
+    torch nodes join a heterogeneous federation with JAX/keras nodes (the
+    reference cannot mix frameworks — its weight lists are framework-layout
+    specific). For the MLP twin, :func:`torch_mlp_model` wires the exact
+    flax-layout translation in via ``canonical=True``.
     """
 
     framework = "pytorch"
 
-    def __init__(self, module: "nn.Module", **kwargs: Any) -> None:
+    def __init__(
+        self,
+        module: "nn.Module",
+        to_wire: Optional[Any] = None,
+        from_wire: Optional[Any] = None,
+        **kwargs: Any,
+    ) -> None:
         _require_torch()
         self.module = module
+        self._to_wire = to_wire
+        self._from_wire = from_wire
         params = {
             k: v.detach().cpu().numpy().copy() for k, v in module.state_dict().items()
         }
@@ -95,12 +110,49 @@ class TorchModelHandle(ModelHandle):
             for k, v in self.module.state_dict().items()
         }
 
+    # --- canonical wire layout (heterogeneous federations) -------------------
+
+    def encode_parameters(self) -> bytes:
+        if self._to_wire is None:
+            return super().encode_parameters()
+        if "scaffold" in self.additional_info or "scaffold_server" in self.additional_info:
+            raise ValueError(
+                "SCAFFOLD payloads cannot cross the canonical wire: their "
+                "leaves are framework-layout specific (use a homogeneous "
+                "federation for the Scaffold aggregator)"
+            )
+        from p2pfl_tpu.ops.serialization import serialize_arrays
+
+        return serialize_arrays(
+            [np.asarray(a) for a in self._to_wire(self.params)],
+            {
+                "contributors": self.contributors,
+                "num_samples": self.num_samples,
+                "additional_info": self.additional_info,
+            },
+        )
+
+    def set_parameters(self, params) -> None:
+        if self._from_wire is not None and isinstance(
+            params, (bytes, bytearray, memoryview)
+        ):
+            from p2pfl_tpu.ops.serialization import deserialize_arrays
+
+            arrays, meta = deserialize_arrays(bytes(params))
+            self.contributors = list(meta.get("contributors", self.contributors))
+            self.num_samples = int(meta.get("num_samples", self.num_samples))
+            self.additional_info.update(meta.get("additional_info", {}))
+            return super().set_parameters(self._from_wire(list(arrays)))
+        return super().set_parameters(params)
+
     def build_copy(self, params=None, contributors=None, num_samples=None):
         # Each copy gets its own module: apply_fn pushes the handle's params
         # into its module, so sharing one would let copies clobber each other
         # (and a learner mid-fit) through load_state_dict.
         copy = TorchModelHandle(
             copy_module(self.module),
+            to_wire=self._to_wire,
+            from_wire=self._from_wire,
             contributors=contributors if contributors is not None else list(self.contributors),
             num_samples=num_samples if num_samples is not None else self.num_samples,
             additional_info=dict(self.additional_info),
@@ -113,9 +165,14 @@ class TorchLearner(Learner):
     """Eager torch CPU trainer with the reference learner's contract
     (fit updates the handle in place with params + contribution metadata;
     interrupt_fit takes effect between epochs — reference
-    lightning_learner.py:98-104 uses trainer.should_stop the same way)."""
+    lightning_learner.py:98-104 uses trainer.should_stop the same way).
 
-    SUPPORTED_CALLBACKS: Sequence[str] = ()
+    Supports the ``scaffold`` callback: per-step gradient correction
+    ``g + c - c_i`` and delta_y/delta_c emission into ``additional_info``
+    (same contract as ``JaxLearner.fit``; reference analogue:
+    pytorch/callbacks/scaffold_callback.py:32-155)."""
+
+    SUPPORTED_CALLBACKS: Sequence[str] = ("scaffold",)
 
     def __init__(
         self,
@@ -132,11 +189,12 @@ class TorchLearner(Learner):
         self.lr = float(lr)
         self.batch_size = int(batch_size)
         self.seed = int(seed)
-        if callbacks:
-            raise ValueError(
-                f"callbacks {callbacks!r} are not supported by TorchLearner "
-                "(use JaxLearner)"
-            )
+        self.callbacks = list(callbacks or [])
+        for cb in self.callbacks:
+            if cb not in self.SUPPORTED_CALLBACKS:
+                raise ValueError(f"unsupported callback {cb!r}")
+        self._scaffold = "scaffold" in self.callbacks
+        self._scaffold_c_i: Optional[Dict[str, np.ndarray]] = None
         self._interrupt = threading.Event()
         self._fit_count = 0
 
@@ -166,6 +224,32 @@ class TorchLearner(Learner):
         opt = torch.optim.Adam(module.parameters(), lr=self.lr)
         loss_fn = nn.CrossEntropyLoss(reduction="none")
 
+        # SCAFFOLD state covers the full state_dict (the aggregator
+        # unflattens deltas against the handle's params treedef); the
+        # per-step correction only touches entries that get gradients.
+        corrections: Dict[str, "torch.Tensor"] = {}
+        if self._scaffold:
+            if model._to_wire is not None:
+                raise ValueError(
+                    "SCAFFOLD is not supported on canonical-wire (heterogeneous"
+                    " federation) handles: control-variate payloads are"
+                    " framework-layout specific"
+                )
+            anchor = {k: np.asarray(v, np.float32).copy() for k, v in model.params.items()}
+            c_global = {k: np.zeros_like(a) for k, a in anchor.items()}
+            if self._scaffold_c_i is None:
+                self._scaffold_c_i = {k: np.zeros_like(a) for k, a in anchor.items()}
+            server = model.get_info("scaffold_server", {}) or {}
+            if "global_c" in server:
+                # Flat list in jax.tree leaf order of the params dict
+                # (sorted keys) — the same order the deltas are emitted in.
+                c_global = dict(zip(sorted(anchor), (np.asarray(a, np.float32) for a in server["global_c"])))
+            corrections = {
+                k: torch.from_numpy(c_global[k] - self._scaffold_c_i[k])
+                for k in anchor
+            }
+
+        total_steps = 0
         for epoch in range(self.epochs):
             if self._interrupt.is_set():
                 break
@@ -180,12 +264,40 @@ class TorchLearner(Learner):
                 wt = torch.from_numpy(np.asarray(w, np.float32))
                 loss = (per * wt).sum() / wt.sum().clamp(min=1.0)
                 loss.backward()
+                if self._scaffold:  # drift correction: g + c - c_i
+                    for name, p in module.named_parameters():
+                        if p.grad is not None:
+                            p.grad.add_(corrections[name])
                 opt.step()
                 losses.append(loss.item())
+                total_steps += 1
             self.report("train_loss", float(np.mean(losses)), step=epoch)
 
         model.pull_from_module()
         model.set_contribution([self._self_addr], self.get_data().get_num_samples(True))
+
+        if self._scaffold and total_steps > 0:
+            # c_i' = c_i - c + (x - y)/(K*lr); deltas ride in additional_info
+            # (contract of the Scaffold aggregator; JaxLearner.fit emits the
+            # same payload).
+            scale = 1.0 / (total_steps * self.lr)
+            keys = sorted(anchor)
+            final = {k: np.asarray(model.params[k], np.float32) for k in keys}
+            delta_y = {k: final[k] - anchor[k] for k in keys}
+            c_i_new = {
+                k: self._scaffold_c_i[k] - c_global[k] - delta_y[k] * scale
+                for k in keys
+            }
+            delta_c = {k: c_i_new[k] - self._scaffold_c_i[k] for k in keys}
+            self._scaffold_c_i = c_i_new
+            model.add_info(
+                "scaffold",
+                {
+                    "delta_y_i": [delta_y[k] for k in keys],
+                    "delta_c_i": [delta_c[k] for k in keys],
+                },
+            )
+
         self.report("fit_time_s", time.monotonic() - t0)
         return model
 
@@ -221,14 +333,39 @@ class TorchLearner(Learner):
 # --- model zoo translation ----------------------------------------------------
 
 
+def torch_mlp_to_wire(state: Dict[str, np.ndarray]) -> List[np.ndarray]:
+    """Canonical (flax-leaf-order) wire layout for the torch MLP twin:
+    per Dense layer ``bias, kernel`` with kernels transposed to ``[in, out]``
+    — exactly ``jax.tree.leaves`` order of the flax MLP params."""
+    nested = torch_state_dict_to_jax_mlp(state)["params"]
+    leaves: List[np.ndarray] = []
+    for name in sorted(nested):
+        leaves += [nested[name]["bias"], nested[name]["kernel"]]
+    return leaves
+
+
+def torch_mlp_from_wire(leaves: Sequence[np.ndarray]) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`torch_mlp_to_wire`."""
+    nested = {
+        f"Dense_{i}": {"bias": leaves[2 * i], "kernel": leaves[2 * i + 1]}
+        for i in range(len(leaves) // 2)
+    }
+    return jax_mlp_params_to_torch({"params": nested})
+
+
 def torch_mlp_model(
     seed: int = 0,
     hidden_sizes: Sequence[int] = (256, 128),
     out_channels: int = 10,
     in_features: int = 784,
+    canonical: bool = False,
 ) -> TorchModelHandle:
     """Torch twin of :func:`p2pfl_tpu.models.mlp_model` (same architecture as
-    the reference's per-framework MLPs, lightning_model.py:118+)."""
+    the reference's per-framework MLPs, lightning_model.py:118+).
+
+    With ``canonical=True`` the handle speaks the flax-layout wire format so
+    it can federate with JAX and keras MLP nodes (heterogeneous federation).
+    """
     _require_torch()
     torch.manual_seed(seed)
     layers: List[nn.Module] = [nn.Flatten()]
@@ -237,7 +374,11 @@ def torch_mlp_model(
         layers += [nn.Linear(prev, h), nn.ReLU()]
         prev = h
     layers.append(nn.Linear(prev, out_channels))
-    return TorchModelHandle(nn.Sequential(*layers))
+    return TorchModelHandle(
+        nn.Sequential(*layers),
+        to_wire=torch_mlp_to_wire if canonical else None,
+        from_wire=torch_mlp_from_wire if canonical else None,
+    )
 
 
 def torch_state_dict_to_jax_mlp(state: Dict[str, np.ndarray]) -> Dict[str, Any]:

@@ -74,6 +74,65 @@ def test_torch_nodes_federate():
             n.stop()
 
 
+def test_torch_scaffold_emits_deltas():
+    """SCAFFOLD contract on the torch path: delta_y_i / delta_c_i ride in
+    additional_info (same payload JaxLearner.fit emits), with delta_y equal
+    to the actual parameter movement."""
+    data = synthetic_mnist(n_train=256, n_test=64)
+    model = torch_mlp_model(seed=0)
+    before = [a.copy() for a in model.get_parameters()]
+    learner = TorchLearner(model, data, "t0", batch_size=32, callbacks=["scaffold"])
+    learner.set_epochs(1)
+    learner.fit()
+    info = model.get_info("scaffold")
+    assert info is not None
+    n_leaves = len(model.get_parameters())
+    assert len(info["delta_y_i"]) == n_leaves
+    assert len(info["delta_c_i"]) == n_leaves
+    after = model.get_parameters()
+    # leaves are emitted in jax-tree (sorted-key) order, same as get_parameters
+    for dy, a, b in zip(info["delta_y_i"], after, before):
+        np.testing.assert_allclose(dy, a.astype(np.float32) - b.astype(np.float32), atol=1e-5)
+    assert any(np.abs(dc).max() > 0 for dc in info["delta_c_i"])
+
+
+def test_torch_nodes_scaffold_convergence():
+    """Torch-node federation under the Scaffold aggregator (VERDICT round-2
+    ask #4): converges and keeps the scaffold server round-trip alive."""
+    from p2pfl_tpu.learning.aggregators import Scaffold
+    from p2pfl_tpu.node import Node
+    from p2pfl_tpu.utils.utils import check_equal_models, wait_convergence, wait_to_finish
+
+    parts = synthetic_mnist(n_train=512, n_test=128).generate_partitions(
+        2, RandomIIDPartitionStrategy
+    )
+    nodes = [
+        Node(
+            torch_mlp_model(seed=i),
+            parts[i],
+            learner=TorchLearner,
+            aggregator=Scaffold(),
+            batch_size=32,
+        )
+        for i in range(2)
+    ]
+    try:
+        for n in nodes:
+            n.start()
+        nodes[1].connect(nodes[0].addr)
+        wait_convergence(nodes, 1, wait=5)
+        nodes[0].set_start_learning(rounds=2, epochs=2)
+        wait_to_finish(nodes, timeout=120)
+        check_equal_models(nodes)
+        # scaffold requires the callback to have been auto-wired by Node
+        assert all(n.learner._scaffold for n in nodes)
+        metrics = [n.learner.evaluate() for n in nodes]
+        assert all(m["test_acc"] > 0.5 for m in metrics), metrics
+    finally:
+        for n in nodes:
+            n.stop()
+
+
 def test_torch_to_jax_weight_translation_exact():
     """Same weights -> same logits across frameworks (atol covers the
     f32 matmul-order difference only)."""

@@ -1,0 +1,135 @@
+"""Keras interop: handle round-trips, learner training, SCAFFOLD deltas,
+keras<->flax weight translation, and the heterogeneous jax/torch/keras
+federation (reference framework matrix tests: test/learning/
+frameworks_test.py:63-385 — the mixed federation exceeds the reference,
+which cannot combine frameworks in one experiment)."""
+
+import numpy as np
+import pytest
+
+keras = pytest.importorskip("keras")
+torch = pytest.importorskip("torch")
+
+from p2pfl_tpu.exceptions import ModelNotMatchingError
+from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+from p2pfl_tpu.learning.interop import (
+    KerasLearner,
+    TorchLearner,
+    jax_mlp_params_to_keras,
+    keras_mlp_model,
+    keras_mlp_to_wire,
+    keras_weights_to_jax_mlp,
+    torch_mlp_model,
+    torch_mlp_to_wire,
+)
+from p2pfl_tpu.learning.learner import LearnerFactory
+from p2pfl_tpu.models import mlp_model
+
+
+def test_keras_handle_roundtrip_and_shape_check():
+    m = keras_mlp_model(seed=0)
+    params = m.get_parameters()
+    wire = m.encode_parameters()
+    m2 = keras_mlp_model(seed=1)
+    m2.set_parameters(bytes(wire))
+    for a, b in zip(params, m2.get_parameters()):
+        np.testing.assert_array_equal(a, b)
+    with pytest.raises(ModelNotMatchingError):
+        m2.set_parameters([p[:1] for p in params])
+
+
+def test_learner_factory_picks_keras():
+    assert LearnerFactory.create_learner(keras_mlp_model()) is KerasLearner
+
+
+def test_keras_learner_trains():
+    data = synthetic_mnist(n_train=512, n_test=128)
+    learner = KerasLearner(keras_mlp_model(seed=0), data, "k0", batch_size=32)
+    learner.set_epochs(2)
+    learner.fit()
+    metrics = learner.evaluate()
+    assert metrics["test_acc"] > 0.5, metrics
+    assert learner.get_model().get_contributors() == ["k0"]
+
+
+def test_keras_scaffold_emits_deltas():
+    data = synthetic_mnist(n_train=256, n_test=64)
+    model = keras_mlp_model(seed=0)
+    before = [a.copy() for a in model.get_parameters()]
+    learner = KerasLearner(model, data, "k0", batch_size=32, callbacks=["scaffold"])
+    learner.set_epochs(1)
+    learner.fit()
+    info = model.get_info("scaffold")
+    assert info is not None
+    after = model.get_parameters()
+    assert len(info["delta_y_i"]) == len(after)
+    for dy, a, b in zip(info["delta_y_i"], after, before):
+        np.testing.assert_allclose(dy, a.astype(np.float32) - b.astype(np.float32), atol=1e-5)
+    assert any(np.abs(dc).max() > 0 for dc in info["delta_c_i"])
+
+
+def test_keras_to_jax_weight_translation_exact():
+    """Same weights -> same logits across frameworks (keras Dense kernels
+    are already [in, out]; only re-nesting happens)."""
+    km = keras_mlp_model(seed=3)
+    jm = mlp_model(seed=0)
+    jax_params = keras_weights_to_jax_mlp(km.params)
+    x = np.random.default_rng(0).normal(size=(8, 28, 28)).astype(np.float32)
+    out_k = km.apply_fn(km.params, x)
+    jm.set_parameters(jax_params)
+    out_j = np.asarray(jm.apply_fn(jm.params, x))
+    # flax MLP computes in bfloat16 -> tolerance is bf16 rounding
+    np.testing.assert_allclose(out_k, out_j, atol=0.1)
+
+    back = jax_mlp_params_to_keras(jax_params)
+    for a, b in zip(back, km.params):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_mixed_jax_torch_keras_federation():
+    """3-node heterogeneous federation — one node per framework — over the
+    in-memory transport with the canonical (flax-layout) wire format. All
+    nodes must converge to the same model."""
+    from p2pfl_tpu.node import Node
+    from p2pfl_tpu.utils.utils import wait_convergence, wait_to_finish
+
+    parts = synthetic_mnist(n_train=384, n_test=96).generate_partitions(
+        3, RandomIIDPartitionStrategy
+    )
+    nodes = [
+        Node(mlp_model(seed=0), parts[0], batch_size=32),
+        Node(
+            torch_mlp_model(seed=1, canonical=True),
+            parts[1],
+            learner=TorchLearner,
+            batch_size=32,
+        ),
+        Node(
+            keras_mlp_model(seed=2, canonical=True),
+            parts[2],
+            learner=KerasLearner,
+            batch_size=32,
+        ),
+    ]
+    try:
+        for n in nodes:
+            n.start()
+        nodes[1].connect(nodes[0].addr)
+        nodes[2].connect(nodes[0].addr)
+        wait_convergence(nodes, 2, wait=8)
+        nodes[0].set_start_learning(rounds=1, epochs=1)
+        wait_to_finish(nodes, timeout=180)
+        # Compare in the canonical layout (native layouts differ by design).
+        canon = [
+            nodes[0].learner.get_model().get_parameters(),
+            torch_mlp_to_wire(nodes[1].learner.get_model().params),
+            keras_mlp_to_wire(nodes[2].learner.get_model().params),
+        ]
+        for other in canon[1:]:
+            assert len(other) == len(canon[0])
+            for a, b in zip(canon[0], other):
+                assert a.shape == b.shape
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-1)
+    finally:
+        for n in nodes:
+            n.stop()

@@ -1,18 +1,27 @@
-"""Framework logger: python-logging + metric routing.
+"""Framework logger: python-logging + metric routing, async by default.
 
 Capability parity with the reference logger stack (management/logger/
-logger.py:87-454 and the decorator chain in logger/__init__.py:28-35).
-Instead of a decorator tower, one logger object owns pluggable sinks:
-stdout/file handlers, the two-level metric store, an optional web telemetry
-pusher, and per-node resource monitors. A process-wide singleton instance is
-exposed as ``logger``.
+logger.py:87-454 and the decorator chain in logger/__init__.py:28-35,
+including AsyncLogger, decorators/async_logger.py:29-70). Instead of a
+decorator tower, one logger object owns pluggable sinks: stdout/file
+handlers, the two-level metric store, an optional web telemetry pusher, and
+per-node resource monitors. A process-wide singleton instance is exposed as
+``logger``.
+
+Async: hot-path log calls (gossip ticks, heartbeats, stage transitions)
+only enqueue a record into a ``QueueHandler``; a ``QueueListener`` thread
+runs the real handlers, so the gossip/heartbeat threads never block on
+stdout or file IO. ``flush()`` drains the queue (registered atexit).
 """
 
 from __future__ import annotations
 
+import atexit
 import datetime
 import logging
+import logging.handlers
 import os
+import queue
 import threading
 from typing import Dict, Optional
 
@@ -26,12 +35,21 @@ class P2pflTpuLogger(metaclass=SingletonMeta):
     def __init__(self) -> None:
         self._log = logging.getLogger("p2pfl_tpu")
         self._log.setLevel(getattr(logging, Settings.LOG_LEVEL, logging.INFO))
-        if not self._log.handlers:
-            h = logging.StreamHandler()
-            h.setFormatter(
-                logging.Formatter("[%(asctime)s] [%(levelname)s] %(message)s", "%H:%M:%S")
-            )
-            self._log.addHandler(h)
+        # Async sink: the logger carries ONE QueueHandler; the listener
+        # thread owns the real handlers (reference async_logger.py:29-70).
+        for h in list(self._log.handlers):
+            self._log.removeHandler(h)
+        self._queue: "queue.SimpleQueue[logging.LogRecord]" = queue.SimpleQueue()
+        self._log.addHandler(logging.handlers.QueueHandler(self._queue))
+        stream = logging.StreamHandler()
+        stream.setFormatter(
+            logging.Formatter("[%(asctime)s] [%(levelname)s] %(message)s", "%H:%M:%S")
+        )
+        self._listener = logging.handlers.QueueListener(
+            self._queue, stream, respect_handler_level=True
+        )
+        self._listener.start()
+        atexit.register(self.flush)
         self._file_handler: Optional[logging.Handler] = None
         self.local_metrics = LocalMetricStorage()
         self.global_metrics = GlobalMetricStorage()
@@ -47,20 +65,31 @@ class P2pflTpuLogger(metaclass=SingletonMeta):
 
     def enable_file_logging(self, log_dir: Optional[str] = None) -> str:
         """Per-run log file under Settings.LOG_DIR (reference
-        decorators/file_logger.py:30-56)."""
+        decorators/file_logger.py:30-56). The file handler joins the async
+        listener, not the logger — writes never block the hot path."""
         log_dir = log_dir or Settings.LOG_DIR
         os.makedirs(log_dir, exist_ok=True)
         path = os.path.join(
             log_dir, f"p2pfl_tpu-{datetime.datetime.now():%Y%m%d-%H%M%S}.log"
         )
+        handlers = [h for h in self._listener.handlers if h is not self._file_handler]
         if self._file_handler is not None:
-            self._log.removeHandler(self._file_handler)
+            self._file_handler.close()
         self._file_handler = logging.FileHandler(path)
         self._file_handler.setFormatter(
             logging.Formatter("[%(asctime)s] [%(levelname)s] %(message)s")
         )
-        self._log.addHandler(self._file_handler)
+        self._listener.handlers = tuple(handlers) + (self._file_handler,)
         return path
+
+    def flush(self) -> None:
+        """Drain the async queue so every enqueued record has been handled
+        (stop processes the backlog, then the listener is restarted)."""
+        listener = getattr(self, "_listener", None)
+        if listener is None or listener._thread is None:
+            return
+        listener.stop()
+        listener.start()
 
     def debug(self, node: str, msg: str) -> None:
         self._log.debug("(%s) %s", node, msg)
@@ -164,6 +193,11 @@ class P2pflTpuLogger(metaclass=SingletonMeta):
                     mon.stop()  # type: ignore[attr-defined]
                 except Exception:
                     pass
+            try:
+                inst._listener.stop()
+                atexit.unregister(inst.flush)
+            except Exception:
+                pass
         SingletonMeta.reset(cls)
 
 

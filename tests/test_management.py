@@ -1,0 +1,66 @@
+"""Management layer: async logger (reference decorators/async_logger.py:
+29-70), file logging through the listener, metric routing, flush-on-exit."""
+
+import logging
+import threading
+
+from p2pfl_tpu.experiment import Experiment
+from p2pfl_tpu.management.logger import logger
+
+
+class _ThreadRecordingHandler(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.records = []
+        self.threads = set()
+
+    def emit(self, record):
+        self.records.append(record.getMessage())
+        self.threads.add(threading.current_thread().name)
+
+
+def test_log_calls_are_async():
+    """Handlers run on the QueueListener thread, never the caller thread —
+    the hot path (gossip/heartbeat) must not block on handler IO."""
+    h = _ThreadRecordingHandler()
+    orig = logger._listener.handlers
+    logger._listener.handlers = orig + (h,)
+    try:
+        logger.info("async-test-node", "hello-async")
+        logger.flush()
+        assert any("hello-async" in r for r in h.records)
+        assert threading.current_thread().name not in h.threads
+    finally:
+        logger._listener.handlers = orig
+
+
+def test_file_logging_flush(tmp_path):
+    path = logger.enable_file_logging(str(tmp_path))
+    logger.info("file-test-node", "to-disk-and-flushed")
+    logger.flush()
+    with open(path) as f:
+        content = f.read()
+    assert "to-disk-and-flushed" in content
+    # detach the file handler again so other tests don't write here
+    logger._listener.handlers = tuple(
+        h for h in logger._listener.handlers if h is not logger._file_handler
+    )
+    logger._file_handler = None
+
+
+def test_metric_routing_step_vs_round():
+    """Step-wise metrics land in local storage, round-wise in global
+    (reference logger.py:266-305)."""
+    node = "metrics-test-node"
+    logger.register_node(node)
+    try:
+        logger.experiment_started(node, Experiment("routing-exp", 3))
+        logger.log_metric(node, "train_loss", 0.5, step=2)
+        logger.log_metric(node, "test_acc", 0.9)
+        local = logger.get_local_logs()
+        assert "routing-exp" in local
+        assert local["routing-exp"][0][node]["train_loss"] == [(2, 0.5)]
+        glob = logger.get_global_logs()
+        assert glob["routing-exp"][node]["test_acc"] == [(0, 0.9)]
+    finally:
+        logger.unregister_node(node)

@@ -44,6 +44,50 @@ def make_mesh(
     return Mesh(dev_array, tuple(axis_names))
 
 
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+) -> None:
+    """Join this process to a multi-host JAX deployment.
+
+    Thin, idempotent wrapper over :func:`jax.distributed.initialize` — the
+    pod-slice leg of the north-star (BASELINE.json: v4-128): after every
+    process calls this, ``jax.devices()`` is the GLOBAL device list and
+    :func:`make_mesh` builds a process-spanning mesh whose collectives ride
+    ICI within a slice and DCN across slices. On TPU pods the arguments are
+    auto-detected from the environment; on CPU/test deployments pass them
+    explicitly. No-op when already initialized, or when no coordinator is
+    configured anywhere (no argument, no ``JAX_COORDINATOR_ADDRESS``, no TPU
+    pod environment) — safe to call unconditionally at startup.
+    """
+    import os
+
+    # NB: must not touch the backend (jax.devices/process_count) before
+    # initialize — only the distributed-client handle tells us if we joined.
+    try:
+        already = getattr(jax.distributed, "is_initialized", lambda: False)() or (
+            jax._src.distributed.global_state.client is not None
+        )
+    except AttributeError:  # private module moved; trust the public probe
+        already = getattr(jax.distributed, "is_initialized", lambda: False)()
+    if already:
+        return
+    pod_env = any(
+        k in os.environ
+        for k in ("JAX_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES", "CLOUD_TPU_TASK_ID", "MEGASCALE_COORDINATOR_ADDRESS")
+    )
+    if coordinator_address is None and num_processes is None and process_id is None and not pod_env:
+        return  # single-process: nothing to join
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+
+
 def population_sharding(mesh: Mesh, axis: str = "nodes") -> NamedSharding:
     """Sharding for stacked-population arrays: leading axis over ``nodes``."""
     return NamedSharding(mesh, PartitionSpec(axis))

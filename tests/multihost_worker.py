@@ -1,0 +1,52 @@
+"""Worker for the 2-process multi-host mesh test (run via subprocess by
+test_multihost.py — not collected by pytest).
+
+Each process contributes 4 virtual CPU devices; after
+``initialize_multihost`` the global mesh spans both processes (8 devices on
+the ``nodes`` axis) and one FedAvg round of the MeshSimulation runs as a
+process-spanning SPMD program — the CI-runnable stand-in for a DCN-spanning
+TPU pod slice (BASELINE.json north-star).
+
+Usage: python multihost_worker.py <coordinator_port> <process_id>
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+port, pid = int(sys.argv[1]), int(sys.argv[2])
+
+from p2pfl_tpu.parallel.mesh import initialize_multihost, make_mesh  # noqa: E402
+
+initialize_multihost(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+assert len(jax.local_devices()) == 4
+
+from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist  # noqa: E402
+from p2pfl_tpu.models import mlp_model  # noqa: E402
+from p2pfl_tpu.parallel.simulation import MeshSimulation  # noqa: E402
+
+mesh = make_mesh()  # all 8 global devices on the "nodes" axis
+assert set(d.process_index for d in mesh.devices.flat) == {0, 1}
+
+# Same seeds in both processes -> identical host data, as SPMD requires.
+data = synthetic_mnist(n_train=512, n_test=128)
+parts = data.generate_partitions(8, RandomIIDPartitionStrategy)
+sim = MeshSimulation(
+    mlp_model(seed=0), parts, train_set_size=4, batch_size=32, seed=1, mesh=mesh
+)
+res = sim.run(rounds=1, epochs=1, warmup=False)
+acc = res.test_acc[-1]
+assert 0.0 <= acc <= 1.0
+print(f"MULTIHOST_OK pid={pid} acc={acc:.4f}", flush=True)

@@ -1,0 +1,53 @@
+"""Multi-host mesh groundwork (VERDICT round-2 ask #5): two OS processes,
+each with 4 virtual CPU devices, join via jax.distributed and run one
+FedAvg round of the MeshSimulation over a process-spanning mesh — the
+CI-runnable analogue of a DCN-spanning pod slice."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_mesh_fedavg_round():
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(worker))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(port), str(pid)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
+        assert "MULTIHOST_OK" in out, out[-2000:]
+    # Both processes computed the same (replicated) accuracy.
+    accs = {line.split("acc=")[1] for out in outs for line in out.splitlines() if "MULTIHOST_OK" in line}
+    assert len(accs) == 1, accs

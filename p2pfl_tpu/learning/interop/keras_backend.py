@@ -169,9 +169,12 @@ class KerasLearner(Learner):
         self.batch_size = int(batch_size)
         self.seed = int(seed)
         self.callbacks = list(callbacks or [])
-        for cb in self.callbacks:
-            if cb not in self.SUPPORTED_CALLBACKS:
-                raise ValueError(f"unsupported callback {cb!r}")
+        from p2pfl_tpu.learning.callbacks import CallbackFactory
+
+        self._callback_objs = CallbackFactory.create(
+            self.get_framework(),
+            [cb for cb in self.callbacks if cb not in self.SUPPORTED_CALLBACKS],
+        )
         self._scaffold = "scaffold" in self.callbacks
         self._scaffold_c_i: Optional[List[np.ndarray]] = None
         self._interrupt = threading.Event()
@@ -192,6 +195,8 @@ class KerasLearner(Learner):
     def fit(self) -> ModelHandle:
         model = self._handle()
         self._interrupt.clear()
+        for cb in self._callback_objs:
+            cb.on_fit_start(self)
         t0 = time.monotonic()
         keras.utils.set_random_seed(self.seed + self._fit_count)
         epoch_seed = self.seed + 1000 * self._fit_count
@@ -270,6 +275,8 @@ class KerasLearner(Learner):
             self._scaffold_c_i = c_i_new
             model.add_info("scaffold", {"delta_y_i": delta_y, "delta_c_i": delta_c})
 
+        for cb in self._callback_objs:
+            cb.on_fit_end(self)
         self.report("fit_time_s", time.monotonic() - t0)
         return model
 

@@ -190,9 +190,12 @@ class TorchLearner(Learner):
         self.batch_size = int(batch_size)
         self.seed = int(seed)
         self.callbacks = list(callbacks or [])
-        for cb in self.callbacks:
-            if cb not in self.SUPPORTED_CALLBACKS:
-                raise ValueError(f"unsupported callback {cb!r}")
+        from p2pfl_tpu.learning.callbacks import CallbackFactory
+
+        self._callback_objs = CallbackFactory.create(
+            self.get_framework(),
+            [cb for cb in self.callbacks if cb not in self.SUPPORTED_CALLBACKS],
+        )
         self._scaffold = "scaffold" in self.callbacks
         self._scaffold_c_i: Optional[Dict[str, np.ndarray]] = None
         self._interrupt = threading.Event()
@@ -213,6 +216,8 @@ class TorchLearner(Learner):
     def fit(self) -> ModelHandle:
         model = self._handle()
         self._interrupt.clear()
+        for cb in self._callback_objs:
+            cb.on_fit_start(self)
         t0 = time.monotonic()
         torch.manual_seed(self.seed + self._fit_count)
         epoch_seed = self.seed + 1000 * self._fit_count
@@ -298,6 +303,8 @@ class TorchLearner(Learner):
                 },
             )
 
+        for cb in self._callback_objs:
+            cb.on_fit_end(self)
         self.report("fit_time_s", time.monotonic() - t0)
         return model
 

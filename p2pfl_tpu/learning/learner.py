@@ -150,9 +150,15 @@ class JaxLearner(Learner):
         self.fedprox_mu = float(fedprox_mu)
         self.seed = int(seed)
         self.callbacks = list(callbacks or [])
-        for cb in self.callbacks:
-            if cb not in self.SUPPORTED_CALLBACKS:
-                raise ValueError(f"unsupported callback {cb!r}")
+        # Reserved names run inside the jitted step; everything else is a
+        # host-side callback resolved through the open registry
+        # (reference CallbackFactory contract, callback_factory.py:16-101).
+        from p2pfl_tpu.learning.callbacks import CallbackFactory
+
+        self._callback_objs = CallbackFactory.create(
+            self.get_framework(),
+            [cb for cb in self.callbacks if cb not in self.SUPPORTED_CALLBACKS],
+        )
         self._interrupt = threading.Event()
         self._fit_count = 0
         self._opt_state: Optional[Pytree] = None
@@ -245,6 +251,8 @@ class JaxLearner(Learner):
         """
         model = self.get_model()
         self._interrupt.clear()
+        for cb in self._callback_objs:
+            cb.on_fit_start(self)
         t0 = time.monotonic()
         epoch_seed = self.seed + 1000 * self._fit_count
         self._fit_count += 1
@@ -318,6 +326,8 @@ class JaxLearner(Learner):
                 },
             )
 
+        for cb in self._callback_objs:
+            cb.on_fit_end(self)
         self.report("fit_time_s", time.monotonic() - t0)
         return model
 

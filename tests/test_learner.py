@@ -84,3 +84,41 @@ def test_metric_reporter_called():
 def test_learner_factory():
     model = mlp_model(seed=0)
     assert LearnerFactory.create_learner(model) is JaxLearner
+
+
+def test_callback_registry_hooks_and_errors():
+    """Open CallbackFactory (reference callback_factory.py:16-101): custom
+    host-side callbacks resolve by name, hook around the jitted fit, and
+    unknown names raise listing what's available."""
+    import pytest
+
+    from p2pfl_tpu.learning.callbacks import CallbackFactory, P2PFLCallback
+    from p2pfl_tpu.learning.dataset import synthetic_mnist
+    from p2pfl_tpu.learning.learner import JaxLearner
+    from p2pfl_tpu.models import mlp_model
+
+    calls = []
+
+    @CallbackFactory.decorator("jax", "recorder")
+    class Recorder(P2PFLCallback):
+        name = "recorder"
+
+        def on_fit_start(self, learner):
+            calls.append("start")
+
+        def on_fit_end(self, learner):
+            calls.append("end")
+            learner.get_model().add_info("recorder", {"fits": calls.count("end")})
+
+    data = synthetic_mnist(n_train=128, n_test=32)
+    learner = JaxLearner(
+        mlp_model(seed=0), data, "cb0", batch_size=32, callbacks=["recorder"]
+    )
+    learner.set_epochs(1)
+    model = learner.fit()
+    assert calls == ["start", "end"]
+    assert model.get_info("recorder") == {"fits": 1}
+
+    with pytest.raises(ValueError, match="recorder"):
+        JaxLearner(mlp_model(seed=0), data, "cb1", callbacks=["nope"])
+    assert "recorder" in CallbackFactory.registered("jax")

@@ -105,6 +105,18 @@ def softmax_cross_entropy(logits: jax.Array, labels: jax.Array, mask: jax.Array)
     return jnp.sum(nll * mask) / denom
 
 
+def fedprox_penalty(params: Pytree, anchor: Pytree, mu: float) -> jax.Array:
+    """FedProx proximal term ``mu/2 * ||w - w_anchor||^2`` in float32 —
+    shared by the nodes-mode learner and the mesh simulation so both
+    execution modes stay provably identical."""
+    sq = jax.tree.map(
+        lambda a, b: jnp.sum((a.astype(jnp.float32) - b.astype(jnp.float32)) ** 2),
+        params,
+        anchor,
+    )
+    return 0.5 * mu * sum(jax.tree.leaves(sq))
+
+
 def masked_lm_loss(logits: jax.Array, tokens: jax.Array, seq_mask: jax.Array) -> jax.Array:
     """Next-token CE over ``logits [B, L, V]`` / ``tokens [B, L]`` with a
     per-sequence validity mask ``[B]`` (padded rows of a stacked federated
@@ -196,12 +208,7 @@ class JaxLearner(Learner):
         def loss_fn(p: Pytree, x: jax.Array, y: jax.Array, w: jax.Array) -> jax.Array:
             loss = softmax_cross_entropy(apply_fn(p, x), y, w)
             if fedprox_mu > 0.0:
-                sq = jax.tree.map(
-                    lambda a, b: jnp.sum((a.astype(jnp.float32) - b.astype(jnp.float32)) ** 2),
-                    p,
-                    anchor,
-                )
-                loss = loss + 0.5 * fedprox_mu * sum(jax.tree.leaves(sq))
+                loss = loss + fedprox_penalty(p, anchor, fedprox_mu)
             return loss
 
         def step(carry, batch):

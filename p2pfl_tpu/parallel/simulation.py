@@ -41,7 +41,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from p2pfl_tpu.config import Settings
 from p2pfl_tpu.learning.dataset.dataset import FederatedDataset
-from p2pfl_tpu.learning.learner import masked_lm_loss, softmax_cross_entropy
+from p2pfl_tpu.learning.learner import (
+    fedprox_penalty,
+    masked_lm_loss,
+    softmax_cross_entropy,
+)
 from p2pfl_tpu.models.model_handle import ModelHandle
 from p2pfl_tpu.ops import aggregation as agg_ops
 from p2pfl_tpu.parallel.mesh import make_mesh
@@ -129,10 +133,14 @@ class MeshSimulation:
         aggregate_fn: Optional[Callable[[Pytree, jax.Array], Pytree]] = None,
         per_node_init: bool = False,
         task: str = "classification",
+        fedprox_mu: float = 0.0,
     ) -> None:
         if task not in ("classification", "lm"):
             raise ValueError(f"unknown task {task!r}")
         self.task = task
+        # FedProx (BASELINE.json config #5): proximal pull toward the
+        # round-start (diffused) model inside the jitted local step.
+        self.fedprox_mu = float(fedprox_mu)
         self.model = model
         self.apply_fn = model.apply_fn
         self.batch_size = int(batch_size)
@@ -255,6 +263,7 @@ class MeshSimulation:
         """One committee member's local training: ``epochs`` x scan over
         shuffled fixed-shape batches (same math as JaxLearner._train_epoch)."""
         steps = x.shape[0] // self.batch_size
+        anchor = params  # round-start model (for the FedProx proximal term)
 
         def epoch(carry, ekey):
             p, s = carry
@@ -268,7 +277,10 @@ class MeshSimulation:
                 bx, by, bw = batch
 
                 def loss_fn(pp):
-                    return self._batch_loss(pp, bx, by, bw)
+                    loss = self._batch_loss(pp, bx, by, bw)
+                    if self.fedprox_mu > 0.0:
+                        loss = loss + fedprox_penalty(pp, anchor, self.fedprox_mu)
+                    return loss
 
                 loss, grads = jax.value_and_grad(loss_fn)(p)
                 updates, s2 = self.optimizer.update(grads, s, p)

@@ -122,3 +122,19 @@ def test_callback_registry_hooks_and_errors():
     with pytest.raises(ValueError, match="recorder"):
         JaxLearner(mlp_model(seed=0), data, "cb1", callbacks=["nope"])
     assert "recorder" in CallbackFactory.registered("jax")
+
+
+def test_cnn_learner_convergence():
+    """CNN model family trains through the jitted learner (BASELINE.json
+    config #2's model leg; the sim-mode leg uses the MLP because bf16 convs
+    under vmap+scan compile for minutes on the virtual CPU mesh)."""
+    from p2pfl_tpu.learning.dataset import synthetic_mnist
+    from p2pfl_tpu.learning.learner import JaxLearner
+    from p2pfl_tpu.models import cnn_model
+
+    data = synthetic_mnist(n_train=512, n_test=64)
+    learner = JaxLearner(cnn_model(seed=0), data, "cnn0", batch_size=32, lr=3e-3)
+    learner.set_epochs(4)
+    learner.fit()
+    metrics = learner.evaluate()
+    assert metrics["test_acc"] > 0.5, metrics

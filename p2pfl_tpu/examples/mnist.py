@@ -52,6 +52,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--measure-time", action="store_true")
     p.add_argument("--profiling", action="store_true", help="cProfile the run")
     p.add_argument("--seed", type=int, default=42)
+    p.add_argument(
+        "--platform",
+        choices=["default", "cpu", "tpu"],
+        default="default",
+        help="force a JAX platform before backend init (the env var alone "
+        "cannot override a sitecustomize platform pin)",
+    )
     return p
 
 
@@ -79,16 +86,15 @@ def run_mesh(args: argparse.Namespace) -> dict:
     from p2pfl_tpu.ops import aggregation as agg_ops
     from p2pfl_tpu.parallel.simulation import MeshSimulation
 
+    # 2*trim must stay below the committee size or the trimmed mean is empty
+    trim = min(max(1, args.train_set_size // 4), (args.train_set_size - 1) // 2)
     agg_fn = {
         "fedavg": agg_ops.fedavg,
         "fedmedian": lambda stacked, w: agg_ops.fedmedian(stacked),
+        "krum": lambda stacked, w: agg_ops.krum(stacked, w, num_byzantine=1)[0],
+        "trimmed_mean": lambda stacked, w: agg_ops.trimmed_mean(stacked, trim=trim),
     }.get(args.aggregator)
-    if agg_fn is None:
-        print(
-            f"aggregator {args.aggregator!r} has no mesh kernel; using nodes mode",
-            file=sys.stderr,
-        )
-        return run_nodes(args)
+    algorithm = "scaffold" if args.aggregator == "scaffold" else "fedavg"
 
     data = synthetic_mnist(
         n_train=args.nodes * args.samples_per_node, n_test=1024, seed=args.seed
@@ -101,6 +107,8 @@ def run_mesh(args: argparse.Namespace) -> dict:
         batch_size=args.batch_size,
         seed=args.seed,
         aggregate_fn=agg_fn,
+        algorithm=algorithm,
+        lr=0.05 if algorithm == "scaffold" else 1e-3,
     )
     res = sim.run(rounds=args.rounds, epochs=args.epochs, warmup=True)
     return {
@@ -177,6 +185,11 @@ def run_nodes(args: argparse.Namespace) -> dict:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+
+    if args.platform != "default":
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
     prof = None
     if args.profiling:

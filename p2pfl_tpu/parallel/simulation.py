@@ -117,6 +117,12 @@ class MeshSimulation:
             sequences ``[N, S, L]``, the target is the next token, and
             eval reports token-level loss/accuracy. Long-context federated
             fine-tuning runs the transformer family through this path.
+        algorithm: ``"fedavg"`` (default) or ``"scaffold"`` — SCAFFOLD keeps
+            per-node control variates as a sharded stacked pytree in the
+            scan carry and applies the ``g + c - c_i`` correction inside
+            the jitted local step (the reference only has host-side
+            scaffold; sim-mode scaffold is an upgrade).
+        scaffold_global_lr: SCAFFOLD server step size.
     """
 
     def __init__(
@@ -134,17 +140,45 @@ class MeshSimulation:
         per_node_init: bool = False,
         task: str = "classification",
         fedprox_mu: float = 0.0,
+        algorithm: str = "fedavg",
+        scaffold_global_lr: float = 1.0,
     ) -> None:
         if task not in ("classification", "lm"):
             raise ValueError(f"unknown task {task!r}")
+        if algorithm not in ("fedavg", "scaffold"):
+            raise ValueError(f"unknown algorithm {algorithm!r}")
+        if algorithm == "scaffold" and aggregate_fn is not None:
+            raise ValueError("scaffold defines its own aggregation; drop aggregate_fn")
+        if algorithm == "scaffold" and per_node_init:
+            raise ValueError(
+                "scaffold assumes a shared round-start model (per_node_init=False)"
+            )
+        if algorithm == "scaffold" and optimizer is not None:
+            raise ValueError(
+                "scaffold manages its own SGD optimizer: the option-II "
+                "control-variate scale 1/(steps*lr) is only valid for SGD at "
+                "exactly lr — pass lr=... instead of optimizer=..."
+            )
         self.task = task
+        self.algorithm = algorithm
+        self.scaffold_global_lr = float(scaffold_global_lr)
+        self.lr = float(lr)  # scaffold's control-variate scale needs the raw step size
         # FedProx (BASELINE.json config #5): proximal pull toward the
         # round-start (diffused) model inside the jitted local step.
         self.fedprox_mu = float(fedprox_mu)
         self.model = model
         self.apply_fn = model.apply_fn
         self.batch_size = int(batch_size)
-        self.optimizer = optimizer if optimizer is not None else optax.adam(lr)
+        if optimizer is not None:
+            self.optimizer = optimizer
+        elif algorithm == "scaffold":
+            # SCAFFOLD's option-II control-variate update estimates the
+            # local gradient as (x - y_i)/(steps * lr), which is only valid
+            # for constant-step SGD — Adam's adaptive steps break the
+            # estimate and the correction diverges.
+            self.optimizer = optax.sgd(lr)
+        else:
+            self.optimizer = optax.adam(lr)
         self.seed = int(seed)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.aggregate_fn = aggregate_fn if aggregate_fn is not None else agg_ops.fedavg
@@ -239,6 +273,29 @@ class MeshSimulation:
         self.sample_mask = shard_stacked(self.sample_mask)
         self.num_samples = jnp.sum(jnp.asarray(self.sample_mask), axis=1)  # [N]
 
+        # SCAFFOLD state (Karimireddy et al. 2020, sim-mode — the reference
+        # only has host-side scaffold): per-node control variates live as a
+        # float32 stacked pytree with the SAME sharding as the params stack;
+        # the global control variate is replicated. Both ride the lax.scan
+        # carry, so the whole scaffold experiment is still one XLA program.
+        if self.algorithm == "scaffold":
+            c_shardings = jax.tree.map(
+                lambda p: p.sharding, self.params_stack
+            )
+
+            @partial(jax.jit, out_shardings=c_shardings)
+            def zeros_stack(t: Pytree) -> Pytree:
+                return jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), t)
+
+            self.c_stack = zeros_stack(self.params_stack)
+            self.c_global = jax.device_put(
+                jax.tree.map(lambda p: np.zeros(p.shape, np.float32), template),
+                NamedSharding(self.mesh, P()),
+            )
+        else:
+            self.c_stack = {}
+            self.c_global = {}
+
         self._round_history: List[Dict[str, float]] = []
         # Rounds already executed (advanced by run(); restored by
         # load_from()). Round r's RNG key is fold_in(base, r), so resuming
@@ -258,10 +315,11 @@ class MeshSimulation:
 
     def _local_train(
         self, params: Pytree, opt_state: Pytree, key: jax.Array, x: jax.Array,
-        y: jax.Array, w: jax.Array, epochs: int
+        y: jax.Array, w: jax.Array, c_i: Pytree, *, c_global: Pytree, epochs: int
     ) -> Tuple[Pytree, Pytree, jax.Array]:
         """One committee member's local training: ``epochs`` x scan over
-        shuffled fixed-shape batches (same math as JaxLearner._train_epoch)."""
+        shuffled fixed-shape batches (same math as JaxLearner._train_epoch,
+        including the in-jit SCAFFOLD drift correction when enabled)."""
         steps = x.shape[0] // self.batch_size
         anchor = params  # round-start model (for the FedProx proximal term)
 
@@ -283,6 +341,13 @@ class MeshSimulation:
                     return loss
 
                 loss, grads = jax.value_and_grad(loss_fn)(p)
+                if self.algorithm == "scaffold":  # drift correction: g + c - c_i
+                    grads = jax.tree.map(
+                        lambda g, c, ci: g + c.astype(g.dtype) - ci.astype(g.dtype),
+                        grads,
+                        c_global,
+                        c_i,
+                    )
                 updates, s2 = self.optimizer.update(grads, s, p)
                 return (optax.apply_updates(p, updates), s2), loss
 
@@ -294,7 +359,7 @@ class MeshSimulation:
         return params, opt_state, jnp.mean(losses)
 
     def _round_body(self, carry, key: jax.Array, data, epochs: int):
-        params_stack, opt_stack = carry
+        params_stack, opt_stack, c_stack, c_global = carry
         x, y, sample_mask, num_samples, xt, yt = data
         kv, kt = jax.random.split(key)
 
@@ -303,17 +368,47 @@ class MeshSimulation:
         # Gather committee state/data (XLA all_gather over the nodes axis).
         p_k = jax.tree.map(lambda a: a[committee], params_stack)
         o_k = jax.tree.map(lambda a: a[committee], opt_stack)
+        c_k = jax.tree.map(lambda a: a[committee], c_stack)
         x_k = x[committee]
         y_k = y[committee]
         w_k = sample_mask[committee]
         keys = jax.random.split(kt, self.train_set_size)
 
-        p_k, o_k, losses = jax.vmap(
-            partial(self._local_train, epochs=epochs)
-        )(p_k, o_k, keys, x_k, y_k, w_k)
+        p_k_new, o_k, losses = jax.vmap(
+            partial(self._local_train, c_global=c_global, epochs=epochs)
+        )(p_k, o_k, keys, x_k, y_k, w_k, c_k)
 
-        # FedAvg over the committee, weighted by true sample counts.
-        agg = self.aggregate_fn(p_k, num_samples[committee])
+        if self.algorithm == "scaffold":
+            # Server step (same jitted kernel as the host-mode Scaffold
+            # aggregator): x <- x + lr_g * mean(dy); c <- c + K/N * mean(dc);
+            # per-member c_i' = c_i - c + (x - y_i)/(steps * lr).
+            anchor = jax.tree.map(lambda a: a[0], params_stack)  # shared start
+            steps_total = (x.shape[1] // self.batch_size) * epochs
+            scale = 1.0 / (steps_total * self.lr)
+            dy = jax.tree.map(
+                lambda yk, a: yk.astype(jnp.float32) - a.astype(jnp.float32)[None],
+                p_k_new,
+                anchor,
+            )
+            c_k_new = jax.tree.map(
+                lambda ci, cg, d: ci - cg[None] - d * scale, c_k, c_global, dy
+            )
+            dc = jax.tree.map(lambda n, o: n - o, c_k_new, c_k)
+            new_global, c_global = agg_ops.scaffold_update(
+                anchor,
+                c_global,
+                dy,
+                dc,
+                jnp.float32(self.scaffold_global_lr),
+                jnp.float32(self.num_nodes),
+            )
+            agg = jax.tree.map(lambda g, t: g.astype(t.dtype), new_global, anchor)
+            c_stack = jax.tree.map(
+                lambda a, u: a.at[committee].set(u), c_stack, c_k_new
+            )
+        else:
+            # FedAvg over the committee, weighted by true sample counts.
+            agg = self.aggregate_fn(p_k_new, num_samples[committee])
 
         # Diffusion: every node adopts the aggregated model (gossip's fixed
         # point); committee members keep their updated optimizer state.
@@ -335,20 +430,28 @@ class MeshSimulation:
         else:
             loss = jnp.float32(0)
             acc = jnp.float32(0)
-        return (params_stack, opt_stack), (committee, losses.mean(), loss, acc)
+        return (
+            (params_stack, opt_stack, c_stack, c_global),
+            (committee, losses.mean(), loss, acc),
+        )
 
     @partial(jax.jit, static_argnames=("self", "rounds", "epochs"))
-    def _run_jit(self, params_stack, opt_stack, data, start_round, *, rounds: int, epochs: int):
+    def _run_jit(
+        self, params_stack, opt_stack, c_stack, c_global, data, start_round,
+        *, rounds: int, epochs: int,
+    ):
         # Per-round keys are position-independent (fold_in on the absolute
         # round index): chunking and checkpoint-resume replay identically.
         base = jax.random.key(self.seed)
         keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
             start_round + jnp.arange(rounds)
         )
-        (params_stack, opt_stack), (committees, train_loss, test_loss, test_acc) = jax.lax.scan(
-            lambda c, k: self._round_body(c, k, data, epochs), (params_stack, opt_stack), keys
+        carry = (params_stack, opt_stack, c_stack, c_global)
+        carry, (committees, train_loss, test_loss, test_acc) = jax.lax.scan(
+            lambda c, k: self._round_body(c, k, data, epochs), carry, keys
         )
-        return params_stack, opt_stack, committees, train_loss, test_loss, test_acc
+        params_stack, opt_stack, c_stack, c_global = carry
+        return params_stack, opt_stack, c_stack, c_global, committees, train_loss, test_loss, test_acc
 
     # --- public API ----------------------------------------------------------
 
@@ -390,19 +493,20 @@ class MeshSimulation:
 
         if warmup:
             out = self._run_jit(
-                self.params_stack, self.opt_stack, data, jnp.int32(start),
-                rounds=chunks[0], epochs=epochs,
+                self.params_stack, self.opt_stack, self.c_stack, self.c_global,
+                data, jnp.int32(start), rounds=chunks[0], epochs=epochs,
             )
             jax.block_until_ready(out[0])
 
         params_stack, opt_stack = self.params_stack, self.opt_stack
+        c_stack, c_global = self.c_stack, self.c_global
         committees, test_loss, test_acc = [], [], []
         t0 = time.monotonic()
         done = 0
         for i, chunk in enumerate(chunks):
-            params_stack, opt_stack, comm, _tr, tl, ta = self._run_jit(
-                params_stack, opt_stack, data, jnp.int32(start + done),
-                rounds=chunk, epochs=epochs,
+            params_stack, opt_stack, c_stack, c_global, comm, _tr, tl, ta = self._run_jit(
+                params_stack, opt_stack, c_stack, c_global,
+                data, jnp.int32(start + done), rounds=chunk, epochs=epochs,
             )
             committees.append(comm)
             test_loss.append(tl)
@@ -414,6 +518,7 @@ class MeshSimulation:
                 (i + 1) % checkpoint_every == 0 or i == len(chunks) - 1
             ):
                 self.params_stack, self.opt_stack = params_stack, opt_stack
+                self.c_stack, self.c_global = c_stack, c_global
                 self.completed_rounds = start + done
                 self.save_to(checkpointer)
         jax.block_until_ready(params_stack)
@@ -421,6 +526,7 @@ class MeshSimulation:
         total_rounds = sum(chunks)
 
         self.params_stack, self.opt_stack = params_stack, opt_stack
+        self.c_stack, self.c_global = c_stack, c_global
         self.completed_rounds = start + total_rounds
         return SimulationResult(
             rounds=total_rounds,
@@ -440,7 +546,11 @@ class MeshSimulation:
 
     def state_dict(self) -> Pytree:
         """Checkpointable population state (device arrays, shardings kept)."""
-        return {"params_stack": self.params_stack, "opt_stack": self.opt_stack}
+        state = {"params_stack": self.params_stack, "opt_stack": self.opt_stack}
+        if self.algorithm == "scaffold":
+            state["c_stack"] = self.c_stack
+            state["c_global"] = self.c_global
+        return state
 
     def save_to(self, checkpointer) -> bool:
         """Snapshot population state at the current completed-round count."""
@@ -461,6 +571,9 @@ class MeshSimulation:
         state, meta = checkpointer.restore(self.state_dict(), step)
         self.params_stack = state["params_stack"]
         self.opt_stack = state["opt_stack"]
+        if self.algorithm == "scaffold":
+            self.c_stack = state["c_stack"]
+            self.c_global = state["c_global"]
         self.completed_rounds = int(meta.get("completed_rounds", 0))
         if "seed" in meta and int(meta["seed"]) != self.seed:
             self.seed = int(meta["seed"])

@@ -191,3 +191,46 @@ def test_simulation_fedprox(parts16):
         return float(np.abs(np.asarray(after) - np.asarray(before)).max())
 
     assert movement(100.0) < movement(0.0)
+
+
+def test_simulation_scaffold(parts16):
+    """Sim-mode SCAFFOLD (BASELINE.json config #3's aggregator leg): control
+    variates ride the scan carry, the federation converges, and the
+    variates actually move."""
+    sim = MeshSimulation(
+        mlp_model(seed=0), parts16, train_set_size=4, batch_size=32, seed=1,
+        algorithm="scaffold", lr=0.05,  # scaffold defaults to SGD (option-II variate math)
+    )
+    res = sim.run(rounds=3, epochs=1, warmup=False)
+    assert res.test_acc[-1] > 0.5, res.test_acc
+    # committee members' control variates are nonzero after training
+    c_leaf = np.asarray(jax.tree.leaves(sim.c_stack)[0])
+    assert np.abs(c_leaf).max() > 0
+    cg_leaf = np.asarray(jax.tree.leaves(sim.c_global)[0])
+    assert np.abs(cg_leaf).max() > 0
+    # all nodes still hold the same model after diffusion
+    m0 = sim.final_model(node=0).get_parameters()
+    m9 = sim.final_model(node=9).get_parameters()
+    for a, b in zip(m0, m9):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_simulation_scaffold_rejects_bad_combos(parts16):
+    with pytest.raises(ValueError):
+        MeshSimulation(
+            mlp_model(seed=0), parts16, algorithm="scaffold",
+            aggregate_fn=lambda s, w: s,
+        )
+    with pytest.raises(ValueError):
+        MeshSimulation(
+            mlp_model(seed=0), parts16, algorithm="scaffold", per_node_init=True
+        )
+    with pytest.raises(ValueError):
+        MeshSimulation(mlp_model(seed=0), parts16, algorithm="fedscram")
+    with pytest.raises(ValueError):
+        import optax
+
+        MeshSimulation(
+            mlp_model(seed=0), parts16, algorithm="scaffold",
+            optimizer=optax.sgd(0.1),
+        )

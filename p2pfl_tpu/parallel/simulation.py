@@ -435,7 +435,11 @@ class MeshSimulation:
             (committee, losses.mean(), loss, acc),
         )
 
-    @partial(jax.jit, static_argnames=("self", "rounds", "epochs"))
+    @partial(
+        jax.jit,
+        static_argnames=("self", "rounds", "epochs"),
+        donate_argnames=("params_stack", "opt_stack", "c_stack", "c_global"),
+    )
     def _run_jit(
         self, params_stack, opt_stack, c_stack, c_global, data, start_round,
         *, rounds: int, epochs: int,
@@ -492,11 +496,20 @@ class MeshSimulation:
         start = self.completed_rounds
 
         if warmup:
+            # Population/opt buffers are donated to the round program (the
+            # state is updated in place — half the HBM high-water of a
+            # copy-in/copy-out loop), so warm up on throwaway copies to keep
+            # the real state alive for the timed run.
+            wp, wo, wc, wcg = jax.tree.map(
+                jnp.copy,
+                (self.params_stack, self.opt_stack, self.c_stack, self.c_global),
+            )
             out = self._run_jit(
-                self.params_stack, self.opt_stack, self.c_stack, self.c_global,
-                data, jnp.int32(start), rounds=chunks[0], epochs=epochs,
+                wp, wo, wc, wcg, data, jnp.int32(start),
+                rounds=chunks[0], epochs=epochs,
             )
             jax.block_until_ready(out[0])
+            del out
 
         params_stack, opt_stack = self.params_stack, self.opt_stack
         c_stack, c_global = self.c_stack, self.c_global
@@ -521,6 +534,9 @@ class MeshSimulation:
                 self.c_stack, self.c_global = c_stack, c_global
                 self.completed_rounds = start + done
                 self.save_to(checkpointer)
+                # The next chunk DONATES these buffers to XLA; an async save
+                # still reading them would race the in-place reuse.
+                checkpointer.wait()
         jax.block_until_ready(params_stack)
         dt = time.monotonic() - t0
         total_rounds = sum(chunks)

@@ -3,6 +3,12 @@ sequence/context parallelism, nodes-mode learner executor."""
 
 from p2pfl_tpu.parallel.executor import LearnerExecutor, VirtualNodeLearner  # noqa: F401
 from p2pfl_tpu.parallel.mesh import make_mesh  # noqa: F401
+from p2pfl_tpu.parallel.pipeline import (  # noqa: F401
+    make_pipeline_train_step,
+    pipeline_apply,
+    sequential_apply,
+    stack_stage_params,
+)
 from p2pfl_tpu.parallel.simulation import MeshSimulation  # noqa: F401
 from p2pfl_tpu.parallel.sequence import (  # noqa: F401
     make_sequence_parallel_train_step,

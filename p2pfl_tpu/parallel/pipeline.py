@@ -1,0 +1,177 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a mesh axis.
+
+Not present in the reference (its only parallelism is federated data
+parallelism + Ray task parallelism — SURVEY.md §2) but required for the
+full TPU parallelism matrix (dp/tp/sp/ep/pp): deep models whose layers
+exceed one chip's HBM are split into S stages laid out along a ``stage``
+mesh axis; microbatches stream through the stages with activations handed
+to the next stage via ``lax.ppermute`` (one ICI neighbor hop per tick —
+the classic collective-permute pipeline schedule).
+
+Design (praxis/GPipe-shaped, compiler-friendly):
+
+* stage parameters are a *stage-stacked* pytree — every leaf has leading
+  axis ``S`` sharded over the ``stage`` axis, so each device holds exactly
+  its stage's block weights,
+* the schedule is a ``lax.scan`` over ``M + S - 1`` ticks: stage 0 feeds a
+  fresh microbatch each tick while it has one; every stage applies its
+  block and ppermutes the activation ring-forward; the last stage's
+  outputs are collected into the output buffer during the drain window,
+* everything runs under one ``shard_map`` — ``jax.grad`` differentiates
+  straight through (ppermute's transpose is the reverse permute), so the
+  backward pass is pipelined too,
+* restriction: blocks must preserve the activation shape (true for
+  transformer blocks at constant d_model).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Pytree = Any
+
+
+def pipeline_spmd(
+    block_fn: Callable[[Pytree, jax.Array], jax.Array],
+    n_microbatches: int,
+    axis_name: str = "stage",
+) -> Callable[[Pytree, jax.Array], jax.Array]:
+    """Per-device SPMD body: run the microbatch pipeline over ``axis_name``.
+
+    Args:
+        block_fn: ``block_fn(stage_params, x) -> y`` with ``y.shape ==
+            x.shape`` (one stage's computation).
+        n_microbatches: microbatch count M (must divide the batch).
+        axis_name: mesh axis carrying the stages.
+
+    Returns a function ``(stage_params_local, x) -> y`` to be wrapped in
+    ``shard_map`` with ``in_specs=(P(axis_name), P()), out_specs=P()``.
+    """
+
+    def body(p_local: Pytree, x: jax.Array) -> jax.Array:
+        params = jax.tree.map(lambda a: a[0], p_local)  # [1, ...] -> [...]
+        stage = jax.lax.axis_index(axis_name)
+        # Static at trace time (mesh shapes are static) — same pattern as
+        # ops/ring_attention.py building its ppermute ring.
+        S = jax.lax.psum(1, axis_name)
+        batch = x.shape[0]
+        m_size = batch // n_microbatches
+        micro = x.reshape(n_microbatches, m_size, *x.shape[1:])
+        ring = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            prev_recv, outputs = carry
+            # Stage 0 consumes a fresh microbatch while any remain; other
+            # stages consume what arrived from the left neighbor.
+            feed = micro[jnp.clip(t, 0, n_microbatches - 1)]
+            inp = jnp.where(stage == 0, feed, prev_recv)
+            out = block_fn(params, inp)
+            # Ring-forward one ICI hop (the wrap-around edge only carries
+            # garbage that is never emitted).
+            recv = jax.lax.ppermute(out, axis_name, ring)
+            # The last stage emits microbatch t-(S-1) during the drain window.
+            emit = t - (S - 1)
+            valid = (emit >= 0) & (emit < n_microbatches) & (stage == S - 1)
+            idx = jnp.clip(emit, 0, n_microbatches - 1)
+            outputs = outputs.at[idx].set(jnp.where(valid, out, outputs[idx]))
+            return (recv, outputs), None
+
+        zeros = jnp.zeros((m_size, *x.shape[1:]), x.dtype)
+        out_buf = jnp.zeros_like(micro)
+        (final_recv, outputs), _ = jax.lax.scan(
+            tick, (zeros, out_buf), jnp.arange(n_microbatches + S - 1)
+        )
+        del final_recv
+        # Only the last stage holds real outputs; replicate them to every
+        # stage with a masked psum so out_specs=P() holds.
+        outputs = outputs * (stage == S - 1).astype(outputs.dtype)
+        outputs = jax.lax.psum(outputs, axis_name)
+        return outputs.reshape(batch, *x.shape[1:])
+
+    return body
+
+
+def pipeline_apply(
+    stage_params: Pytree,
+    x: jax.Array,
+    block_fn: Callable[[Pytree, jax.Array], jax.Array],
+    mesh: Mesh,
+    n_microbatches: int,
+    axis_name: str = "stage",
+) -> jax.Array:
+    """Apply S stacked stages to ``x`` as a microbatch pipeline over
+    ``mesh[axis_name]``. Stage parameters must be stage-stacked (leading
+    axis S on every leaf)."""
+    body = pipeline_spmd(block_fn, n_microbatches, axis_name)
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis_name), P()),
+        out_specs=P(),
+        check_vma=False,  # masked-psum replication of the output
+    )
+    return fn(stage_params, x)
+
+
+def sequential_apply(
+    stage_params: Pytree,
+    x: jax.Array,
+    block_fn: Callable[[Pytree, jax.Array], jax.Array],
+    n_stages: int,
+) -> jax.Array:
+    """Reference semantics: the same stages applied one after another
+    (what the pipeline must compute, used by tests and single-device runs)."""
+    for s in range(n_stages):
+        params = jax.tree.map(lambda a, s=s: a[s], stage_params)
+        x = block_fn(params, x)
+    return x
+
+
+def make_pipeline_train_step(
+    block_fn: Callable[[Pytree, jax.Array], jax.Array],
+    loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    n_microbatches: int,
+    axis_name: str = "stage",
+) -> Callable:
+    """Jitted pipelined train step: forward AND backward stream through the
+    stages (grad of ppermute is the reverse ppermute — XLA pipelines both).
+
+    Returns ``step(stage_params, opt_state, x, y) -> (params, opt_state,
+    loss)`` with stage-stacked params sharded over ``axis_name``.
+    """
+    spec = NamedSharding(mesh, P(axis_name))
+
+    @jax.jit
+    def step(stage_params: Pytree, opt_state: Pytree, x: jax.Array, y: jax.Array):
+        def objective(p: Pytree) -> jax.Array:
+            logits = pipeline_apply(p, x, block_fn, mesh, n_microbatches, axis_name)
+            return loss_fn(logits, y)
+
+        loss, grads = jax.value_and_grad(objective)(stage_params)
+        updates, opt_state2 = optimizer.update(grads, opt_state, stage_params)
+        new_params = optax.apply_updates(stage_params, updates)
+        new_params = jax.tree.map(
+            lambda a: jax.lax.with_sharding_constraint(a, spec), new_params
+        )
+        return new_params, opt_state2, loss
+
+    return step
+
+
+def stack_stage_params(
+    params_list: list[Pytree], mesh: Optional[Mesh] = None, axis_name: str = "stage"
+) -> Pytree:
+    """Stack per-stage param pytrees into the stage-stacked layout and (when
+    a mesh is given) shard the stage axis over ``axis_name``."""
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *params_list)
+    if mesh is not None:
+        sharding = NamedSharding(mesh, P(axis_name))
+        stacked = jax.tree.map(lambda a: jax.device_put(a, sharding), stacked)
+    return stacked

@@ -302,6 +302,12 @@ class MeshSimulation:
         # from a checkpoint replays the exact key sequence regardless of how
         # rounds are chunked into compiled calls.
         self.completed_rounds = 0
+        # Abstract state (shapes/dtypes/shardings) so load_from() can rebuild
+        # the population even after a failed donated step deleted it.
+        self._abstract_state = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding),
+            self.state_dict(),
+        )
 
     # --- jitted round body ---------------------------------------------------
 
@@ -516,27 +522,41 @@ class MeshSimulation:
         committees, test_loss, test_acc = [], [], []
         t0 = time.monotonic()
         done = 0
-        for i, chunk in enumerate(chunks):
-            params_stack, opt_stack, c_stack, c_global, comm, _tr, tl, ta = self._run_jit(
-                params_stack, opt_stack, c_stack, c_global,
-                data, jnp.int32(start + done), rounds=chunk, epochs=epochs,
-            )
-            committees.append(comm)
-            test_loss.append(tl)
-            test_acc.append(ta)
-            done += chunk
-            # Save on the cadence, and always after the final chunk so the
-            # end-of-run state is never memory-only.
-            if checkpointer is not None and (
-                (i + 1) % checkpoint_every == 0 or i == len(chunks) - 1
-            ):
-                self.params_stack, self.opt_stack = params_stack, opt_stack
-                self.c_stack, self.c_global = c_stack, c_global
-                self.completed_rounds = start + done
-                self.save_to(checkpointer)
-                # The next chunk DONATES these buffers to XLA; an async save
-                # still reading them would race the in-place reuse.
-                checkpointer.wait()
+        try:
+            for i, chunk in enumerate(chunks):
+                params_stack, opt_stack, c_stack, c_global, comm, _tr, tl, ta = self._run_jit(
+                    params_stack, opt_stack, c_stack, c_global,
+                    data, jnp.int32(start + done), rounds=chunk, epochs=epochs,
+                )
+                committees.append(comm)
+                test_loss.append(tl)
+                test_acc.append(ta)
+                done += chunk
+                # Save on the cadence, and always after the final chunk so the
+                # end-of-run state is never memory-only.
+                if checkpointer is not None and (
+                    (i + 1) % checkpoint_every == 0 or i == len(chunks) - 1
+                ):
+                    self.params_stack, self.opt_stack = params_stack, opt_stack
+                    self.c_stack, self.c_global = c_stack, c_global
+                    self.completed_rounds = start + done
+                    self.save_to(checkpointer)
+                    # The next chunk DONATES these buffers to XLA; an async
+                    # save still reading them would race the in-place reuse.
+                    checkpointer.wait()
+        except BaseException as e:
+            # The failed step's input buffers were donated (deleted) — the
+            # in-memory population state is unrecoverable. Make that an
+            # explicit contract instead of later 'Array has been deleted'
+            # confusion; completed_rounds stays at the last checkpoint so
+            # load_from() + run() resumes cleanly.
+            self.params_stack = self.opt_stack = None
+            self.c_stack = self.c_global = None
+            raise RuntimeError(
+                "simulation step failed after its population buffers were "
+                "donated; restore with load_from(checkpointer) before "
+                "running again"
+            ) from e
         jax.block_until_ready(params_stack)
         dt = time.monotonic() - t0
         total_rounds = sum(chunks)
@@ -555,6 +575,11 @@ class MeshSimulation:
 
     def final_model(self, node: int = 0) -> ModelHandle:
         """Extract one node's model (they're all equal after diffusion)."""
+        if self.params_stack is None:
+            raise RuntimeError(
+                "population state lost in a failed donated step; "
+                "load_from(checkpointer) to restore"
+            )
         params = jax.tree.map(lambda a: a[node], self.params_stack)
         return self.model.build_copy(params=params)
 
@@ -584,7 +609,10 @@ class MeshSimulation:
         ``fold_in(key(seed), round)``, so resuming under a different seed
         would silently diverge from the original run's key sequence.
         """
-        state, meta = checkpointer.restore(self.state_dict(), step)
+        template = (
+            self.state_dict() if self.params_stack is not None else self._abstract_state
+        )
+        state, meta = checkpointer.restore(template, step)
         self.params_stack = state["params_stack"]
         self.opt_stack = state["opt_stack"]
         if self.algorithm == "scaffold":

@@ -1,0 +1,97 @@
+"""Pipeline parallelism (GPipe over a `stage` mesh axis): exact forward
+equivalence vs sequential stage application, gradient equivalence, and a
+pipelined training loop that learns. No reference analogue — part of the
+full dp/tp/sp/ep/pp parallelism matrix."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from p2pfl_tpu.parallel.mesh import make_mesh
+from p2pfl_tpu.parallel.pipeline import (
+    make_pipeline_train_step,
+    pipeline_apply,
+    sequential_apply,
+    stack_stage_params,
+)
+
+D = 16
+
+
+def _block_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _stage_params(seed, n_stages):
+    rng = np.random.default_rng(seed)
+    return [
+        {
+            "w": jnp.asarray(rng.normal(scale=0.5, size=(D, D)), jnp.float32),
+            "b": jnp.asarray(rng.normal(scale=0.1, size=(D,)), jnp.float32),
+        }
+        for _ in range(n_stages)
+    ]
+
+
+@pytest.fixture(scope="module")
+def stage_mesh():
+    return make_mesh((4,), ("stage",), devices=jax.devices()[:4])
+
+
+def test_pipeline_matches_sequential_forward(stage_mesh):
+    n_stages, batch, micro = 4, 16, 4
+    params = _stage_params(0, n_stages)
+    stacked = stack_stage_params(params, stage_mesh)
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(batch, D)), jnp.float32)
+
+    piped = pipeline_apply(stacked, x, _block_fn, stage_mesh, micro)
+    seq = sequential_apply(stacked, x, _block_fn, n_stages)
+    np.testing.assert_allclose(np.asarray(piped), np.asarray(seq), atol=1e-6)
+
+
+def test_pipeline_stage_params_actually_sharded(stage_mesh):
+    stacked = stack_stage_params(_stage_params(0, 4), stage_mesh)
+    w = stacked["w"]
+    assert "stage" in w.sharding.spec
+    assert w.addressable_shards[0].data.shape[0] == 1  # one stage per device
+
+
+def test_pipeline_gradients_match_sequential(stage_mesh):
+    n_stages, batch, micro = 4, 16, 4
+    stacked = stack_stage_params(_stage_params(2, n_stages), stage_mesh)
+    x = jnp.asarray(np.random.default_rng(3).normal(size=(batch, D)), jnp.float32)
+    y = jnp.asarray(np.random.default_rng(4).normal(size=(batch, D)), jnp.float32)
+
+    def loss_piped(p):
+        return jnp.mean((pipeline_apply(p, x, _block_fn, stage_mesh, micro) - y) ** 2)
+
+    def loss_seq(p):
+        return jnp.mean((sequential_apply(p, x, _block_fn, n_stages) - y) ** 2)
+
+    g_piped = jax.grad(loss_piped)(stacked)
+    g_seq = jax.grad(loss_seq)(stacked)
+    for a, b in zip(jax.tree.leaves(g_piped), jax.tree.leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_pipeline_train_step_learns(stage_mesh):
+    n_stages, batch, micro = 4, 32, 4
+    stacked = stack_stage_params(_stage_params(5, n_stages), stage_mesh)
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(stacked)
+    x = jnp.asarray(np.random.default_rng(6).normal(size=(batch, D)), jnp.float32)
+    y = jnp.tanh(x @ jnp.ones((D, D), jnp.float32) * 0.1)
+
+    step = make_pipeline_train_step(
+        _block_fn, lambda out, tgt: jnp.mean((out - tgt) ** 2), opt, stage_mesh, micro
+    )
+    params, losses = stacked, []
+    for _ in range(30):
+        params, opt_state, loss = step(params, opt_state, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < 0.5 * losses[0], losses[:3] + losses[-3:]
+    # stage sharding preserved through updates
+    assert "stage" in params["w"].sharding.spec

@@ -153,13 +153,13 @@ class TransformerLM(nn.Module):
     block_k: int = 512
     compute_dtype: jnp.dtype = jnp.bfloat16
 
-    @nn.compact
-    def __call__(self, tokens: jax.Array) -> jax.Array:
-        x = nn.Embed(self.vocab_size, self.embed_dim, dtype=self.compute_dtype, name="embed")(
-            tokens.astype(jnp.int32)
-        )
-        for i in range(self.num_layers):
-            x = Block(
+    def setup(self) -> None:
+        # setup-style (not @nn.compact) so embed_tokens/head can be invoked
+        # standalone via apply(method=...) — the pipeline-parallel wrapper
+        # reuses them instead of re-declaring the layers.
+        self.embed = nn.Embed(self.vocab_size, self.embed_dim, dtype=self.compute_dtype)
+        self.blocks = [
+            Block(
                 num_heads=self.num_heads,
                 mlp_ratio=self.mlp_ratio,
                 attention_kind=self.attention_kind,
@@ -167,12 +167,25 @@ class TransformerLM(nn.Module):
                 block_k=self.block_k,
                 compute_dtype=self.compute_dtype,
                 name=f"block{i}",
-            )(x)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
-        logits = nn.Dense(
-            self.vocab_size, use_bias=False, dtype=self.compute_dtype, name="lm_head"
-        )(x.astype(self.compute_dtype))
+            )
+            for i in range(self.num_layers)
+        ]
+        self.ln_f = nn.LayerNorm(dtype=jnp.float32)
+        self.lm_head = nn.Dense(self.vocab_size, use_bias=False, dtype=self.compute_dtype)
+
+    def embed_tokens(self, tokens: jax.Array) -> jax.Array:
+        return self.embed(tokens.astype(jnp.int32))
+
+    def head(self, x: jax.Array) -> jax.Array:
+        x = self.ln_f(x)
+        logits = self.lm_head(x.astype(self.compute_dtype))
         return logits.astype(jnp.float32)
+
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        x = self.embed_tokens(tokens)
+        for block in self.blocks:
+            x = block(x)
+        return self.head(x)
 
 
 class TransformerClassifier(nn.Module):

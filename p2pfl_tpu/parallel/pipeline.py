@@ -26,7 +26,7 @@ Design (praxis/GPipe-shaped, compiler-friendly):
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -175,3 +175,92 @@ def stack_stage_params(
         sharding = NamedSharding(mesh, P(axis_name))
         stacked = jax.tree.map(lambda a: jax.device_put(a, sharding), stacked)
     return stacked
+
+
+def make_pipelined_transformer_lm(
+    model,
+    mesh: Mesh,
+    n_microbatches: int,
+    axis_name: str = "stage",
+) -> Tuple[Pytree, Callable[[Pytree, jax.Array], jax.Array]]:
+    """Stage a :class:`~p2pfl_tpu.models.transformer.TransformerLM` over a
+    pipeline mesh axis.
+
+    The embed / final-LN / lm-head params stay replicated; the transformer
+    blocks are stage-stacked (``num_layers`` must divide evenly by the
+    ``stage`` axis size) and applied through the GPipe schedule — blocks
+    preserve ``[B, S, D]``, exactly the pipeline restriction.
+
+    Args:
+        model: a ``ModelHandle`` from :func:`p2pfl_tpu.models.
+            transformer_lm_model` (attention must not need a mesh axis of
+            its own, i.e. ``attention_kind != 'ring'``).
+
+    Returns ``(pipeline_params, apply_fn)`` where ``pipeline_params`` is
+    ``{"embed", "stages", "ln_f", "lm_head"}`` (stages sharded over
+    ``axis_name``) and ``apply_fn(pipeline_params, tokens) -> logits``
+    matches ``model.apply_fn(model.params, tokens)``.
+    """
+    from p2pfl_tpu.models.transformer import Block
+
+    module = model.model_def
+    if module.attention_kind == "ring":
+        raise ValueError(
+            "pipelined LM needs a per-stage attention kind (ring attention "
+            "owns its own mesh axis); use 'blockwise', 'flash', or 'dense'"
+        )
+    n_stages = int(mesh.shape[axis_name])
+    if module.num_layers % n_stages != 0:
+        raise ValueError(
+            f"num_layers={module.num_layers} must divide evenly over "
+            f"{n_stages} stages"
+        )
+    per_stage = module.num_layers // n_stages
+    inner = model.params["params"]
+
+    stage_trees = [
+        {f"b{j}": inner[f"block{s * per_stage + j}"] for j in range(per_stage)}
+        for s in range(n_stages)
+    ]
+    pipeline_params = {
+        "embed": inner["embed"],
+        "stages": stack_stage_params(stage_trees, mesh, axis_name),
+        "ln_f": inner["ln_f"],
+        "lm_head": inner["lm_head"],
+    }
+
+    block_mod = Block(
+        num_heads=module.num_heads,
+        mlp_ratio=module.mlp_ratio,
+        attention_kind=module.attention_kind,
+        axis_name=None,
+        block_k=module.block_k,
+        compute_dtype=module.compute_dtype,
+    )
+
+    def block_fn(stage_params: Pytree, x: jax.Array) -> jax.Array:
+        for j in range(per_stage):
+            x = block_mod.apply({"params": stage_params[f"b{j}"]}, x)
+        return x
+
+    def apply_fn(params: Pytree, tokens: jax.Array) -> jax.Array:
+        if tokens.shape[0] % n_microbatches != 0:
+            raise ValueError(
+                f"batch {tokens.shape[0]} must divide evenly into "
+                f"{n_microbatches} microbatches"
+            )
+        # Embed/head run through the model's OWN methods (single definition
+        # of the layer hyperparameters — transformer.py setup()).
+        x = module.apply(
+            {"params": {"embed": params["embed"]}}, tokens, method="embed_tokens"
+        )
+        x = pipeline_apply(
+            params["stages"], x, block_fn, mesh, n_microbatches, axis_name
+        )
+        return module.apply(
+            {"params": {"ln_f": params["ln_f"], "lm_head": params["lm_head"]}},
+            x,
+            method="head",
+        )
+
+    return pipeline_params, apply_fn

@@ -230,16 +230,24 @@ def bench_mfu(device_kind: str) -> dict:
     }
 
 
-def measure_reference_baseline() -> dict:
+def measure_reference_baseline(remaining: float = float("inf")) -> dict:
     """Measure the actual reference federation via the attempt ladder: run
     THIS file with --baseline-ref in a CPU-pinned subprocess (the reference
     import must never touch the TPU backend) and parse its single JSON
-    line. Returns the largest completing configuration."""
+    line. Returns the largest completing configuration. Each rung's
+    subprocess timeout is capped by the caller's ``remaining`` soft budget
+    (minus a reserve for the fallback path), so the whole bench cannot
+    overshoot its budget chasing a slow rung."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     last_err = "ladder empty"
+    deadline = time.monotonic() + remaining
     for nodes, rounds, budget in BASELINE_LADDER:
-        _phase(f"reference baseline attempt: {nodes} nodes x {rounds} round(s), cap {budget}s")
+        budget = min(budget, deadline - time.monotonic() - 60.0)  # 60s reserve
+        if budget < 90.0:
+            last_err = "soft budget exhausted before this rung"
+            break
+        _phase(f"reference baseline attempt: {nodes} nodes x {rounds} round(s), cap {budget:.0f}s")
         try:
             proc = subprocess.run(
                 [
@@ -424,8 +432,11 @@ def main() -> None:
         "extra": {},
     }
     t_start = time.monotonic()
-    soft_budget = float(os.environ.get("P2PFL_TPU_BENCH_BUDGET", "1500"))
     try:
+        try:
+            soft_budget = float(os.environ.get("P2PFL_TPU_BENCH_BUDGET", "1500"))
+        except ValueError:
+            soft_budget = 1500.0
         kind = probe_backend()
         tpu = bench_tpu()
         # A slow tunnel/compile must not push the whole bench past the
@@ -443,11 +454,12 @@ def main() -> None:
                 mfu = {"error": f"{type(e).__name__}: {e}"}
         _phase("measuring reference baseline (subprocess, CPU)")
         try:
-            if time.monotonic() - t_start > soft_budget * 0.6:
+            remaining = soft_budget - (time.monotonic() - t_start)
+            if remaining < 240.0:
                 _phase("soft budget tight: using torch-loop fallback baseline")
                 base = bench_torch_cpu_fallback()
             else:
-                base = measure_reference_baseline()
+                base = measure_reference_baseline(remaining)
         except Exception as e:  # noqa: BLE001
             traceback.print_exc(file=sys.stderr)
             _phase(f"reference baseline failed ({e}); falling back to torch loop")

@@ -16,6 +16,11 @@ EXAMPLES = {
         "--protocol/--aggregator/--mode (mesh = one sharded XLA program, "
         "nodes = full async gossip protocol).",
     ),
+    "longcontext": (
+        "p2pfl_tpu.examples.longcontext",
+        "Federated long-context LM fine-tuning over the mesh (task='lm'): "
+        "--seq-len/--attention {blockwise,flash,dense}/--layers/--nodes.",
+    ),
     "node1": (
         "p2pfl_tpu.examples.node1",
         "Two-process gRPC quickstart, process 1 (waits for node2, then trains).",

@@ -1,0 +1,112 @@
+"""Federated long-context LM fine-tuning example.
+
+No reference analogue (the reference's models are MNIST-scale MLPs —
+SURVEY.md §5 "long-context: absent"): N federated nodes fine-tune a
+decoder-only transformer on their private token corpora through the mesh
+simulation's causal-LM path (``MeshSimulation(task="lm")``), with the
+attention kind selectable — ``blockwise`` (O(S)-memory online softmax),
+``flash`` (Pallas TPU kernel), or ``dense``. For context lengths beyond
+one chip's HBM, use ring attention over a sequence mesh axis via
+``parallel.sequence.make_sequence_parallel_train_step`` (a separate
+training path — it owns its own mesh axis, so it isn't a flag here).
+
+The corpus is synthetic-but-learnable: arithmetic token progressions mod
+the vocab, so next-token loss falls fast and the example is self-checking.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="p2pfl-tpu experiment run longcontext", description=__doc__
+    )
+    p.add_argument("--nodes", type=int, default=8)
+    p.add_argument("--rounds", type=int, default=4)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--seq-len", type=int, default=256)
+    p.add_argument("--vocab", type=int, default=64)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--embed-dim", type=int, default=64)
+    p.add_argument("--seqs-per-node", type=int, default=32)
+    p.add_argument("--batch-size", type=int, default=4)
+    p.add_argument("--train-set-size", type=int, default=4)
+    p.add_argument(
+        "--attention",
+        choices=["blockwise", "flash", "dense"],
+        default="blockwise",
+        help="attention kind inside the federated LM",
+    )
+    p.add_argument("--lr", type=float, default=5e-3)
+    p.add_argument("--measure-time", action="store_true")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--platform", choices=["default", "cpu", "tpu"], default="default"
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.platform != "default":
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    import numpy as np
+
+    from p2pfl_tpu.models import transformer_lm_model
+    from p2pfl_tpu.parallel.simulation import MeshSimulation
+
+    rng = np.random.default_rng(args.seed)
+    n, s, length = args.nodes, args.seqs_per_node, args.seq_len
+    starts = rng.integers(0, args.vocab, size=(n, s, 1))
+    x = ((starts + np.arange(length)[None, None, :]) % args.vocab).astype(np.int32)
+    y = np.zeros((n, s), np.int32)  # unused for task="lm"
+    mask = np.ones((n, s), np.float32)
+    xt = (
+        (rng.integers(0, args.vocab, size=(16, 1)) + np.arange(length)) % args.vocab
+    ).astype(np.int32)
+
+    model = transformer_lm_model(
+        seed=args.seed,
+        seq_len=length,
+        vocab_size=args.vocab,
+        num_layers=args.layers,
+        num_heads=args.heads,
+        embed_dim=args.embed_dim,
+        attention_kind=args.attention,
+    )
+    sim = MeshSimulation(
+        model,
+        (x, y, mask),
+        test_data=(xt, None),
+        train_set_size=args.train_set_size,
+        batch_size=args.batch_size,
+        lr=args.lr,
+        seed=args.seed,
+        task="lm",
+    )
+    t0 = time.time()
+    res = sim.run(rounds=args.rounds, epochs=args.epochs, warmup=True)
+    result = {
+        "seq_len": length,
+        "attention": args.attention,
+        "sec_per_round": round(res.seconds_per_round, 4),
+        "first_token_loss": round(res.test_loss[0], 4),
+        "final_token_loss": round(res.test_loss[-1], 4),
+        "final_token_acc": round(res.test_acc[-1], 4),
+    }
+    if args.measure_time:
+        result["total_elapsed_s"] = round(time.time() - t0, 3)
+    print(result)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,16 +9,16 @@ Two execution modes (SURVEY.md §7 "hard parts"):
   objects running the async gossip protocol (in-memory or gRPC transport),
   exactly like the reference example.
 
-Profiling uses stdlib :mod:`cProfile` (the reference wires yappi,
-examples/mnist.py:264-297); output goes under ``profile/mnist/``.
+Profiling goes through :mod:`p2pfl_tpu.management.profiler` (the reference
+wires yappi, examples/mnist.py:264-297): ``--profiling`` writes a host
+cProfile ``.pstat`` under ``profile/mnist/``; ``--trace DIR`` additionally
+captures the on-device XLA timeline (TensorBoard/Perfetto-viewable).
 """
 
 from __future__ import annotations
 
 import argparse
 import sys
-import time
-import uuid
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -51,6 +51,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--samples-per-node", type=int, default=300)
     p.add_argument("--measure-time", action="store_true")
     p.add_argument("--profiling", action="store_true", help="cProfile the run")
+    p.add_argument(
+        "--trace",
+        metavar="DIR",
+        default=None,
+        help="write an on-device XLA profiler trace under DIR",
+    )
     p.add_argument("--seed", type=int, default=42)
     p.add_argument(
         "--platform",
@@ -191,29 +197,17 @@ def main(argv=None) -> int:
 
         jax.config.update("jax_platforms", args.platform)
 
-    prof = None
-    if args.profiling:
-        import cProfile
+    from p2pfl_tpu.management.profiler import profile_run
 
-        prof = cProfile.Profile()
-        prof.enable()
-
-    t0 = time.time()
-    result = run_mesh(args) if args.mode == "mesh" else run_nodes(args)
-    elapsed = time.time() - t0
-
-    if prof is not None:
-        import pathlib
-
-        prof.disable()
-        out = pathlib.Path("profile") / "mnist"
-        out.mkdir(parents=True, exist_ok=True)
-        path = out / f"{uuid.uuid4().hex}.pstat"
-        prof.dump_stats(str(path))
-        print(f"profile written to {path}", file=sys.stderr)
+    with profile_run(
+        host_dir="profile/mnist" if args.profiling else None,
+        device_trace_dir=args.trace,
+        label="mnist",
+    ) as prof_info:
+        result = run_mesh(args) if args.mode == "mesh" else run_nodes(args)
 
     if args.measure_time:
-        result["total_elapsed_s"] = round(elapsed, 3)
+        result["total_elapsed_s"] = round(prof_info["elapsed_s"], 3)
     print(result)
     return 0
 

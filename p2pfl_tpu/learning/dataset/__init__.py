@@ -1,9 +1,18 @@
 """Dataset wrapper, partition strategies, and jax export."""
 
-from p2pfl_tpu.learning.dataset.dataset import FederatedDataset, synthetic_mnist  # noqa: F401
+from p2pfl_tpu.learning.dataset.dataset import (  # noqa: F401
+    FederatedDataset,
+    mnist,
+    synthetic_mnist,
+)
 from p2pfl_tpu.learning.dataset.partition import (  # noqa: F401
     DirichletPartitionStrategy,
     LabelSkewedPartitionStrategy,
     PercentageBasedNonIIDPartitionStrategy,
     RandomIIDPartitionStrategy,
+)
+from p2pfl_tpu.learning.dataset.vision import (  # noqa: F401
+    from_vision_datasets,
+    load_torchvision,
+    vision_pairs_to_arrays,
 )

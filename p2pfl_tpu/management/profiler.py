@@ -1,0 +1,75 @@
+"""Profiling: host cProfile plus on-device XLA traces.
+
+Capability parity with the reference's profiling hook (yappi around the
+example run, p2pfl/examples/mnist.py:264-297 — host-side Python stacks
+saved as .pstat files). TPU-first upgrade: in this framework the entire
+round loop is ONE jitted XLA program, so host profiles show a single
+opaque ``execute`` call; :func:`profile_run` therefore also captures the
+device timeline with ``jax.profiler.trace`` (per-op XLA execution, fusion
+boundaries, HBM traffic), viewable in TensorBoard / Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import cProfile
+import pathlib
+import sys
+import time
+import uuid
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def profile_run(
+    host_dir: Optional[str] = None,
+    device_trace_dir: Optional[str] = None,
+    label: str = "run",
+) -> Iterator[dict]:
+    """Profile the enclosed block.
+
+    Args:
+        host_dir: if set, write a cProfile ``.pstat`` of the host Python
+            under this directory (the reference's capability).
+        device_trace_dir: if set, wrap the block in ``jax.profiler.trace``
+            writing an XLA device trace under this directory.
+        label: filename stem for the host profile.
+
+    Yields a dict filled in on exit: ``elapsed_s`` plus the artifact paths
+    that were written (``host_profile``, ``device_trace``).
+    """
+    info: dict = {}
+    prof = None
+    if host_dir is not None:
+        prof = cProfile.Profile()
+
+    stack = contextlib.ExitStack()
+    if device_trace_dir is not None:
+        import jax
+
+        pathlib.Path(device_trace_dir).mkdir(parents=True, exist_ok=True)
+        stack.enter_context(jax.profiler.trace(device_trace_dir))
+        info["device_trace"] = device_trace_dir
+
+    t0 = time.monotonic()
+    if prof is not None:
+        prof.enable()
+    try:
+        with stack:
+            try:
+                yield info
+            finally:
+                # Stamp + stop the host profiler before the trace context
+                # exits: serializing the xplane files can take seconds and
+                # is neither run time nor hot-path frames.
+                info["elapsed_s"] = round(time.monotonic() - t0, 4)
+                if prof is not None:
+                    prof.disable()
+    finally:
+        if prof is not None:
+            out = pathlib.Path(host_dir)
+            out.mkdir(parents=True, exist_ok=True)
+            path = out / f"{label}-{uuid.uuid4().hex}.pstat"
+            prof.dump_stats(str(path))
+            info["host_profile"] = str(path)
+            print(f"host profile written to {path}", file=sys.stderr)

@@ -98,3 +98,93 @@ def test_train_test_split_from_arrays():
     ds.generate_train_test_split(test_size=0.25, seed=0)
     assert ds.get_num_samples(True) == 75
     assert ds.get_num_samples(False) == 25
+
+
+class _FakeVisionDataset:
+    """Map-style (image, label) dataset, shaped like torchvision's."""
+
+    def __init__(self, n, uint8=True, seed=0):
+        rng = np.random.default_rng(seed)
+        if uint8:
+            self.images = rng.integers(0, 256, size=(n, 28, 28), dtype=np.uint8)
+        else:
+            self.images = rng.uniform(size=(n, 28, 28)).astype(np.float32)
+        self.labels = rng.integers(0, 10, size=n)
+
+    def __iter__(self):
+        return zip(self.images, self.labels)
+
+
+def test_vision_pairs_to_arrays_uint8_rescale():
+    from p2pfl_tpu.learning.dataset import vision_pairs_to_arrays
+
+    x, y = vision_pairs_to_arrays(_FakeVisionDataset(16))
+    assert x.shape == (16, 28, 28) and x.dtype == np.float32
+    assert 0.0 <= x.min() and x.max() <= 1.0
+    assert y.shape == (16,) and y.dtype == np.int32
+
+
+def test_from_vision_datasets_end_to_end():
+    from p2pfl_tpu.learning.dataset import from_vision_datasets
+
+    ds = from_vision_datasets(_FakeVisionDataset(64), _FakeVisionDataset(16, seed=1))
+    assert ds.get_num_samples(True) == 64
+    assert ds.get_num_samples(False) == 16
+    parts = ds.generate_partitions(4, RandomIIDPartitionStrategy, seed=0)
+    xb, yb, wb = parts[0].export_batches(8)
+    assert xb.shape == (2, 8, 28, 28)
+
+
+def test_load_torchvision_gated(tmp_path):
+    from p2pfl_tpu.learning.dataset import load_torchvision
+
+    try:
+        import torchvision  # noqa: F401
+    except ImportError:
+        with pytest.raises(ImportError, match="synthetic_mnist"):
+            load_torchvision("MNIST", cache_dir=str(tmp_path))
+    else:  # pragma: no cover - torchvision present: no-egress environment,
+        # so only assert the no-download path fails cleanly, never fetch
+        with pytest.raises(RuntimeError):
+            load_torchvision("MNIST", cache_dir=str(tmp_path), download=False)
+
+
+def test_vision_dense_fast_path_and_int_rescale():
+    from p2pfl_tpu.learning.dataset import vision_pairs_to_arrays
+
+    class DenseStyle:  # torchvision-like: whole split as .data/.targets
+        data = np.arange(4 * 28 * 28, dtype=np.uint16).reshape(4, 28, 28)
+        targets = [0, 1, 2, 3]
+
+        def __iter__(self):  # pragma: no cover - fast path must win
+            raise AssertionError("fast path not taken")
+
+    x, y = vision_pairs_to_arrays(DenseStyle())
+    assert x.dtype == np.float32 and x.max() <= 1.0
+    np.testing.assert_array_equal(y, [0, 1, 2, 3])
+
+
+def test_vision_fast_path_respects_transforms_and_empty():
+    from p2pfl_tpu.learning.dataset import vision_pairs_to_arrays
+
+    class WithTransform:
+        data = np.zeros((2, 4, 4), dtype=np.uint8)
+        targets = [0, 1]
+        transform = staticmethod(lambda img: np.asarray(img) + 1.0)
+
+        def __iter__(self):
+            for img, t in zip(self.data, self.targets):
+                yield self.transform(img), t
+
+    x, _ = vision_pairs_to_arrays(WithTransform())
+    assert x.min() == 1.0  # transform applied -> per-item path was taken
+
+    class Empty:
+        data = np.zeros((0, 4, 4), dtype=np.uint8)
+        targets = []
+
+        def __iter__(self):
+            return iter(())
+
+    with pytest.raises(ValueError, match="empty"):
+        vision_pairs_to_arrays(Empty())

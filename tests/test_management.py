@@ -64,3 +64,29 @@ def test_metric_routing_step_vs_round():
         assert glob["routing-exp"][node]["test_acc"] == [(0, 0.9)]
     finally:
         logger.unregister_node(node)
+
+
+def test_profile_run_host_and_device_trace(tmp_path):
+    """profile_run writes a host .pstat and an XLA device trace
+    (TPU-first upgrade over the reference's yappi hook,
+    examples/mnist.py:264-297)."""
+    import jax.numpy as jnp
+
+    from p2pfl_tpu.management.profiler import profile_run
+
+    host_dir = tmp_path / "host"
+    trace_dir = tmp_path / "trace"
+    with profile_run(str(host_dir), str(trace_dir), label="t") as info:
+        jnp.dot(jnp.ones((8, 8)), jnp.ones((8, 8))).block_until_ready()
+    assert info["elapsed_s"] >= 0
+    assert list(host_dir.glob("t-*.pstat"))
+    # jax.profiler.trace writes plugins/profile/<ts>/*.xplane.pb
+    assert list(trace_dir.rglob("*.xplane.pb"))
+
+
+def test_profile_run_noop_paths():
+    from p2pfl_tpu.management.profiler import profile_run
+
+    with profile_run() as info:
+        pass
+    assert "host_profile" not in info and "device_trace" not in info

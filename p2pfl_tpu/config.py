@@ -59,6 +59,12 @@ class Settings:
     GOSSIP_EXIT_ON_X_EQUAL_ROUNDS: int = _env_override("GOSSIP_EXIT_ON_X_EQUAL_ROUNDS", 10)
     AMOUNT_LAST_MESSAGES_SAVED: int = _env_override("AMOUNT_LAST_MESSAGES_SAVED", 100)
 
+    # --- wire compression ---------------------------------------------------
+    # Lossy-but-bounded codec for gossiped weights ("none" | "bf16" | "int8",
+    # ops/compression.py). Sender-local: the codec spec rides in the frame,
+    # so mixed settings across a federation interoperate.
+    WIRE_COMPRESSION: str = _env_override("WIRE_COMPRESSION", "none")
+
     # --- learning round -----------------------------------------------------
     TRAIN_SET_SIZE: int = _env_override("TRAIN_SET_SIZE", 4)
     VOTE_TIMEOUT: float = _env_override("VOTE_TIMEOUT", 60.0)

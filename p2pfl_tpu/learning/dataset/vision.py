@@ -53,9 +53,9 @@ def vision_pairs_to_arrays(
     # image per sample).
     data = getattr(dataset, "data", None)
     targets = getattr(dataset, "targets", None)
-    has_transform = (
-        getattr(dataset, "transform", None) is not None
-        or getattr(dataset, "target_transform", None) is not None
+    has_transform = any(
+        getattr(dataset, attr, None) is not None
+        for attr in ("transform", "target_transform", "transforms")
     )
     if data is not None and targets is not None and not has_transform:
         x = _rescale(np.asarray(data))

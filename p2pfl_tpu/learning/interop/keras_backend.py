@@ -88,33 +88,32 @@ class KerasModelHandle(ModelHandle):
 
     # --- canonical wire layout (heterogeneous federations) -------------------
 
-    def encode_parameters(self) -> bytes:
+    def encode_parameters(self, compression: Optional[str] = None) -> bytes:
         if self._to_wire is None:
-            return super().encode_parameters()
+            return super().encode_parameters(compression)
         if "scaffold" in self.additional_info or "scaffold_server" in self.additional_info:
             raise ValueError(
                 "SCAFFOLD payloads cannot cross the canonical wire: their "
                 "leaves are framework-layout specific (use a homogeneous "
                 "federation for the Scaffold aggregator)"
             )
-        from p2pfl_tpu.ops.serialization import serialize_arrays
+        from p2pfl_tpu.models.model_handle import encode_wire_frame
 
-        return serialize_arrays(
+        return encode_wire_frame(
             [np.asarray(a) for a in self._to_wire(self.params)],
-            {
-                "contributors": self.contributors,
-                "num_samples": self.num_samples,
-                "additional_info": self.additional_info,
-            },
+            self.contributors,
+            self.num_samples,
+            self.additional_info,
+            compression,
         )
 
     def set_parameters(self, params) -> None:
         if self._from_wire is not None and isinstance(
             params, (bytes, bytearray, memoryview)
         ):
-            from p2pfl_tpu.ops.serialization import deserialize_arrays
+            from p2pfl_tpu.models.model_handle import decode_wire_frame
 
-            arrays, meta = deserialize_arrays(bytes(params))
+            arrays, meta = decode_wire_frame(params)
             self.contributors = list(meta.get("contributors", self.contributors))
             self.num_samples = int(meta.get("num_samples", self.num_samples))
             self.additional_info.update(meta.get("additional_info", {}))

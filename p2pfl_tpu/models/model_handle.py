@@ -20,10 +20,60 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 import jax
 import numpy as np
 
+from p2pfl_tpu.config import Settings
 from p2pfl_tpu.exceptions import DecodingParamsError, ModelNotMatchingError
+from p2pfl_tpu.ops.compression import (
+    CODEC_META_KEY,
+    compress_arrays,
+    decompress_arrays,
+)
 from p2pfl_tpu.ops.serialization import deserialize_arrays, serialize_arrays
 
 Pytree = Any
+
+
+def encode_wire_frame(
+    arrays: Sequence[np.ndarray],
+    contributors: List[str],
+    num_samples: int,
+    additional_info: Dict[str, Any],
+    compression: Optional[str] = None,
+) -> bytes:
+    """Build a PFLT weights frame: tensors + federation metadata, with the
+    wire codec (default ``Settings.WIRE_COMPRESSION``) applied and its spec
+    recorded in the frame so any receiver can reconstruct full precision.
+    Shared by the JAX handle and the interop backends' canonical wire."""
+    if compression is None:
+        compression = Settings.WIRE_COMPRESSION
+    meta: Dict[str, Any] = {
+        "contributors": contributors,
+        "num_samples": num_samples,
+        "additional_info": additional_info,
+    }
+    if compression != "none":
+        arrays, spec = compress_arrays(arrays, compression)
+        meta[CODEC_META_KEY] = spec
+    return serialize_arrays(list(arrays), meta)
+
+
+def decode_wire_frame(blob: bytes) -> tuple[List[np.ndarray], Dict[str, Any]]:
+    """Decode a PFLT weights frame, inverting any wire codec it declares.
+
+    Raises :class:`DecodingParamsError` on any malformed input — including a
+    malformed codec spec — so transport-thread command handlers see one
+    exception type for all bad frames (same contract as
+    :func:`~p2pfl_tpu.ops.serialization.deserialize_arrays`).
+    """
+    arrays, meta = deserialize_arrays(bytes(blob))
+    arrays = list(arrays)
+    if CODEC_META_KEY in meta:
+        try:
+            arrays = decompress_arrays(arrays, meta[CODEC_META_KEY])
+        except DecodingParamsError:
+            raise
+        except Exception as exc:
+            raise DecodingParamsError(f"malformed wire codec spec: {exc}") from exc
+    return arrays, meta
 
 
 class ModelHandle:
@@ -78,11 +128,10 @@ class ModelHandle:
             DecodingParamsError: wire bytes are malformed.
         """
         if isinstance(params, (bytes, bytearray, memoryview)):
-            arrays, meta = deserialize_arrays(bytes(params))
+            flat, meta = decode_wire_frame(params)
             self.contributors = list(meta.get("contributors", self.contributors))
             self.num_samples = int(meta.get("num_samples", self.num_samples))
             self.additional_info.update(meta.get("additional_info", {}))
-            flat = list(arrays)
         elif isinstance(params, (list, tuple)):
             flat = list(params)
         else:  # pytree
@@ -100,16 +149,22 @@ class ModelHandle:
         ]
         self.params = jax.tree.unflatten(self._treedef, cast)
 
-    def encode_parameters(self) -> bytes:
+    def encode_parameters(self, compression: Optional[str] = None) -> bytes:
         """Serialize params + metadata for the wire (reference encodes with
-        pickle at p2pfl_model.py:71-86; here: safe flat buffers)."""
-        return serialize_arrays(
+        pickle at p2pfl_model.py:71-86; here: safe flat buffers).
+
+        ``compression`` (default ``Settings.WIRE_COMPRESSION``) applies a
+        lossy-but-bounded per-tensor codec at the wire boundary only
+        (:mod:`p2pfl_tpu.ops.compression`); the receiver's
+        :meth:`set_parameters` reconstructs full-precision arrays from the
+        codec spec carried in the frame metadata.
+        """
+        return encode_wire_frame(
             self.get_parameters(),
-            {
-                "contributors": self.contributors,
-                "num_samples": self.num_samples,
-                "additional_info": self.additional_info,
-            },
+            self.contributors,
+            self.num_samples,
+            self.additional_info,
+            compression,
         )
 
     @staticmethod

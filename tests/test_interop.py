@@ -149,3 +149,28 @@ def test_torch_to_jax_weight_translation_exact():
     back = jax_mlp_params_to_torch(jax_params)
     for k, v in tm.params.items():
         np.testing.assert_array_equal(back[k], v)
+
+
+def test_canonical_wire_with_compression():
+    """A torch handle's canonical-wire frame compressed with bf16 decodes on
+    a jax handle with default settings (codec spec rides in the frame)."""
+    import numpy as np
+
+    from p2pfl_tpu.config import Settings
+    from p2pfl_tpu.models import mlp_model
+
+    tm = torch_mlp_model(seed=3, canonical=True)
+    tm.set_contribution(["t-addr"], 77)
+    assert len(tm.encode_parameters(compression="int8")) < len(tm.encode_parameters())
+    with Settings.overridden(WIRE_COMPRESSION="bf16"):
+        blob = tm.encode_parameters()
+    jm = mlp_model(seed=0)
+    jm.set_parameters(bytes(blob))
+    assert jm.contributors == ["t-addr"] and jm.num_samples == 77
+    want = torch_state_dict_to_jax_mlp(tm.params)
+    import jax
+
+    for got, ref in zip(jax.tree.leaves(jm.params), jax.tree.leaves(want)):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2**-7, atol=1e-6
+        )

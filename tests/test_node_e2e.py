@@ -158,3 +158,24 @@ def test_e2e_over_grpc_transport():
     finally:
         for node in nodes:
             node.stop()
+
+
+def test_e2e_with_int8_wire_compression():
+    """Federation converges with int8-quantized gossip (4x smaller weight
+    frames; no reference analogue — it always gossips full-precision
+    pickle, p2pfl_model.py:71-86)."""
+    Settings.RESOURCE_MONITOR_PERIOD = 0
+    with Settings.overridden(WIRE_COMPRESSION="int8"):
+        nodes = _spawn(2)
+        try:
+            nodes[1].connect(nodes[0].addr)
+            wait_convergence(nodes, 1, wait=5)
+            nodes[0].set_start_learning(rounds=2, epochs=1)
+            _wait_finished(nodes)
+            check_equal_models(nodes)
+            for node in nodes:
+                acc = node.learner.evaluate().get("test_acc")
+                assert acc is not None and acc > 0.5, acc
+        finally:
+            for node in nodes:
+                node.stop()

@@ -55,3 +55,101 @@ def test_truncated_raises():
 def test_rejects_unserializable_metadata():
     with pytest.raises(TypeError):
         serialize_arrays([], {"fn": lambda: None})
+
+
+# --- wire compression (ops/compression.py) -----------------------------------
+
+
+def test_compress_bf16_roundtrip_bound():
+    from p2pfl_tpu.ops.compression import compress_arrays, decompress_arrays
+
+    rng = np.random.default_rng(0)
+    arrays = [rng.normal(size=(64, 32)).astype(np.float32), np.arange(5, dtype=np.int32)]
+    enc, spec = compress_arrays(arrays, "bf16")
+    assert enc[0].dtype.name == "bfloat16"
+    assert enc[1].dtype == np.int32 and spec[1]["codec"] == "raw"  # ints pass through
+    dec = decompress_arrays(enc, spec)
+    assert dec[0].dtype == np.float32
+    # bf16 keeps ~8 mantissa bits: relative error < 2^-8
+    np.testing.assert_allclose(dec[0], arrays[0], rtol=2**-8)
+    np.testing.assert_array_equal(dec[1], arrays[1])
+
+
+def test_compress_int8_error_bound_and_size():
+    from p2pfl_tpu.ops.compression import compress_arrays, decompress_arrays
+
+    rng = np.random.default_rng(1)
+    a = rng.normal(scale=0.1, size=(128, 128)).astype(np.float32)
+    enc, spec = compress_arrays([a], "int8")
+    assert enc[0].dtype == np.int8 and enc[0].nbytes == a.nbytes // 4
+    dec = decompress_arrays(enc, spec)[0]
+    scale = spec[0]["scale"]
+    assert np.max(np.abs(dec - a)) <= scale / 2 + 1e-7
+    # zero tensors and 0-d arrays survive
+    enc, spec = compress_arrays([np.zeros((3,), np.float32), np.float32(2.5)], "int8")
+    dec = decompress_arrays(enc, spec)
+    np.testing.assert_array_equal(dec[0], np.zeros((3,)))
+    np.testing.assert_allclose(dec[1], 2.5, atol=2.5 / 127)
+
+
+def test_compress_unknown_scheme_and_spec_mismatch():
+    from p2pfl_tpu.ops.compression import compress_arrays, decompress_arrays
+
+    with pytest.raises(ValueError, match="unknown compression scheme"):
+        compress_arrays([np.zeros(2, np.float32)], "zstd")
+    with pytest.raises(ValueError, match="does not match"):
+        decompress_arrays([np.zeros(2, np.int8)], [])
+
+
+def test_model_handle_wire_compression_transparent():
+    """A compressed frame decodes on a receiver with default settings: the
+    codec spec rides in the frame (sender-local setting)."""
+    from p2pfl_tpu.config import Settings
+    from p2pfl_tpu.models import mlp_model
+
+    sender = mlp_model(seed=0)
+    sender.set_contribution(["addr-a"], 321)
+    raw = len(sender.encode_parameters())
+    blob = sender.encode_parameters(compression="int8")
+    assert len(blob) < raw / 3  # ~4x smaller minus header
+    receiver = mlp_model(seed=1)
+    receiver.set_parameters(bytes(blob))
+    assert receiver.contributors == ["addr-a"] and receiver.num_samples == 321
+    for got, want in zip(receiver.get_parameters(), sender.get_parameters()):
+        assert got.dtype == want.dtype
+        absmax = np.max(np.abs(want)) if want.size else 0.0
+        np.testing.assert_allclose(got, want, atol=absmax / 127 + 1e-7)
+
+    # Settings-driven default path
+    with Settings.overridden(WIRE_COMPRESSION="bf16"):
+        blob = sender.encode_parameters()
+    assert len(blob) < raw * 0.6
+    receiver.set_parameters(bytes(blob))
+
+
+def test_int8_nonfinite_tensors_ship_raw():
+    """A diverged (NaN/inf) tensor must not be laundered into finite int8
+    weights — it passes through raw so receivers still see the divergence."""
+    from p2pfl_tpu.ops.compression import compress_arrays, decompress_arrays
+
+    bad = np.array([np.nan, 1.0, np.inf], np.float32)
+    good = np.ones((4,), np.float32)
+    enc, spec = compress_arrays([bad, good], "int8")
+    assert spec[0]["codec"] == "raw" and spec[1]["codec"] == "int8"
+    dec = decompress_arrays(enc, spec)
+    assert np.isnan(dec[0][0]) and np.isinf(dec[0][2])
+
+
+def test_malformed_codec_spec_raises_decoding_error():
+    from p2pfl_tpu.exceptions import DecodingParamsError
+    from p2pfl_tpu.models.model_handle import decode_wire_frame
+    from p2pfl_tpu.ops.compression import CODEC_META_KEY
+
+    blob = serialize_arrays(
+        [np.zeros((2,), np.int8)], {CODEC_META_KEY: [{"codec": "int8"}]}  # no scale
+    )
+    with pytest.raises(DecodingParamsError, match="codec spec"):
+        decode_wire_frame(bytes(blob))
+    blob = serialize_arrays([np.zeros((2,), np.int8)], {CODEC_META_KEY: "bf16"})
+    with pytest.raises(DecodingParamsError):
+        decode_wire_frame(bytes(blob))

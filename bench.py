@@ -62,10 +62,14 @@ NOISE = 0.35
 LABEL_FLIP = 0.10  # caps achievable acc at ~0.9 -> accuracy is informative
 
 # --- utilization (MFU) config ------------------------------------------------
+# batch 2048: at batch 512 the round is dominated by optimizer/HBM traffic
+# (adam on 20M params x 4 members per step); 4x the batch quadruples the
+# matmul work per step at constant optimizer traffic, so measured MFU
+# reflects MXU utilization rather than update-path bandwidth.
 MFU_NODES = 8
 MFU_HIDDEN = (4096, 4096)
-MFU_BATCH = 512
-MFU_SAMPLES_PER_NODE = 2048
+MFU_BATCH = 2048
+MFU_SAMPLES_PER_NODE = 8192
 MFU_ROUNDS = 5
 MFU_TEST_SAMPLES = 256
 

@@ -62,8 +62,14 @@ class Settings:
     # --- wire compression ---------------------------------------------------
     # Lossy-but-bounded codec for gossiped weights ("none" | "bf16" | "int8",
     # ops/compression.py). Sender-local: the codec spec rides in the frame,
-    # so mixed settings across a federation interoperate.
+    # so mixed settings across a federation interoperate. Validated at load
+    # so a typo'd env value fails here, not mid-round in a gossip thread.
     WIRE_COMPRESSION: str = _env_override("WIRE_COMPRESSION", "none")
+    if WIRE_COMPRESSION not in ("none", "bf16", "int8"):
+        raise ValueError(
+            f"P2PFL_TPU_WIRE_COMPRESSION={WIRE_COMPRESSION!r} is not one of "
+            "('none', 'bf16', 'int8')"
+        )
 
     # --- learning round -----------------------------------------------------
     TRAIN_SET_SIZE: int = _env_override("TRAIN_SET_SIZE", 4)

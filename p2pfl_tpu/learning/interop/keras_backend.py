@@ -24,6 +24,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from p2pfl_tpu.learning.dataset.dataset import FederatedDataset
+from p2pfl_tpu.learning.interop.wire import CanonicalWireMixin
 from p2pfl_tpu.learning.learner import Learner, LearnerFactory
 from p2pfl_tpu.models.model_handle import ModelHandle
 
@@ -46,7 +47,7 @@ def _require_keras() -> None:
         )
 
 
-class KerasModelHandle(ModelHandle):
+class KerasModelHandle(CanonicalWireMixin, ModelHandle):
     """ModelHandle whose parameters are a keras model's weight list.
 
     The pytree is the flat ``get_weights()`` list (stable variable order —
@@ -86,39 +87,7 @@ class KerasModelHandle(ModelHandle):
         """Refresh the handle's numpy params from the live keras model."""
         self.params = [np.asarray(w).copy() for w in self.keras_model.get_weights()]
 
-    # --- canonical wire layout (heterogeneous federations) -------------------
-
-    def encode_parameters(self, compression: Optional[str] = None) -> bytes:
-        if self._to_wire is None:
-            return super().encode_parameters(compression)
-        if "scaffold" in self.additional_info or "scaffold_server" in self.additional_info:
-            raise ValueError(
-                "SCAFFOLD payloads cannot cross the canonical wire: their "
-                "leaves are framework-layout specific (use a homogeneous "
-                "federation for the Scaffold aggregator)"
-            )
-        from p2pfl_tpu.models.model_handle import encode_wire_frame
-
-        return encode_wire_frame(
-            [np.asarray(a) for a in self._to_wire(self.params)],
-            self.contributors,
-            self.num_samples,
-            self.additional_info,
-            compression,
-        )
-
-    def set_parameters(self, params) -> None:
-        if self._from_wire is not None and isinstance(
-            params, (bytes, bytearray, memoryview)
-        ):
-            from p2pfl_tpu.models.model_handle import decode_wire_frame
-
-            arrays, meta = decode_wire_frame(params)
-            self.contributors = list(meta.get("contributors", self.contributors))
-            self.num_samples = int(meta.get("num_samples", self.num_samples))
-            self.additional_info.update(meta.get("additional_info", {}))
-            return super().set_parameters(self._from_wire(list(arrays)))
-        return super().set_parameters(params)
+    # canonical wire layout (heterogeneous federations): CanonicalWireMixin
 
     def build_copy(self, params=None, contributors=None, num_samples=None):
         # Each copy gets its own keras model: apply_fn pushes the handle's

@@ -1,0 +1,54 @@
+"""Canonical-wire mixin shared by the interop model handles.
+
+Torch and Keras handles speak the flax-layout wire format through
+``_to_wire`` / ``_from_wire`` translators so heterogeneous federations can
+mix frameworks; the encode/decode choreography around those translators is
+identical for every backend and lives here once.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class CanonicalWireMixin:
+    """Wire frame encode/decode over ``self._to_wire`` / ``self._from_wire``.
+
+    Expects the host class to be a :class:`~p2pfl_tpu.models.model_handle.
+    ModelHandle` subclass with ``_to_wire``/``_from_wire`` attributes
+    (``None`` disables translation and falls back to the native layout).
+    """
+
+    def encode_parameters(self, compression: Optional[str] = None) -> bytes:
+        if self._to_wire is None:
+            return super().encode_parameters(compression)
+        if "scaffold" in self.additional_info or "scaffold_server" in self.additional_info:
+            raise ValueError(
+                "SCAFFOLD payloads cannot cross the canonical wire: their "
+                "leaves are framework-layout specific (use a homogeneous "
+                "federation for the Scaffold aggregator)"
+            )
+        from p2pfl_tpu.models.model_handle import encode_wire_frame
+
+        return encode_wire_frame(
+            [np.asarray(a) for a in self._to_wire(self.params)],
+            self.contributors,
+            self.num_samples,
+            self.additional_info,
+            compression,
+        )
+
+    def set_parameters(self, params) -> None:
+        if self._from_wire is not None and isinstance(
+            params, (bytes, bytearray, memoryview)
+        ):
+            from p2pfl_tpu.models.model_handle import decode_wire_frame
+
+            arrays, meta = decode_wire_frame(params)
+            self.contributors = list(meta.get("contributors", self.contributors))
+            self.num_samples = int(meta.get("num_samples", self.num_samples))
+            self.additional_info.update(meta.get("additional_info", {}))
+            return super().set_parameters(self._from_wire(list(arrays)))
+        return super().set_parameters(params)

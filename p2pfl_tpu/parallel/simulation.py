@@ -505,16 +505,23 @@ class MeshSimulation:
             # Population/opt buffers are donated to the round program (the
             # state is updated in place — half the HBM high-water of a
             # copy-in/copy-out loop), so warm up on throwaway copies to keep
-            # the real state alive for the timed run.
+            # the real state alive for the timed run. The warmup uses a
+            # start_round the real run never sees: a remote/tunneled backend
+            # may recognize a repeated (program, inputs) execution and replay
+            # its cached result, which would make the first timed chunk—
+            # value-identical to the warmup otherwise—report fantasy timings.
             wp, wo, wc, wcg = jax.tree.map(
                 jnp.copy,
                 (self.params_stack, self.opt_stack, self.c_stack, self.c_global),
             )
             out = self._run_jit(
-                wp, wo, wc, wcg, data, jnp.int32(start),
+                wp, wo, wc, wcg, data, jnp.int32(start + rounds + 1),
                 rounds=chunks[0], epochs=epochs,
             )
             jax.block_until_ready(out[0])
+            # Force true retirement (see the matching fetch after the timed
+            # loop): otherwise the in-flight warmup bleeds into the timing.
+            np.asarray(out[6])
             del out
 
         params_stack, opt_stack = self.params_stack, self.opt_stack
@@ -558,6 +565,12 @@ class MeshSimulation:
                 "running again"
             ) from e
         jax.block_until_ready(params_stack)
+        # On a tunneled/remote backend block_until_ready can return before
+        # the execution actually retires (observed on the relay right after
+        # compilation: block returns in ~0.1ms while the first fetch then
+        # takes seconds). Fetching a tiny output that data-depends on the
+        # final chunk forces true completion, so dt is honest.
+        np.asarray(test_loss[-1])
         dt = time.monotonic() - t0
         total_rounds = sum(chunks)
 

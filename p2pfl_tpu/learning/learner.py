@@ -25,6 +25,7 @@ from __future__ import annotations
 import abc
 import threading
 import time
+import zlib
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -117,6 +118,80 @@ def fedprox_penalty(params: Pytree, anchor: Pytree, mu: float) -> jax.Array:
     return 0.5 * mu * sum(jax.tree.leaves(sq))
 
 
+def dp_grads(
+    batch_loss_fn: Callable[[Pytree, jax.Array, jax.Array, jax.Array], jax.Array],
+    params: Pytree,
+    x: jax.Array,
+    y: jax.Array,
+    w: jax.Array,
+    key: jax.Array,
+    clip_norm: float,
+    noise_multiplier: float,
+) -> Tuple[jax.Array, Pytree]:
+    """DP-SGD (loss, gradient): per-example clip to L2 ``clip_norm``, mean,
+    Gaussian noise with std ``clip_norm * noise_multiplier / batch`` (Abadi
+    et al. 2016, the standard sum-then-noise-then-average formulation).
+
+    TPU-native: per-example losses and gradients come from one ``vmap``
+    (a batched backward pass on the MXU — no extra forward, no per-sample
+    Python loop). Shared by the nodes-mode learner and the mesh simulation
+    so both execution modes stay provably identical. No reference analogue
+    — p2pfl has no privacy machinery at all.
+
+    Args:
+        batch_loss_fn: the caller's masked batch loss
+            ``(params, x, y, w) -> scalar`` (the pure data loss —
+            regularizers that should not be clipped per example, like
+            FedProx's proximal term, are added by the caller afterwards;
+            see :func:`fedprox_grad`). Applied here to single-example
+            batches.
+        w: ``[B]`` 0/1 validity mask (padded rows contribute nothing).
+
+    Returns:
+        ``(loss, grads)``: the masked mean per-example loss and the private
+        gradient estimate.
+    """
+
+    def example_loss(p: Pytree, xi: jax.Array, yi: jax.Array) -> jax.Array:
+        return batch_loss_fn(p, xi[None], yi[None], jnp.ones((1,), jnp.float32))
+
+    losses, grads = jax.vmap(
+        jax.value_and_grad(example_loss), in_axes=(None, 0, 0)
+    )(params, x, y)
+    denom = jnp.maximum(w.sum(), 1.0)
+    loss = jnp.sum(losses.astype(jnp.float32) * w) / denom
+    sq = jax.tree.map(
+        lambda g: jnp.sum(
+            g.reshape(g.shape[0], -1).astype(jnp.float32) ** 2, axis=1
+        ),
+        grads,
+    )
+    norms = jnp.sqrt(sum(jax.tree.leaves(sq)))  # [B] per-example global norm
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(norms, 1e-12)) * w
+    noise_std = clip_norm * noise_multiplier / denom
+    leaves, treedef = jax.tree.flatten(grads)
+    keys = list(jax.random.split(key, len(leaves)))
+    out = []
+    for g, k in zip(leaves, keys):
+        mean = jnp.tensordot(scale, g.astype(jnp.float32), axes=1) / denom
+        if noise_multiplier > 0.0:
+            mean = mean + noise_std * jax.random.normal(k, mean.shape, jnp.float32)
+        out.append(mean)
+    return loss, jax.tree.unflatten(treedef, out)
+
+
+def fedprox_grad(grads: Pytree, params: Pytree, anchor: Pytree, mu: float) -> Pytree:
+    """Add FedProx's proximal-term gradient ``mu * (w - w_anchor)`` —
+    applied *after* the DP mean so the regularizer is never clipped per
+    example. Shared by both execution modes (like :func:`fedprox_penalty`)."""
+    return jax.tree.map(
+        lambda g, p, a: g + mu * (p.astype(g.dtype) - a.astype(g.dtype)),
+        grads,
+        params,
+        anchor,
+    )
+
+
 def masked_lm_loss(logits: jax.Array, tokens: jax.Array, seq_mask: jax.Array) -> jax.Array:
     """Next-token CE over ``logits [B, L, V]`` / ``tokens [B, L]`` with a
     per-sequence validity mask ``[B]`` (padded rows of a stacked federated
@@ -138,6 +213,10 @@ class JaxLearner(Learner):
         batch_size: local batch size (reference flax path hardcoded 1).
         fedprox_mu: if > 0, add the FedProx proximal term
             ``mu/2 * ||w - w_round_start||^2`` to the loss.
+        dp_clip_norm: if > 0, train with DP-SGD: per-example gradients
+            clipped to this L2 norm (see :func:`dp_grads`).
+        dp_noise_multiplier: Gaussian noise scale sigma for DP-SGD (noise
+            std = clip * sigma / batch on the mean gradient).
         seed: base RNG seed; batch order varies per fit() call.
     """
 
@@ -152,6 +231,8 @@ class JaxLearner(Learner):
         lr: float = 1e-3,
         batch_size: int = 64,
         fedprox_mu: float = 0.0,
+        dp_clip_norm: float = 0.0,
+        dp_noise_multiplier: float = 0.0,
         seed: int = 0,
         callbacks: Optional[List[str]] = None,
     ) -> None:
@@ -160,6 +241,14 @@ class JaxLearner(Learner):
         self.optimizer = optimizer if optimizer is not None else optax.adam(self.lr)
         self.batch_size = int(batch_size)
         self.fedprox_mu = float(fedprox_mu)
+        self.dp_clip_norm = float(dp_clip_norm)
+        self.dp_noise_multiplier = float(dp_noise_multiplier)
+        if self.dp_noise_multiplier > 0.0 and self.dp_clip_norm <= 0.0:
+            raise ValueError(
+                "dp_noise_multiplier > 0 requires dp_clip_norm > 0 — without "
+                "a clip bound the DP branch never runs and training would be "
+                "silently non-private"
+            )
         self.seed = int(seed)
         self.callbacks = list(callbacks or [])
         # Reserved names run inside the jitted step; everything else is a
@@ -186,7 +275,13 @@ class JaxLearner(Learner):
     # --- jitted kernels -----------------------------------------------------
 
     @staticmethod
-    @partial(jax.jit, static_argnames=("apply_fn", "optimizer", "fedprox_mu", "use_scaffold"))
+    @partial(
+        jax.jit,
+        static_argnames=(
+            "apply_fn", "optimizer", "fedprox_mu", "use_scaffold",
+            "dp_clip_norm", "dp_noise_multiplier",
+        ),
+    )
     def _train_epoch(
         params: Pytree,
         opt_state: Pytree,
@@ -196,14 +291,20 @@ class JaxLearner(Learner):
         anchor: Pytree,
         c_global: Pytree,
         c_local: Pytree,
+        key: jax.Array,
         *,
         apply_fn: Callable,
         optimizer: optax.GradientTransformation,
         fedprox_mu: float,
         use_scaffold: bool,
+        dp_clip_norm: float = 0.0,
+        dp_noise_multiplier: float = 0.0,
     ) -> Tuple[Pytree, Pytree, jax.Array]:
         """One epoch = lax.scan over fixed-shape batches. Returns
-        (params, opt_state, mean_loss)."""
+        (params, opt_state, mean_loss). With ``dp_clip_norm > 0`` the
+        gradient is the DP-SGD estimate (:func:`dp_grads`); FedProx's
+        proximal pull and SCAFFOLD's correction apply after the private
+        mean (they depend only on params/control state, not on data)."""
 
         def loss_fn(p: Pytree, x: jax.Array, y: jax.Array, w: jax.Array) -> jax.Array:
             loss = softmax_cross_entropy(apply_fn(p, x), y, w)
@@ -213,8 +314,19 @@ class JaxLearner(Learner):
 
         def step(carry, batch):
             p, s = carry
-            x, y, w = batch
-            loss, grads = jax.value_and_grad(loss_fn)(p, x, y, w)
+            x, y, w, k = batch
+            if dp_clip_norm > 0.0:
+                loss, grads = dp_grads(
+                    lambda pp, bx, by, bw: softmax_cross_entropy(
+                        apply_fn(pp, bx), by, bw
+                    ),
+                    p, x, y, w, k, dp_clip_norm, dp_noise_multiplier,
+                )
+                if fedprox_mu > 0.0:
+                    loss = loss + fedprox_penalty(p, anchor, fedprox_mu)
+                    grads = fedprox_grad(grads, p, anchor, fedprox_mu)
+            else:
+                loss, grads = jax.value_and_grad(loss_fn)(p, x, y, w)
             if use_scaffold:  # SCAFFOLD drift correction: g + c - c_i
                 grads = jax.tree.map(
                     lambda g, c, ci: g + c.astype(g.dtype) - ci.astype(g.dtype),
@@ -226,7 +338,10 @@ class JaxLearner(Learner):
             p = optax.apply_updates(p, updates)
             return (p, s), loss
 
-        (params, opt_state), losses = jax.lax.scan(step, (params, opt_state), (xb, yb, wb))
+        skeys = jax.random.split(key, xb.shape[0])
+        (params, opt_state), losses = jax.lax.scan(
+            step, (params, opt_state), (xb, yb, wb, skeys)
+        )
         return params, opt_state, jnp.mean(losses)
 
     @staticmethod
@@ -299,10 +414,18 @@ class JaxLearner(Learner):
                 anchor,
                 c_global,
                 c_local,
+                # Fold the node identity in: nodes sharing the default seed
+                # must not inject identical (coherent, recomputable) DP noise.
+                jax.random.fold_in(
+                    jax.random.key(epoch_seed + epoch),
+                    zlib.crc32(self._self_addr.encode()),
+                ),
                 apply_fn=model.apply_fn,
                 optimizer=self.optimizer,
                 fedprox_mu=self.fedprox_mu,
                 use_scaffold=self._scaffold,
+                dp_clip_norm=self.dp_clip_norm,
+                dp_noise_multiplier=self.dp_noise_multiplier,
             )
             total_steps += xb.shape[0]
             last_loss = float(loss)

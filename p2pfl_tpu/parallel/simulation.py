@@ -42,6 +42,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from p2pfl_tpu.config import Settings
 from p2pfl_tpu.learning.dataset.dataset import FederatedDataset
 from p2pfl_tpu.learning.learner import (
+    dp_grads,
+    fedprox_grad,
     fedprox_penalty,
     masked_lm_loss,
     softmax_cross_entropy,
@@ -140,6 +142,8 @@ class MeshSimulation:
         per_node_init: bool = False,
         task: str = "classification",
         fedprox_mu: float = 0.0,
+        dp_clip_norm: float = 0.0,
+        dp_noise_multiplier: float = 0.0,
         algorithm: str = "fedavg",
         scaffold_global_lr: float = 1.0,
     ) -> None:
@@ -166,6 +170,18 @@ class MeshSimulation:
         # FedProx (BASELINE.json config #5): proximal pull toward the
         # round-start (diffused) model inside the jitted local step.
         self.fedprox_mu = float(fedprox_mu)
+        # DP-SGD (no reference analogue): per-example clip + Gaussian noise
+        # inside the jitted local step (learner.dp_grads).
+        if dp_clip_norm > 0.0 and task == "lm":
+            raise ValueError("dp_clip_norm is only supported for task='classification'")
+        if dp_noise_multiplier > 0.0 and dp_clip_norm <= 0.0:
+            raise ValueError(
+                "dp_noise_multiplier > 0 requires dp_clip_norm > 0 — without "
+                "a clip bound the DP branch never runs and training would be "
+                "silently non-private"
+            )
+        self.dp_clip_norm = float(dp_clip_norm)
+        self.dp_noise_multiplier = float(dp_noise_multiplier)
         self.model = model
         self.apply_fn = model.apply_fn
         self.batch_size = int(batch_size)
@@ -331,14 +347,16 @@ class MeshSimulation:
 
         def epoch(carry, ekey):
             p, s = carry
-            perm = jax.random.permutation(ekey, x.shape[0])
+            kperm, kdp = jax.random.split(ekey)
+            perm = jax.random.permutation(kperm, x.shape[0])
             xb = x[perm][: steps * self.batch_size].reshape(steps, self.batch_size, *x.shape[1:])
             yb = y[perm][: steps * self.batch_size].reshape(steps, self.batch_size)
             wb = w[perm][: steps * self.batch_size].reshape(steps, self.batch_size)
+            skeys = jax.random.split(kdp, steps)
 
             def step(carry, batch):
                 p, s = carry
-                bx, by, bw = batch
+                bx, by, bw, bk = batch
 
                 def loss_fn(pp):
                     loss = self._batch_loss(pp, bx, by, bw)
@@ -346,7 +364,16 @@ class MeshSimulation:
                         loss = loss + fedprox_penalty(pp, anchor, self.fedprox_mu)
                     return loss
 
-                loss, grads = jax.value_and_grad(loss_fn)(p)
+                if self.dp_clip_norm > 0.0:
+                    loss, grads = dp_grads(
+                        self._batch_loss, p, bx, by, bw, bk,
+                        self.dp_clip_norm, self.dp_noise_multiplier,
+                    )
+                    if self.fedprox_mu > 0.0:  # proximal pull after the DP mean
+                        loss = loss + fedprox_penalty(p, anchor, self.fedprox_mu)
+                        grads = fedprox_grad(grads, p, anchor, self.fedprox_mu)
+                else:
+                    loss, grads = jax.value_and_grad(loss_fn)(p)
                 if self.algorithm == "scaffold":  # drift correction: g + c - c_i
                     grads = jax.tree.map(
                         lambda g, c, ci: g + c.astype(g.dtype) - ci.astype(g.dtype),
@@ -357,7 +384,7 @@ class MeshSimulation:
                 updates, s2 = self.optimizer.update(grads, s, p)
                 return (optax.apply_updates(p, updates), s2), loss
 
-            (p, s), losses = jax.lax.scan(step, (p, s), (xb, yb, wb))
+            (p, s), losses = jax.lax.scan(step, (p, s), (xb, yb, wb, skeys))
             return (p, s), jnp.mean(losses)
 
         ekeys = jax.random.split(key, epochs)

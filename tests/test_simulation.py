@@ -234,3 +234,20 @@ def test_simulation_scaffold_rejects_bad_combos(parts16):
             mlp_model(seed=0), parts16, algorithm="scaffold",
             optimizer=optax.sgd(0.1),
         )
+
+
+def test_simulation_with_dp_sgd():
+    """Mesh simulation with DP-SGD local training (per-example clip +
+    Gaussian noise inside the jitted round) still learns; no reference
+    analogue — p2pfl has no privacy machinery."""
+    from p2pfl_tpu.models import mlp_model
+
+    data = synthetic_mnist(n_train=512, n_test=128)
+    parts = data.generate_partitions(4, RandomIIDPartitionStrategy)
+    sim = MeshSimulation(
+        mlp_model(seed=0), parts, train_set_size=4, batch_size=32, seed=0,
+        lr=3e-3, dp_clip_norm=1.0, dp_noise_multiplier=0.2,
+    )
+    res = sim.run(rounds=5, epochs=2, warmup=False)
+    assert np.isfinite(res.test_loss[-1])
+    assert res.test_acc[-1] > 0.5, res.test_acc

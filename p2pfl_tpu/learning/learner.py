@@ -262,6 +262,7 @@ class JaxLearner(Learner):
         )
         self._interrupt = threading.Event()
         self._fit_count = 0
+        self._dp_total_steps = 0  # cumulative DP-SGD steps across fit() calls
         self._opt_state: Optional[Pytree] = None
         self._scaffold_c_i: Optional[Pytree] = None
         self._scaffold = "scaffold" in self.callbacks
@@ -435,6 +436,14 @@ class JaxLearner(Learner):
         model.params = params
         model.set_contribution([self._self_addr], self.get_data().get_num_samples(True))
 
+        if self.dp_clip_norm > 0.0:
+            self._dp_total_steps += total_steps
+            # Reported as a metric, NOT stamped into model.additional_info:
+            # aggregation merges peers' additional_info into the local model,
+            # so a stamped entry could be overwritten by another node's
+            # (smaller) epsilon — a privacy claim must never travel that way.
+            self.report("dp_epsilon", self.privacy_spent()["epsilon"])
+
         if self._scaffold and total_steps > 0:
             # c_i' = c_i - c + (x - y)/(K*lr); deltas ride in additional_info
             # (contract of reference scaffold callbacks + aggregator,
@@ -460,6 +469,16 @@ class JaxLearner(Learner):
             cb.on_fit_end(self)
         self.report("fit_time_s", time.monotonic() - t0)
         return model
+
+    def privacy_spent(self, delta: float = 1e-5) -> Dict[str, Any]:
+        """Conservative (epsilon, delta) spent by all DP-SGD steps so far
+        (:mod:`p2pfl_tpu.learning.privacy`); epsilon is ``inf`` when
+        training ran without noise."""
+        from p2pfl_tpu.learning.privacy import dp_sgd_privacy_spent
+
+        return dp_sgd_privacy_spent(
+            self.dp_noise_multiplier, self.dp_clip_norm, self._dp_total_steps, delta
+        )
 
     def evaluate(self) -> Dict[str, float]:
         model = self.get_model()

@@ -1,0 +1,73 @@
+"""Privacy accounting for DP-SGD training.
+
+Conservative Renyi-DP composition for the Gaussian mechanism (Mironov
+2017): each DP-SGD step with noise multiplier sigma is a Gaussian
+mechanism with sensitivity equal to the clip norm, whose RDP at order
+``alpha`` is ``alpha / (2 sigma^2)``; T steps compose additively and the
+RDP bound converts to (epsilon, delta)-DP via
+``epsilon = T alpha / (2 sigma^2) + log(1/delta) / (alpha - 1)``.
+
+This bound deliberately does NOT claim privacy amplification by
+subsampling (which needs assumptions about how batches are formed —
+Poisson vs shuffling — that a federation cannot verify for its peers), so
+the reported epsilon is a valid upper bound on the true privacy loss for
+any batching scheme. No reference analogue — p2pfl has no privacy
+machinery.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+
+def gaussian_rdp_epsilon(
+    noise_multiplier: float,
+    steps: int,
+    delta: float,
+    orders: Optional[Sequence[float]] = None,
+) -> float:
+    """(epsilon, delta)-DP bound for ``steps`` composed Gaussian mechanisms.
+
+    Minimizes the RDP-to-DP conversion over ``orders``; the analytic
+    minimizer ``alpha* = 1 + sqrt(2 sigma^2 log(1/delta) / T)`` is always
+    included, so the default grid is only a refinement.
+
+    Returns ``inf`` when ``noise_multiplier <= 0`` (no noise, no guarantee).
+    """
+    if steps <= 0:
+        return 0.0
+    if noise_multiplier <= 0.0:
+        return math.inf
+    if not (0.0 < delta < 1.0):
+        raise ValueError(f"delta must be in (0, 1), got {delta}")
+    sigma2 = noise_multiplier**2
+    log1d = math.log(1.0 / delta)
+    alpha_star = 1.0 + math.sqrt(2.0 * sigma2 * log1d / steps)
+    candidates = [alpha_star]
+    if orders is not None:
+        candidates += list(orders)
+
+    def eps(alpha: float) -> float:
+        if alpha <= 1.0:
+            return math.inf
+        return steps * alpha / (2.0 * sigma2) + log1d / (alpha - 1.0)
+
+    return min(eps(a) for a in candidates)
+
+
+def dp_sgd_privacy_spent(
+    noise_multiplier: float,
+    clip_norm: float,
+    steps: int,
+    delta: float = 1e-5,
+) -> dict:
+    """Summary dict for a completed DP-SGD run (ready for metadata/info)."""
+    return {
+        "mechanism": "gaussian-rdp-conservative",
+        "noise_multiplier": float(noise_multiplier),
+        "clip_norm": float(clip_norm),
+        "steps": int(steps),
+        "delta": float(delta),
+        "epsilon": gaussian_rdp_epsilon(noise_multiplier, steps, delta),
+    }

@@ -312,6 +312,11 @@ class MeshSimulation:
             self.c_stack = {}
             self.c_global = {}
 
+        # Cumulative per-node DP-SGD steps, counted as if every node trained
+        # in every round (conservative: a node not on the committee spends
+        # nothing, so the true loss is never above this bound).
+        self._dp_steps_per_node = 0
+
         self._round_history: List[Dict[str, float]] = []
         # Rounds already executed (advanced by run(); restored by
         # load_from()). Round r's RNG key is fold_in(base, r), so resuming
@@ -347,7 +352,13 @@ class MeshSimulation:
 
         def epoch(carry, ekey):
             p, s = carry
-            kperm, kdp = jax.random.split(ekey)
+            if self.dp_clip_norm > 0.0:
+                kperm, kdp = jax.random.split(ekey)
+            else:
+                # Non-DP runs keep the historical permutation stream: ekey
+                # feeds the shuffle directly, so checkpoints written before
+                # DP existed still resume bit-identically.
+                kperm = kdp = ekey
             perm = jax.random.permutation(kperm, x.shape[0])
             xb = x[perm][: steps * self.batch_size].reshape(steps, self.batch_size, *x.shape[1:])
             yb = y[perm][: steps * self.batch_size].reshape(steps, self.batch_size)
@@ -566,6 +577,13 @@ class MeshSimulation:
                 test_loss.append(tl)
                 test_acc.append(ta)
                 done += chunk
+                if self.dp_clip_norm > 0.0:
+                    # Per chunk, not per run: a later chunk failing must not
+                    # erase the noise already injected by completed chunks.
+                    # (Replayed rounds after a checkpoint resume re-count,
+                    # which over-states epsilon — the safe direction.)
+                    steps_per_epoch = self.x.shape[1] // self.batch_size
+                    self._dp_steps_per_node += chunk * epochs * steps_per_epoch
                 # Save on the cadence, and always after the final chunk so the
                 # end-of-run state is never memory-only.
                 if checkpointer is not None and (
@@ -613,6 +631,20 @@ class MeshSimulation:
             committees=np.concatenate([np.asarray(c) for c in committees]),
         )
 
+    def privacy_spent(self, delta: float = 1e-5) -> Dict[str, Any]:
+        """Conservative per-node (epsilon, delta) for the DP-SGD run so far
+        (:mod:`p2pfl_tpu.learning.privacy`) — counts every node as training
+        in every completed round, which upper-bounds the committee's actual
+        participation."""
+        from p2pfl_tpu.learning.privacy import dp_sgd_privacy_spent
+
+        return dp_sgd_privacy_spent(
+            self.dp_noise_multiplier,
+            self.dp_clip_norm,
+            self._dp_steps_per_node,
+            delta,
+        )
+
     def final_model(self, node: int = 0) -> ModelHandle:
         """Extract one node's model (they're all equal after diffusion)."""
         if self.params_stack is None:
@@ -638,7 +670,14 @@ class MeshSimulation:
         return checkpointer.save(
             self.completed_rounds,
             self.state_dict(),
-            {"completed_rounds": self.completed_rounds, "seed": self.seed},
+            {
+                "completed_rounds": self.completed_rounds,
+                "seed": self.seed,
+                # Privacy spend must survive resume: a fresh process that
+                # restored 50 DP rounds and runs 50 more must report 100
+                # rounds of noise, never 50.
+                "dp_steps_per_node": self._dp_steps_per_node,
+            },
         )
 
     def load_from(self, checkpointer, step: Optional[int] = None) -> int:
@@ -659,6 +698,9 @@ class MeshSimulation:
             self.c_stack = state["c_stack"]
             self.c_global = state["c_global"]
         self.completed_rounds = int(meta.get("completed_rounds", 0))
+        self._dp_steps_per_node = max(
+            self._dp_steps_per_node, int(meta.get("dp_steps_per_node", 0))
+        )
         if "seed" in meta and int(meta["seed"]) != self.seed:
             self.seed = int(meta["seed"])
         return self.completed_rounds

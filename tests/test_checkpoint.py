@@ -183,3 +183,35 @@ def test_node_round_end_checkpointing(tmp_path):
         assert len(steps) >= 2  # one snapshot per finished round
         restored = ck.restore_model(mlp_model(seed=0))
     _trees_equal(restored.params, nodes[0].learner.get_model().params)
+
+
+def test_dp_step_counter_survives_resume(tmp_path):
+    """Privacy spend must survive checkpoint resume: a fresh object that
+    restored N DP rounds and runs more must count ALL noise injected."""
+    from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from p2pfl_tpu.management.checkpoint import FLCheckpointer
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.parallel.simulation import MeshSimulation
+
+    data = synthetic_mnist(n_train=128, n_test=32)
+    parts = data.generate_partitions(2, RandomIIDPartitionStrategy)
+
+    def make():
+        return MeshSimulation(
+            mlp_model(seed=0), parts, train_set_size=2, batch_size=32, seed=0,
+            dp_clip_norm=1.0, dp_noise_multiplier=0.5,
+        )
+
+    ckpt = FLCheckpointer(str(tmp_path / "dp-ckpt"))
+    sim = make()
+    sim.run(rounds=2, epochs=1, warmup=False, checkpointer=ckpt)
+    spent_first = sim.privacy_spent()
+    assert spent_first["steps"] == 2 * (64 // 32)
+
+    resumed = make()
+    resumed.load_from(ckpt)
+    assert resumed.privacy_spent()["steps"] == spent_first["steps"]
+    resumed.run(rounds=2, epochs=1, warmup=False)
+    assert resumed.privacy_spent()["steps"] == 2 * spent_first["steps"]
+    assert resumed.privacy_spent()["epsilon"] > spent_first["epsilon"]
+    ckpt.close()

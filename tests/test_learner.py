@@ -248,3 +248,44 @@ def test_dp_noise_differs_across_nodes_with_same_seed():
         out.append(learner.fit().get_parameters())
     diffs = [float(np.max(np.abs(a - b))) for a, b in zip(out[0], out[1])]
     assert max(diffs) > 1e-6, diffs
+
+
+def test_privacy_accountant_closed_form_and_monotonicity():
+    """The conservative Gaussian-RDP bound has a closed-form optimum:
+    eps = T/(2 sigma^2) + sqrt(2 T log(1/delta)) / sigma."""
+    import math
+
+    from p2pfl_tpu.learning.privacy import gaussian_rdp_epsilon
+
+    for sigma, steps, delta in [(1.0, 100, 1e-5), (2.0, 1000, 1e-6), (0.5, 10, 1e-3)]:
+        want = steps / (2 * sigma**2) + math.sqrt(2 * steps * math.log(1 / delta)) / sigma
+        got = gaussian_rdp_epsilon(sigma, steps, delta)
+        assert abs(got - want) < 1e-9 * max(1.0, want), (got, want)
+    # properties: more noise -> less epsilon; more steps -> more epsilon
+    assert gaussian_rdp_epsilon(2.0, 100, 1e-5) < gaussian_rdp_epsilon(1.0, 100, 1e-5)
+    assert gaussian_rdp_epsilon(1.0, 200, 1e-5) > gaussian_rdp_epsilon(1.0, 100, 1e-5)
+    assert gaussian_rdp_epsilon(0.0, 100, 1e-5) == float("inf")
+    assert gaussian_rdp_epsilon(1.0, 0, 1e-5) == 0.0
+
+
+def test_dp_learner_reports_privacy_spent():
+    data = synthetic_mnist(n_train=128, n_test=32)
+    learner = JaxLearner(
+        mlp_model(seed=0), data, "dp-acct", batch_size=32,
+        dp_clip_norm=1.0, dp_noise_multiplier=1.0,
+    )
+    metrics = []
+    learner.metric_reporter = lambda name, value, step=None: metrics.append((name, value))
+    learner.set_epochs(2)
+    model = learner.fit()
+    info = learner.privacy_spent()
+    assert info["steps"] == 8  # 4 steps/epoch x 2
+    assert 0 < info["epsilon"] < float("inf")
+    assert ("dp_epsilon", info["epsilon"]) in metrics
+    # epsilon must be a LOCAL claim: never stamped into the gossiped model's
+    # additional_info (aggregation merges peers' info and could overwrite it)
+    assert model.get_info("dp") is None
+    # epsilon accumulates across fits
+    learner.fit()
+    assert learner.privacy_spent()["steps"] == 16
+    assert learner.privacy_spent()["epsilon"] > info["epsilon"]

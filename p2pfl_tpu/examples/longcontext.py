@@ -43,6 +43,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="attention kind inside the federated LM",
     )
     p.add_argument("--lr", type=float, default=5e-3)
+    p.add_argument(
+        "--dp-clip",
+        type=float,
+        default=0.0,
+        help="DP-SGD per-sequence clip norm (> 0 enables private training)",
+    )
+    p.add_argument(
+        "--dp-noise",
+        type=float,
+        default=0.0,
+        help="DP-SGD Gaussian noise multiplier sigma",
+    )
     p.add_argument("--measure-time", action="store_true")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument(
@@ -91,6 +103,8 @@ def main(argv=None) -> int:
         lr=args.lr,
         seed=args.seed,
         task="lm",
+        dp_clip_norm=args.dp_clip,
+        dp_noise_multiplier=args.dp_noise,
     )
     t0 = time.time()
     res = sim.run(rounds=args.rounds, epochs=args.epochs, warmup=True)
@@ -102,6 +116,8 @@ def main(argv=None) -> int:
         "final_token_loss": round(res.test_loss[-1], 4),
         "final_token_acc": round(res.test_acc[-1], 4),
     }
+    if args.dp_clip > 0.0:
+        result["dp_epsilon_at_1e-5"] = round(sim.privacy_spent()["epsilon"], 3)
     if args.measure_time:
         result["total_elapsed_s"] = round(time.time() - t0, 3)
     print(result)

@@ -204,10 +204,12 @@ def run_nodes(args: argparse.Namespace) -> dict:
             "final_test_acc": float(np.mean(accs)) if accs else None,
         }
         if args.dp_clip > 0.0:
-            # Unwrap the executor decorator; privacy spend is a local claim
-            # of the node's own learner, never read off the gossiped model.
-            inner = getattr(nodes[0].learner, "learner", nodes[0].learner)
-            out["dp_epsilon_at_1e-5"] = round(inner.privacy_spent()["epsilon"], 3)
+            # Privacy spend is a local claim of the node's own learner,
+            # never read off the gossiped model (the executor decorator
+            # delegates privacy_spent through its __getattr__).
+            out["dp_epsilon_at_1e-5"] = round(
+                nodes[0].learner.privacy_spent()["epsilon"], 3
+            )
         return out
     finally:
         for n in nodes:

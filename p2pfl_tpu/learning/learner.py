@@ -263,6 +263,7 @@ class JaxLearner(Learner):
         self._interrupt = threading.Event()
         self._fit_count = 0
         self._dp_total_steps = 0  # cumulative DP-SGD steps across fit() calls
+        self._nonprivate_steps = 0  # steps taken WITHOUT the DP mechanism
         self._opt_state: Optional[Pytree] = None
         self._scaffold_c_i: Optional[Pytree] = None
         self._scaffold = "scaffold" in self.callbacks
@@ -436,7 +437,9 @@ class JaxLearner(Learner):
         model.params = params
         model.set_contribution([self._self_addr], self.get_data().get_num_samples(True))
 
-        if self.dp_clip_norm > 0.0:
+        if self.dp_clip_norm <= 0.0:
+            self._nonprivate_steps += total_steps
+        else:
             self._dp_total_steps += total_steps
             # Reported as a metric, NOT stamped into model.additional_info:
             # aggregation merges peers' additional_info into the local model,
@@ -471,13 +474,17 @@ class JaxLearner(Learner):
         return model
 
     def privacy_spent(self, delta: float = 1e-5) -> Dict[str, Any]:
-        """Conservative (epsilon, delta) spent by all DP-SGD steps so far
-        (:mod:`p2pfl_tpu.learning.privacy`); epsilon is ``inf`` when
-        training ran without noise."""
+        """Conservative (epsilon, delta) spent by all training so far
+        (:mod:`p2pfl_tpu.learning.privacy`); epsilon is ``inf`` when any
+        step ran without the DP mechanism (noise off, or DP disabled)."""
         from p2pfl_tpu.learning.privacy import dp_sgd_privacy_spent
 
         return dp_sgd_privacy_spent(
-            self.dp_noise_multiplier, self.dp_clip_norm, self._dp_total_steps, delta
+            self.dp_noise_multiplier,
+            self.dp_clip_norm,
+            self._dp_total_steps,
+            delta,
+            nonprivate_steps=self._nonprivate_steps,
         )
 
     def evaluate(self) -> Dict[str, float]:

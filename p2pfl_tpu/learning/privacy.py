@@ -18,22 +18,18 @@ machinery.
 from __future__ import annotations
 
 import math
-from typing import Optional, Sequence
 
 
-def gaussian_rdp_epsilon(
-    noise_multiplier: float,
-    steps: int,
-    delta: float,
-    orders: Optional[Sequence[float]] = None,
-) -> float:
+def gaussian_rdp_epsilon(noise_multiplier: float, steps: int, delta: float) -> float:
     """(epsilon, delta)-DP bound for ``steps`` composed Gaussian mechanisms.
 
-    Minimizes the RDP-to-DP conversion over ``orders``; the analytic
-    minimizer ``alpha* = 1 + sqrt(2 sigma^2 log(1/delta) / T)`` is always
-    included, so the default grid is only a refinement.
+    The conversion ``eps(alpha) = T alpha / (2 sigma^2) + log(1/delta) /
+    (alpha - 1)`` is convex in ``alpha`` with the closed-form minimizer
+    ``alpha* = 1 + sqrt(2 sigma^2 log(1/delta) / T)``, which is evaluated
+    exactly — no order grid is needed for this bound.
 
-    Returns ``inf`` when ``noise_multiplier <= 0`` (no noise, no guarantee).
+    Returns ``inf`` when ``noise_multiplier <= 0`` (no noise, no guarantee)
+    and ``0`` when ``steps == 0`` (nothing was released).
     """
     if steps <= 0:
         return 0.0
@@ -43,17 +39,8 @@ def gaussian_rdp_epsilon(
         raise ValueError(f"delta must be in (0, 1), got {delta}")
     sigma2 = noise_multiplier**2
     log1d = math.log(1.0 / delta)
-    alpha_star = 1.0 + math.sqrt(2.0 * sigma2 * log1d / steps)
-    candidates = [alpha_star]
-    if orders is not None:
-        candidates += list(orders)
-
-    def eps(alpha: float) -> float:
-        if alpha <= 1.0:
-            return math.inf
-        return steps * alpha / (2.0 * sigma2) + log1d / (alpha - 1.0)
-
-    return min(eps(a) for a in candidates)
+    alpha = 1.0 + math.sqrt(2.0 * sigma2 * log1d / steps)
+    return steps * alpha / (2.0 * sigma2) + log1d / (alpha - 1.0)
 
 
 def dp_sgd_privacy_spent(
@@ -61,13 +48,23 @@ def dp_sgd_privacy_spent(
     clip_norm: float,
     steps: int,
     delta: float = 1e-5,
+    nonprivate_steps: int = 0,
 ) -> dict:
-    """Summary dict for a completed DP-SGD run (ready for metadata/info)."""
+    """Summary dict for a completed DP-SGD run (ready for metadata/info).
+
+    ``nonprivate_steps`` counts training steps taken WITHOUT the DP
+    mechanism on the same released model: any such step voids the guarantee,
+    so epsilon becomes ``inf`` (a non-DP run must never read as epsilon=0).
+    """
+    eps = gaussian_rdp_epsilon(noise_multiplier, steps, delta)
+    if nonprivate_steps > 0:
+        eps = math.inf
     return {
         "mechanism": "gaussian-rdp-conservative",
         "noise_multiplier": float(noise_multiplier),
         "clip_norm": float(clip_norm),
         "steps": int(steps),
+        "nonprivate_steps": int(nonprivate_steps),
         "delta": float(delta),
-        "epsilon": gaussian_rdp_epsilon(noise_multiplier, steps, delta),
+        "epsilon": eps,
     }

@@ -171,9 +171,9 @@ class MeshSimulation:
         # round-start (diffused) model inside the jitted local step.
         self.fedprox_mu = float(fedprox_mu)
         # DP-SGD (no reference analogue): per-example clip + Gaussian noise
-        # inside the jitted local step (learner.dp_grads).
-        if dp_clip_norm > 0.0 and task == "lm":
-            raise ValueError("dp_clip_norm is only supported for task='classification'")
+        # inside the jitted local step (learner.dp_grads). For task="lm" the
+        # privacy unit is one SEQUENCE (dp_grads clips each row of the
+        # batch, and a batch row is a full sequence there).
         if dp_noise_multiplier > 0.0 and dp_clip_norm <= 0.0:
             raise ValueError(
                 "dp_noise_multiplier > 0 requires dp_clip_norm > 0 — without "
@@ -314,8 +314,11 @@ class MeshSimulation:
 
         # Cumulative per-node DP-SGD steps, counted as if every node trained
         # in every round (conservative: a node not on the committee spends
-        # nothing, so the true loss is never above this bound).
+        # nothing, so the true loss is never above this bound). Non-private
+        # steps (DP disabled) are counted separately: any of them voids the
+        # epsilon claim on the released weights.
         self._dp_steps_per_node = 0
+        self._nonprivate_steps_per_node = 0
 
         self._round_history: List[Dict[str, float]] = []
         # Rounds already executed (advanced by run(); restored by
@@ -577,13 +580,15 @@ class MeshSimulation:
                 test_loss.append(tl)
                 test_acc.append(ta)
                 done += chunk
+                # Per chunk, not per run: a later chunk failing must not
+                # erase the noise already injected by completed chunks.
+                # (Replayed rounds after a checkpoint resume re-count,
+                # which over-states epsilon — the safe direction.)
+                steps_per_epoch = self.x.shape[1] // self.batch_size
                 if self.dp_clip_norm > 0.0:
-                    # Per chunk, not per run: a later chunk failing must not
-                    # erase the noise already injected by completed chunks.
-                    # (Replayed rounds after a checkpoint resume re-count,
-                    # which over-states epsilon — the safe direction.)
-                    steps_per_epoch = self.x.shape[1] // self.batch_size
                     self._dp_steps_per_node += chunk * epochs * steps_per_epoch
+                else:
+                    self._nonprivate_steps_per_node += chunk * epochs * steps_per_epoch
                 # Save on the cadence, and always after the final chunk so the
                 # end-of-run state is never memory-only.
                 if checkpointer is not None and (
@@ -643,6 +648,7 @@ class MeshSimulation:
             self.dp_clip_norm,
             self._dp_steps_per_node,
             delta,
+            nonprivate_steps=self._nonprivate_steps_per_node,
         )
 
     def final_model(self, node: int = 0) -> ModelHandle:
@@ -675,8 +681,13 @@ class MeshSimulation:
                 "seed": self.seed,
                 # Privacy spend must survive resume: a fresh process that
                 # restored 50 DP rounds and runs 50 more must report 100
-                # rounds of noise, never 50.
+                # rounds of noise, never 50. The DP parameters are pinned
+                # too, so a resume under a different sigma cannot silently
+                # re-price the restored steps (load_from validates).
                 "dp_steps_per_node": self._dp_steps_per_node,
+                "nonprivate_steps_per_node": self._nonprivate_steps_per_node,
+                "dp_noise_multiplier": self.dp_noise_multiplier,
+                "dp_clip_norm": self.dp_clip_norm,
             },
         )
 
@@ -701,6 +712,29 @@ class MeshSimulation:
         self._dp_steps_per_node = max(
             self._dp_steps_per_node, int(meta.get("dp_steps_per_node", 0))
         )
+        self._nonprivate_steps_per_node = max(
+            self._nonprivate_steps_per_node,
+            int(meta.get("nonprivate_steps_per_node", 0)),
+        )
+        if self.dp_clip_norm > 0.0:
+            if "dp_noise_multiplier" not in meta:
+                # Pre-DP checkpoint: the restored weights embed training of
+                # unknown (non-private) provenance — void the epsilon claim.
+                self._nonprivate_steps_per_node = max(
+                    self._nonprivate_steps_per_node, 1
+                )
+            elif (
+                float(meta["dp_noise_multiplier"]) != self.dp_noise_multiplier
+                or float(meta.get("dp_clip_norm", 0.0)) != self.dp_clip_norm
+            ):
+                raise ValueError(
+                    "checkpoint was written with DP parameters "
+                    f"(sigma={meta['dp_noise_multiplier']}, "
+                    f"clip={meta.get('dp_clip_norm')}) that differ from this "
+                    f"simulation's (sigma={self.dp_noise_multiplier}, "
+                    f"clip={self.dp_clip_norm}); resuming would re-price the "
+                    "restored steps and invalidate privacy_spent()"
+                )
         if "seed" in meta and int(meta["seed"]) != self.seed:
             self.seed = int(meta["seed"])
         return self.completed_rounds

@@ -215,3 +215,34 @@ def test_dp_step_counter_survives_resume(tmp_path):
     assert resumed.privacy_spent()["steps"] == 2 * spent_first["steps"]
     assert resumed.privacy_spent()["epsilon"] > spent_first["epsilon"]
     ckpt.close()
+
+
+def test_dp_resume_rejects_changed_noise_parameters(tmp_path):
+    """Resuming a DP checkpoint under a different sigma would re-price the
+    restored steps; load_from must refuse."""
+    import pytest
+
+    from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from p2pfl_tpu.management.checkpoint import FLCheckpointer
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.parallel.simulation import MeshSimulation
+
+    data = synthetic_mnist(n_train=128, n_test=32)
+    parts = data.generate_partitions(2, RandomIIDPartitionStrategy)
+
+    def make(sigma):
+        return MeshSimulation(
+            mlp_model(seed=0), parts, train_set_size=2, batch_size=32, seed=0,
+            dp_clip_norm=1.0, dp_noise_multiplier=sigma,
+        )
+
+    ckpt = FLCheckpointer(str(tmp_path / "dp-mismatch"))
+    sim = make(0.5)
+    sim.run(rounds=1, epochs=1, warmup=False, checkpointer=ckpt)
+    with pytest.raises(ValueError, match="re-price"):
+        make(2.0).load_from(ckpt)
+    # matching parameters restore fine
+    ok = make(0.5)
+    ok.load_from(ckpt)
+    assert ok.privacy_spent()["steps"] == sim.privacy_spent()["steps"]
+    ckpt.close()

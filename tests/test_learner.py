@@ -289,3 +289,16 @@ def test_dp_learner_reports_privacy_spent():
     learner.fit()
     assert learner.privacy_spent()["steps"] == 16
     assert learner.privacy_spent()["epsilon"] > info["epsilon"]
+
+
+def test_privacy_spent_is_inf_after_nonprivate_training():
+    """A model trained without DP must never read as epsilon=0 — any
+    non-private step voids the claim."""
+    data = synthetic_mnist(n_train=64, n_test=16)
+    learner = JaxLearner(mlp_model(seed=0), data, "plain", batch_size=32)
+    assert learner.privacy_spent()["epsilon"] == 0.0  # nothing released yet
+    learner.set_epochs(1)
+    learner.fit()
+    spent = learner.privacy_spent()
+    assert spent["epsilon"] == float("inf")
+    assert spent["nonprivate_steps"] > 0

@@ -34,6 +34,12 @@ trained TrainState back into the model it returns, so its federation
 aggregates initial weights and that accuracy stays ~random — see the
 baseline "note" field and SURVEY.md §7 quirks.)
 
+If the TPU probe fails (the tunneled chip can be unreachable for hours),
+the metric is measured at reduced scale on the 8-device virtual CPU mesh
+in a fresh subprocess (the wedged client init holds jax's backend lock in
+this process) and labeled with an explicit ``scale_note`` — an honest
+smaller number instead of no number.
+
 Always prints exactly ONE JSON line:
 {"metric", "value", "unit", "vs_baseline", "extra", ["error"]}.
 """
@@ -99,7 +105,7 @@ def _phase(msg: str) -> None:
     print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
 
 
-def probe_backend(attempts: int = 3, timeout: float = 180.0) -> str:
+def probe_backend(attempts: int = 2, timeout: float = 180.0) -> str:
     """Bounded, retried backend-init probe: a flaky TPU client must produce
     a JSON error line, not a hang or a bare rc=1 (round-1/2 failure mode)."""
     last_err: list[str] = ["backend probe never ran"]
@@ -160,39 +166,111 @@ def _make_data(num_nodes: int, samples: int, test_samples: int, seed: int = 42):
     return out
 
 
-def bench_tpu() -> dict:
+_metric_data_cache: dict = {}
+
+
+def _metric_sim_run(nodes: int, rounds: int, rpc: int) -> dict:
+    """One measurement of the metric simulation at the given scale —
+    the ONE place the metric's sim config lives (primary TPU path and CPU
+    fallback must never drift apart)."""
     from p2pfl_tpu.models import mlp_model
     from p2pfl_tpu.parallel.simulation import MeshSimulation
 
-    _phase("generating data on device")
-    x, y, mask, xt, yt = _make_data(NUM_NODES, SAMPLES_PER_NODE, TEST_SAMPLES)
-
-    _phase("building simulation")
-    sweep: dict[int, float] = {}
-    best = None
-    for rpc in (1, 5, 10):
-        sim = MeshSimulation(
-            mlp_model(seed=0),
-            (x, y, mask),
-            test_data=(xt, yt),
-            train_set_size=COMMITTEE,
-            batch_size=BATCH,
-            seed=1,
-        )
-        _phase(f"rounds_per_call={rpc}: warmup compile + timed run")
-        res = sim.run(rounds=ROUNDS, epochs=EPOCHS, warmup=True, rounds_per_call=rpc)
-        sweep[rpc] = res.seconds_per_round
-        _phase(f"rounds_per_call={rpc}: {res.seconds_per_round:.5f}s/round acc={res.test_acc[-1]:.3f}")
-        if best is None or res.seconds_per_round < best[1].seconds_per_round:
-            best = (rpc, res)
-    rpc, res = best
+    if nodes not in _metric_data_cache:  # the rpc sweep reuses one dataset
+        _phase("generating data on device")
+        _metric_data_cache[nodes] = _make_data(nodes, SAMPLES_PER_NODE, TEST_SAMPLES)
+    x, y, mask, xt, yt = _metric_data_cache[nodes]
+    sim = MeshSimulation(
+        mlp_model(seed=0), (x, y, mask), test_data=(xt, yt),
+        train_set_size=COMMITTEE, batch_size=BATCH, seed=1,
+    )
+    res = sim.run(rounds=rounds, epochs=EPOCHS, warmup=True, rounds_per_call=rpc)
     return {
         "sec_per_round": res.seconds_per_round,
         "rounds_per_sec": 1.0 / res.seconds_per_round,
         "final_test_acc": res.test_acc[-1],
         "rounds_per_call": rpc,
-        "rounds_per_call_sweep": {str(k): round(v, 6) for k, v in sweep.items()},
+        "nodes": nodes,
+        "rounds": rounds,
     }
+
+
+def bench_tpu() -> dict:
+    _phase("building simulation")
+    sweep: dict[int, float] = {}
+    best = None
+    for rpc in (1, 5, 10):
+        _phase(f"rounds_per_call={rpc}: warmup compile + timed run")
+        out = _metric_sim_run(NUM_NODES, ROUNDS, rpc)
+        sweep[rpc] = out["sec_per_round"]
+        _phase(
+            f"rounds_per_call={rpc}: {out['sec_per_round']:.5f}s/round "
+            f"acc={out['final_test_acc']:.3f}"
+        )
+        if best is None or out["sec_per_round"] < best["sec_per_round"]:
+            best = out
+    best["rounds_per_call_sweep"] = {str(k): round(v, 6) for k, v in sweep.items()}
+    return best
+
+
+def run_cpu_fallback() -> None:
+    """Subprocess body: reduced-scale measurement on the virtual CPU mesh.
+
+    Runs when the TPU probe fails (the tunneled chip can be unreachable for
+    hours): the number is honest — same simulation code path, same measured
+    reference baseline — just on CPU at 8 nodes x 4 rounds, and the parent
+    relabels the metric so it can never be misread as the 100-node result.
+    A SUBPROCESS is mandatory: a hung axon client init in the parent holds
+    jax's backend-init lock, deadlocking any in-process CPU retry.
+    """
+    out: dict = {}
+    try:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        out = _metric_sim_run(nodes=8, rounds=4, rpc=4)
+    except Exception as e:  # noqa: BLE001
+        traceback.print_exc(file=sys.stderr)
+        out = {"error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(out), flush=True)
+    os._exit(0)
+
+
+def _json_subprocess(args: list, timeout: float, env: dict) -> dict:
+    """Run a bench subprocess mode, parse its single JSON line; on any
+    failure raise with a stderr tail so crashes are diagnosable."""
+    stderr_tail = ""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"), *args],
+            capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
+        )
+        stderr_tail = (proc.stderr or "")[-1500:]
+        line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+        out = json.loads(line)
+        if "error" in out:
+            raise RuntimeError(out["error"])
+        return out
+    except Exception as e:  # noqa: BLE001
+        if isinstance(e, subprocess.TimeoutExpired) and e.stderr:
+            stderr_tail = (
+                e.stderr[-1500:] if isinstance(e.stderr, str) else e.stderr.decode()[-1500:]
+            )
+        raise RuntimeError(
+            f"{type(e).__name__}: {e}\n--- subprocess stderr tail ---\n{stderr_tail}"
+        ) from e
+
+
+def measure_cpu_fallback(budget: float) -> dict:
+    """Run the reduced-scale CPU measurement in a subprocess and parse it."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return _json_subprocess(["--cpu-fallback"], max(120.0, budget), env)
 
 
 def bench_mfu(device_kind: str) -> dict:
@@ -253,27 +331,12 @@ def measure_reference_baseline(remaining: float = float("inf")) -> dict:
             break
         _phase(f"reference baseline attempt: {nodes} nodes x {rounds} round(s), cap {budget:.0f}s")
         try:
-            proc = subprocess.run(
-                [
-                    sys.executable, os.path.join(REPO, "bench.py"),
-                    "--baseline-ref", str(nodes), str(rounds),
-                ],
-                capture_output=True, text=True, timeout=budget, env=env, cwd=REPO,
+            return _json_subprocess(
+                ["--baseline-ref", str(nodes), str(rounds)], budget, env
             )
-            line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
-            out = json.loads(line)
-            if "error" in out:
-                raise RuntimeError(out["error"])
-            return out
         except Exception as e:  # noqa: BLE001 — try the next rung
-            last_err = f"{type(e).__name__}: {e}"
-            stderr_tail = ""
-            try:
-                stderr_tail = (proc.stderr or "")[-1500:]
-            except NameError:  # timeout: proc never bound
-                if isinstance(e, subprocess.TimeoutExpired) and e.stderr:
-                    stderr_tail = e.stderr[-1500:] if isinstance(e.stderr, str) else e.stderr.decode()[-1500:]
-            _phase(f"reference baseline at {nodes} nodes failed: {last_err}\n{stderr_tail}")
+            last_err = str(e)  # includes the subprocess stderr tail
+            _phase(f"reference baseline at {nodes} nodes failed: {last_err}")
     raise RuntimeError(f"reference baseline failed at every ladder rung: {last_err}")
 
 
@@ -441,21 +504,46 @@ def main() -> None:
             soft_budget = float(os.environ.get("P2PFL_TPU_BENCH_BUDGET", "1500"))
         except ValueError:
             soft_budget = 1500.0
-        kind = probe_backend()
-        tpu = bench_tpu()
-        # A slow tunnel/compile must not push the whole bench past the
-        # driver's patience: when over half the soft budget is gone, skip
-        # the MFU probe and use the fast fallback baseline.
-        tight = time.monotonic() - t_start > soft_budget * 0.5
-        if tight:
-            _phase("soft budget tight: skipping MFU probe")
-            mfu = {"skipped": "soft time budget"}
+        scale_note = None
+        try:
+            kind = probe_backend()
+        except RuntimeError as probe_err:
+            # The tunneled chip can be down for hours; a reduced-scale CPU
+            # measurement (same code path, same measured baseline, honestly
+            # labeled) beats an error line with no number.
+            _phase(f"{probe_err}; falling back to reduced-scale CPU-mesh run")
+            kind = None
+        if kind is None:
+            tpu = measure_cpu_fallback(soft_budget * 0.3)
+            kind = "cpu (TPU unavailable)"
+            mfu = {"skipped": "TPU unavailable (reduced-scale CPU fallback)"}
+            # Relabel the metric and flag degradation at TOP level: a
+            # consumer parsing only {metric, value, vs_baseline} must never
+            # mistake the reduced-scale CPU number for the 100-node result.
+            out["metric"] = (
+                f"sec_per_round_{tpu['nodes']}node_mnist_fedavg_cpu_fallback"
+            )
+            out["degraded"] = True
+            scale_note = (
+                f"TPU tunnel down: measured at {tpu['nodes']} nodes x "
+                f"{tpu['rounds']} rounds on the 8-device virtual CPU mesh "
+                f"(metric shape is {NUM_NODES} nodes x {ROUNDS} rounds)"
+            )
         else:
-            try:
-                mfu = bench_mfu(kind)
-            except Exception as e:  # noqa: BLE001 — MFU must not kill the metric
-                traceback.print_exc(file=sys.stderr)
-                mfu = {"error": f"{type(e).__name__}: {e}"}
+            tpu = bench_tpu()
+            # A slow tunnel/compile must not push the whole bench past the
+            # driver's patience: when over half the soft budget is gone, skip
+            # the MFU probe and use the fast fallback baseline.
+            tight = time.monotonic() - t_start > soft_budget * 0.5
+            if tight:
+                _phase("soft budget tight: skipping MFU probe")
+                mfu = {"skipped": "soft time budget"}
+            else:
+                try:
+                    mfu = bench_mfu(kind)
+                except Exception as e:  # noqa: BLE001 — MFU must not kill the metric
+                    traceback.print_exc(file=sys.stderr)
+                    mfu = {"error": f"{type(e).__name__}: {e}"}
         _phase("measuring reference baseline (subprocess, CPU)")
         try:
             remaining = soft_budget - (time.monotonic() - t_start)
@@ -478,17 +566,19 @@ def main() -> None:
             "final_test_acc": round(tpu["final_test_acc"], 4),
             "label_flip": LABEL_FLIP,
             "rounds_per_call": tpu["rounds_per_call"],
-            "rounds_per_call_sweep": tpu["rounds_per_call_sweep"],
+            "rounds_per_call_sweep": tpu.get("rounds_per_call_sweep"),
             "baseline": base.get("baseline"),
             "baseline_sec_per_round": round(base["sec_per_round"], 4),
             "baseline_final_test_acc": base.get("final_test_acc"),
             "baseline_note": base.get("note"),
             "device_kind": kind,
             "mfu_probe": mfu,
-            "rounds": ROUNDS,
-            "nodes": NUM_NODES,
+            "rounds": tpu.get("rounds", ROUNDS),
+            "nodes": tpu.get("nodes", NUM_NODES),
             "committee": COMMITTEE,
         }
+        if scale_note:
+            out["extra"]["scale_note"] = scale_note
     except Exception as e:  # noqa: BLE001
         traceback.print_exc(file=sys.stderr)
         out["error"] = f"{type(e).__name__}: {e}"
@@ -502,5 +592,7 @@ if __name__ == "__main__":
     if "--baseline-ref" in sys.argv:
         i = sys.argv.index("--baseline-ref")
         run_reference_baseline(int(sys.argv[i + 1]), int(sys.argv[i + 2]))
+    elif "--cpu-fallback" in sys.argv:
+        run_cpu_fallback()
     else:
         main()

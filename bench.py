@@ -131,7 +131,8 @@ def probe_backend(attempts: int = 2, timeout: float = 180.0) -> str:
             return result["kind"]
         last_err[0] = result.get("err", f"backend init timed out after {timeout}s")
         _phase(f"backend probe attempt {attempt}/{attempts} failed: {last_err[0]}")
-        time.sleep(min(30.0, 5.0 * attempt))
+        if attempt < attempts:  # no backoff after the final attempt
+            time.sleep(min(30.0, 5.0 * attempt))
     raise RuntimeError(f"TPU backend unavailable: {last_err[0]}")
 
 
@@ -312,19 +313,27 @@ def bench_mfu(device_kind: str) -> dict:
     }
 
 
-def measure_reference_baseline(remaining: float = float("inf")) -> dict:
+def measure_reference_baseline(
+    remaining: float = float("inf"), ladder=None
+) -> dict:
     """Measure the actual reference federation via the attempt ladder: run
     THIS file with --baseline-ref in a CPU-pinned subprocess (the reference
     import must never touch the TPU backend) and parse its single JSON
     line. Returns the largest completing configuration. Each rung's
     subprocess timeout is capped by the caller's ``remaining`` soft budget
     (minus a reserve for the fallback path), so the whole bench cannot
-    overshoot its budget chasing a slow rung."""
+    overshoot its budget chasing a slow rung.
+
+    ``ladder`` overrides BASELINE_LADDER — the degraded CPU-fallback path
+    passes a same-node-count ladder so the ratio stays apples-to-apples
+    (the reference's per-round cost grows with node count, so dividing an
+    8-node measurement by a 20-node baseline would overstate the speedup).
+    """
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     last_err = "ladder empty"
     deadline = time.monotonic() + remaining
-    for nodes, rounds, budget in BASELINE_LADDER:
+    for nodes, rounds, budget in (ladder if ladder is not None else BASELINE_LADDER):
         budget = min(budget, deadline - time.monotonic() - 60.0)  # 60s reserve
         if budget < 90.0:
             last_err = "soft budget exhausted before this rung"
@@ -550,6 +559,13 @@ def main() -> None:
             if remaining < 240.0:
                 _phase("soft budget tight: using torch-loop fallback baseline")
                 base = bench_torch_cpu_fallback()
+            elif scale_note is not None:
+                # Degraded run: baseline at the SAME node count as the
+                # fallback measurement (apples-to-apples ratio).
+                base = measure_reference_baseline(
+                    remaining,
+                    ladder=[(tpu["nodes"], 1, 700.0), (4, 1, 240.0)],
+                )
             else:
                 base = measure_reference_baseline(remaining)
         except Exception as e:  # noqa: BLE001

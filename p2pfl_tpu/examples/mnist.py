@@ -69,6 +69,13 @@ def build_parser() -> argparse.ArgumentParser:
         default=0.0,
         help="DP-SGD Gaussian noise multiplier sigma",
     )
+    p.add_argument(
+        "--wire-compression",
+        choices=["none", "bf16", "int8"],
+        default="none",
+        help="codec for gossiped weight frames (nodes mode; mesh mode "
+        "never puts weights on a wire)",
+    )
     p.add_argument("--seed", type=int, default=42)
     p.add_argument(
         "--platform",
@@ -144,6 +151,9 @@ def run_mesh(args: argparse.Namespace) -> dict:
 def run_nodes(args: argparse.Namespace) -> dict:
     import numpy as np
 
+    from p2pfl_tpu.config import Settings
+
+    Settings.WIRE_COMPRESSION = args.wire_compression
     from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
     from p2pfl_tpu.models import mlp_model
     from p2pfl_tpu.node import Node

@@ -585,6 +585,11 @@ def main() -> None:
             "rounds_per_call_sweep": tpu.get("rounds_per_call_sweep"),
             "baseline": base.get("baseline"),
             "baseline_sec_per_round": round(base["sec_per_round"], 4),
+            # Baseline's own shape: makes a ladder fall-through (e.g. the
+            # matched-count rung failing in degraded mode) visible in the
+            # JSON rather than silently skewing vs_baseline.
+            "baseline_nodes": base.get("nodes"),
+            "baseline_rounds": base.get("rounds"),
             "baseline_final_test_acc": base.get("final_test_acc"),
             "baseline_note": base.get("note"),
             "device_kind": kind,

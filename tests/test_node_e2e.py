@@ -179,3 +179,35 @@ def test_e2e_with_int8_wire_compression():
         finally:
             for node in nodes:
                 node.stop()
+
+
+def test_node_down_during_learning():
+    """Kill a node mid-experiment: survivors detect the death via heartbeats
+    and finish the remaining rounds through vote/aggregation timeouts with
+    equal models. The reference ships this scenario DISABLED
+    (_test_node_down_on_learning, node_test.py:160-180); here it runs."""
+    Settings.RESOURCE_MONITOR_PERIOD = 0
+    nodes = _spawn(3)
+    try:
+        nodes[1].connect(nodes[0].addr)
+        nodes[2].connect(nodes[0].addr)
+        wait_convergence(nodes, 2, wait=5)
+        nodes[0].set_start_learning(rounds=3, epochs=1)
+        time.sleep(1.5)  # let round 0 get going, then kill a participant
+        nodes[2].stop()
+        survivors = nodes[:2]
+        deadline = time.time() + 150
+        while any(n.learning_in_progress() for n in survivors):
+            if time.time() > deadline:
+                raise TimeoutError("survivors did not finish after node death")
+            time.sleep(0.3)
+        # the dead node is gone from every survivor's view
+        for n in survivors:
+            assert nodes[2].addr not in n.protocol.get_neighbors(only_direct=False)
+        check_equal_models(survivors)
+        for n in survivors:
+            acc = n.learner.evaluate().get("test_acc")
+            assert acc is not None and acc > 0.5, acc
+    finally:
+        for node in nodes:
+            node.stop()

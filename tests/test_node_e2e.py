@@ -193,14 +193,18 @@ def test_node_down_during_learning():
         nodes[2].connect(nodes[0].addr)
         wait_convergence(nodes, 2, wait=5)
         nodes[0].set_start_learning(rounds=3, epochs=1)
-        time.sleep(1.5)  # let round 0 get going, then kill a participant
-        nodes[2].stop()
+        time.sleep(1.5)  # let round 0 get going, then crash a participant
+        # Simulate an UNANNOUNCED crash: silence the node's threads and
+        # server without the graceful disconnect notification that
+        # Node.stop() sends — the survivors must notice via the heartbeat
+        # staleness sweep, which is exactly what's under test.
+        crashed = nodes[2].protocol
+        crashed._running = False
+        crashed.heartbeater.stop()
+        crashed.gossiper.stop()
+        crashed._server_stop()
         survivors = nodes[:2]
-        deadline = time.time() + 150
-        while any(n.learning_in_progress() for n in survivors):
-            if time.time() > deadline:
-                raise TimeoutError("survivors did not finish after node death")
-            time.sleep(0.3)
+        _wait_finished(survivors, timeout=150)
         # the dead node is gone from every survivor's view
         for n in survivors:
             assert nodes[2].addr not in n.protocol.get_neighbors(only_direct=False)
@@ -211,3 +215,35 @@ def test_node_down_during_learning():
     finally:
         for node in nodes:
             node.stop()
+
+
+def test_e2e_scaffold_with_wire_compression():
+    """SCAFFOLD federation under bf16 wire compression: the weight tensors
+    compress but the control-variate deltas ride the frame METADATA
+    (ndarray-tagged, never compressed), so the scaffold server math stays
+    full precision. Proves no interaction bug between the codec and the
+    additional_info side channel."""
+    from p2pfl_tpu.learning.aggregators import Scaffold
+
+    Settings.RESOURCE_MONITOR_PERIOD = 0
+    with Settings.overridden(WIRE_COMPRESSION="bf16"):
+        data = synthetic_mnist(n_train=512, n_test=128)
+        parts = data.generate_partitions(2, RandomIIDPartitionStrategy)
+        nodes = [
+            Node(mlp_model(seed=i), parts[i], aggregator=Scaffold(), batch_size=32)
+            for i in range(2)
+        ]
+        for node in nodes:
+            node.start()
+        try:
+            nodes[1].connect(nodes[0].addr)
+            wait_convergence(nodes, 1, wait=5)
+            nodes[0].set_start_learning(rounds=2, epochs=2)
+            _wait_finished(nodes)
+            check_equal_models(nodes)
+            for node in nodes:
+                acc = node.learner.evaluate().get("test_acc")
+                assert acc is not None and acc > 0.5, acc
+        finally:
+            for node in nodes:
+                node.stop()

@@ -72,11 +72,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--wire-compression",
         choices=["none", "bf16", "int8"],
-        default="none",
+        default=None,
         help="codec for gossiped weight frames (nodes mode; mesh mode "
-        "never puts weights on a wire)",
+        "never puts weights on a wire). Unset: the "
+        "P2PFL_TPU_WIRE_COMPRESSION env override (or 'none') applies.",
     )
-    p.add_argument("--seed", type=int, default=42)
+    p.add_argument(
+        "--seed",
+        type=int,
+        default=None,
+        help="pin the trainer RNG seed (reproducible runs; voids the DP "
+        "noise-unpredictability guarantee). Unset: OS entropy.",
+    )
     p.add_argument(
         "--platform",
         choices=["default", "cpu", "tpu"],
@@ -121,8 +128,11 @@ def run_mesh(args: argparse.Namespace) -> dict:
     }.get(args.aggregator)
     algorithm = "scaffold" if args.aggregator == "scaffold" else "fedavg"
 
+    # Data stays deterministic either way — only the trainer seed (batch
+    # order, committee draw, DP noise) goes entropy-derived when unset.
     data = synthetic_mnist(
-        n_train=args.nodes * args.samples_per_node, n_test=1024, seed=args.seed
+        n_train=args.nodes * args.samples_per_node, n_test=1024,
+        seed=42 if args.seed is None else args.seed,
     )
     parts = data.generate_partitions(args.nodes, RandomIIDPartitionStrategy)
     sim = MeshSimulation(
@@ -153,7 +163,8 @@ def run_nodes(args: argparse.Namespace) -> dict:
 
     from p2pfl_tpu.config import Settings
 
-    Settings.WIRE_COMPRESSION = args.wire_compression
+    if args.wire_compression is not None:  # unset keeps the env override
+        Settings.WIRE_COMPRESSION = args.wire_compression
     from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
     from p2pfl_tpu.models import mlp_model
     from p2pfl_tpu.node import Node
@@ -176,7 +187,8 @@ def run_nodes(args: argparse.Namespace) -> dict:
         addr = lambda i: None  # noqa: E731
 
     data = synthetic_mnist(
-        n_train=args.nodes * args.samples_per_node, n_test=512, seed=args.seed
+        n_train=args.nodes * args.samples_per_node, n_test=512,
+        seed=42 if args.seed is None else args.seed,
     )
     parts = data.generate_partitions(args.nodes, RandomIIDPartitionStrategy)
     nodes = [

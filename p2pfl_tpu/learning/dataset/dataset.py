@@ -178,7 +178,7 @@ class FederatedDataset:
         self,
         batch_size: int,
         train: bool = True,
-        seed: int = 0,
+        seed: "int | Tuple[int, ...]" = 0,
         drop_remainder: bool = False,
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Fixed-shape batched arrays for a jitted ``lax.scan`` epoch.
@@ -187,6 +187,10 @@ class FederatedDataset:
         ``[steps, B]``, ``[steps, B]``; ``wb`` is a 0/1 validity mask covering
         the padding of the final partial batch (so jitted loss math can ignore
         padded rows while shapes stay static).
+
+        ``seed`` may be an int or a tuple of ints — tuples feed numpy's
+        ``SeedSequence`` hash, giving collision-free streams for structured
+        coordinates like ``(base_seed, fit, epoch)``.
         """
         x, y = self.export_arrays(train)
         n = len(y)

@@ -166,8 +166,8 @@ class KerasLearner(Learner):
         for cb in self._callback_objs:
             cb.on_fit_start(self)
         t0 = time.monotonic()
-        keras.utils.set_random_seed(self.seed + self._fit_count)
-        epoch_seed = self.seed + 1000 * self._fit_count
+        keras.utils.set_random_seed((self.seed + self._fit_count) % 2**31)
+        fit_idx = self._fit_count
         self._fit_count += 1
 
         model._load()
@@ -199,8 +199,10 @@ class KerasLearner(Learner):
         for epoch in range(self.epochs):
             if self._interrupt.is_set():
                 break
+            # Tuple seed = SeedSequence hash: collision-free across (fit,
+            # epoch), matching JaxLearner's fold_in-derived streams.
             xb, yb, wb = self.get_data().export_batches(
-                self.batch_size, train=True, seed=epoch_seed + epoch
+                self.batch_size, train=True, seed=(self.seed, fit_idx, epoch)
             )
             losses = []
             for x, y, w in zip(xb, yb, wb):

@@ -188,7 +188,7 @@ class TorchLearner(Learner):
             cb.on_fit_start(self)
         t0 = time.monotonic()
         torch.manual_seed(self.seed + self._fit_count)
-        epoch_seed = self.seed + 1000 * self._fit_count
+        fit_idx = self._fit_count
         self._fit_count += 1
 
         model._load()
@@ -226,8 +226,10 @@ class TorchLearner(Learner):
         for epoch in range(self.epochs):
             if self._interrupt.is_set():
                 break
+            # Tuple seed = SeedSequence hash: collision-free across (fit,
+            # epoch), matching JaxLearner's fold_in-derived streams.
             xb, yb, wb = self.get_data().export_batches(
-                self.batch_size, train=True, seed=epoch_seed + epoch
+                self.batch_size, train=True, seed=(self.seed, fit_idx, epoch)
             )
             losses = []
             for x, y, w in zip(xb, yb, wb):

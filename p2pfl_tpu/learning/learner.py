@@ -217,7 +217,12 @@ class JaxLearner(Learner):
             clipped to this L2 norm (see :func:`dp_grads`).
         dp_noise_multiplier: Gaussian noise scale sigma for DP-SGD (noise
             std = clip * sigma / batch on the mean gradient).
-        seed: base RNG seed; batch order varies per fit() call.
+        seed: base RNG seed. Default ``None`` draws the base from OS
+            entropy — required for the DP-SGD epsilon claim to mean
+            anything, since a noise key derived from public values lets an
+            observer regenerate and subtract the noise. Pinning an int is
+            an explicit reproducibility opt-in; with DP enabled it voids
+            the privacy claim against any adversary who learns the seed.
     """
 
     SUPPORTED_CALLBACKS = ("scaffold",)
@@ -233,7 +238,7 @@ class JaxLearner(Learner):
         fedprox_mu: float = 0.0,
         dp_clip_norm: float = 0.0,
         dp_noise_multiplier: float = 0.0,
-        seed: int = 0,
+        seed: Optional[int] = None,
         callbacks: Optional[List[str]] = None,
     ) -> None:
         super().__init__(model, data, self_addr)
@@ -249,7 +254,9 @@ class JaxLearner(Learner):
                 "a clip bound the DP branch never runs and training would be "
                 "silently non-private"
             )
-        self.seed = int(seed)
+        from p2pfl_tpu.learning.privacy import resolve_seed
+
+        self.seed = resolve_seed(seed, self.dp_noise_multiplier)
         self.callbacks = list(callbacks or [])
         # Reserved names run inside the jitted step; everything else is a
         # host-side callback resolved through the open registry
@@ -378,8 +385,13 @@ class JaxLearner(Learner):
         for cb in self._callback_objs:
             cb.on_fit_start(self)
         t0 = time.monotonic()
-        epoch_seed = self.seed + 1000 * self._fit_count
+        fit_idx = self._fit_count
         self._fit_count += 1
+        # Collision-free (fit, epoch) streams: arithmetic like
+        # seed + 1000*fit + epoch aliases across fit() calls at epochs>=1000
+        # and would reuse both the batch permutation and the DP noise key
+        # (ADVICE r3) — fold_in / SeedSequence hash instead.
+        fit_key = jax.random.fold_in(jax.random.key(self.seed), fit_idx)
 
         params = model.params
         if self._opt_state is None:
@@ -405,7 +417,7 @@ class JaxLearner(Learner):
             if self._interrupt.is_set():
                 break
             xb, yb, wb = self.get_data().export_batches(
-                self.batch_size, train=True, seed=epoch_seed + epoch
+                self.batch_size, train=True, seed=(self.seed, fit_idx, epoch)
             )
             params, opt_state, loss = self._train_epoch(
                 params,
@@ -416,10 +428,10 @@ class JaxLearner(Learner):
                 anchor,
                 c_global,
                 c_local,
-                # Fold the node identity in: nodes sharing the default seed
+                # Fold the node identity in: nodes sharing a pinned seed
                 # must not inject identical (coherent, recomputable) DP noise.
                 jax.random.fold_in(
-                    jax.random.key(epoch_seed + epoch),
+                    jax.random.fold_in(fit_key, epoch),
                     zlib.crc32(self._self_addr.encode()),
                 ),
                 apply_fn=model.apply_fn,

@@ -18,6 +18,35 @@ machinery.
 from __future__ import annotations
 
 import math
+import secrets
+import warnings
+from typing import Optional
+
+
+def resolve_seed(seed: Optional[int], dp_noise_multiplier: float = 0.0) -> int:
+    """Entropy-or-pinned base RNG seed for a trainer (shared by JaxLearner
+    and MeshSimulation so the DP seed policy can't drift between modes).
+
+    ``None`` (the default everywhere) draws the base from OS entropy —
+    required for a DP-SGD epsilon claim to mean anything, since a noise key
+    derived from public values lets an observer regenerate and subtract the
+    noise. Pinning an int is an explicit reproducibility opt-in (simulation
+    studies, bit-identical resume); with DP enabled it triggers a warning
+    because the epsilon claim then only holds while the seed stays secret
+    (note: MeshSimulation persists the seed in plaintext checkpoint
+    metadata).
+    """
+    if seed is None:
+        return secrets.randbits(31)
+    if dp_noise_multiplier > 0.0:
+        warnings.warn(
+            "DP-SGD with a pinned seed: the Gaussian noise is recomputable "
+            "by anyone who knows the seed, so the reported epsilon only "
+            "holds while the seed stays secret. Pass seed=None (default) "
+            "for entropy-derived noise.",
+            stacklevel=3,
+        )
+    return int(seed)
 
 
 def gaussian_rdp_epsilon(noise_multiplier: float, steps: int, delta: float) -> float:

@@ -48,6 +48,7 @@ from p2pfl_tpu.learning.learner import (
     masked_lm_loss,
     softmax_cross_entropy,
 )
+from p2pfl_tpu.learning.privacy import resolve_seed
 from p2pfl_tpu.models.model_handle import ModelHandle
 from p2pfl_tpu.ops import aggregation as agg_ops
 from p2pfl_tpu.parallel.mesh import make_mesh
@@ -136,7 +137,7 @@ class MeshSimulation:
         batch_size: int = 64,
         lr: float = 1e-3,
         optimizer: Optional[optax.GradientTransformation] = None,
-        seed: int = 0,
+        seed: Optional[int] = None,
         mesh: Optional[Mesh] = None,
         aggregate_fn: Optional[Callable[[Pytree, jax.Array], Pytree]] = None,
         per_node_init: bool = False,
@@ -195,7 +196,7 @@ class MeshSimulation:
             self.optimizer = optax.sgd(lr)
         else:
             self.optimizer = optax.adam(lr)
-        self.seed = int(seed)
+        self.seed = resolve_seed(seed, self.dp_noise_multiplier)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.aggregate_fn = aggregate_fn if aggregate_fn is not None else agg_ops.fedavg
 

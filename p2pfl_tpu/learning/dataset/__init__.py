@@ -2,8 +2,21 @@
 
 from p2pfl_tpu.learning.dataset.dataset import (  # noqa: F401
     FederatedDataset,
+    cifar10,
     mnist,
+    synthetic_cifar10,
     synthetic_mnist,
+)
+from p2pfl_tpu.learning.dataset.export_strategies import (  # noqa: F401
+    BatchedArraysExportStrategy,
+    ExportStrategy,
+    NumpyExportStrategy,
+    TensorFlowExportStrategy,
+    TorchExportStrategy,
+)
+from p2pfl_tpu.learning.dataset.poison import (  # noqa: F401
+    flip_labels,
+    poison_partitions,
 )
 from p2pfl_tpu.learning.dataset.partition import (  # noqa: F401
     DirichletPartitionStrategy,

@@ -192,27 +192,39 @@ class FederatedDataset:
         ``SeedSequence`` hash, giving collision-free streams for structured
         coordinates like ``(base_seed, fit, epoch)``.
         """
+        from p2pfl_tpu.learning.dataset.export_strategies import (
+            BatchedArraysExportStrategy,
+        )
+
+        return self.export(
+            BatchedArraysExportStrategy,
+            train=train,
+            batch_size=batch_size,
+            seed=seed,
+            drop_remainder=drop_remainder,
+        )
+
+    def export(
+        self,
+        strategy: type,
+        train: bool = True,
+        batch_size: int = 64,
+        seed: "int | Tuple[int, ...]" = 0,
+        **kwargs,
+    ) -> Any:
+        """Export the split through a framework-native strategy (reference
+        ``P2PFLDataset.export``, p2pfl_dataset.py:224-248).
+
+        ``strategy`` is an :class:`~p2pfl_tpu.learning.dataset.
+        export_strategies.ExportStrategy` subclass — e.g.
+        ``TorchExportStrategy`` (a ``DataLoader``),
+        ``TensorFlowExportStrategy`` (a ``tf.data.Dataset``), or
+        ``BatchedArraysExportStrategy`` (the TPU ``lax.scan`` layout).
+        """
         x, y = self.export_arrays(train)
-        n = len(y)
-        rng = np.random.default_rng(seed)
-        order = rng.permutation(n)
-        x, y = x[order], y[order]
-        if drop_remainder:
-            steps = n // batch_size
-            pad = 0
-        else:
-            steps = -(-n // batch_size)
-            pad = steps * batch_size - n
-        if pad:
-            x = np.concatenate([x, np.zeros((pad, *x.shape[1:]), x.dtype)])
-            y = np.concatenate([y, np.zeros((pad,), y.dtype)])
-        w = np.ones((steps * batch_size,), np.float32)
-        if pad:
-            w[-pad:] = 0.0
-        xb = x[: steps * batch_size].reshape(steps, batch_size, *x.shape[1:])
-        yb = y[: steps * batch_size].reshape(steps, batch_size)
-        wb = w.reshape(steps, batch_size)
-        return xb, yb, wb
+        return strategy.export(
+            x, y, train=train, batch_size=batch_size, seed=seed, **kwargs
+        )
 
 
 class _ArraySplit:
@@ -262,6 +274,52 @@ def synthetic_mnist(
     x_train, y_train = make(n_train, np.random.default_rng(seed + 1))
     x_test, y_test = make(n_test, np.random.default_rng(seed + 2))
     return FederatedDataset.from_arrays(x_train, y_train, x_test, y_test)
+
+
+def synthetic_cifar10(
+    n_train: int = 8192,
+    n_test: int = 1024,
+    num_classes: int = 10,
+    image_size: int = 32,
+    seed: int = 42,
+    noise: float = 0.25,
+) -> FederatedDataset:
+    """Deterministic CIFAR-shaped dataset ``[N, H, W, 3]`` a convnet can learn
+    (BASELINE.json configs #3/#4 shape, no downloads).
+
+    Each class has a fixed low-frequency color template (random coarse grid
+    upsampled to ``image_size``); samples are ``template + gaussian noise``
+    clipped to [0, 1]. The coarse structure rewards spatial feature
+    extraction — a conv stem separates the classes quickly while the task
+    stays nontrivial under per-pixel noise.
+    """
+    rng = np.random.default_rng(seed)
+    coarse = rng.uniform(0.0, 1.0, size=(num_classes, 4, 4, 3)).astype(np.float32)
+    reps = -(-image_size // 4)
+    templates = np.repeat(np.repeat(coarse, reps, axis=1), reps, axis=2)[
+        :, :image_size, :image_size, :
+    ]
+
+    def make(n: int, rng: np.random.Generator) -> Tuple[np.ndarray, np.ndarray]:
+        y = rng.integers(0, num_classes, size=n).astype(np.int32)
+        x = templates[y] + rng.normal(0.0, noise, size=(n, image_size, image_size, 3)).astype(
+            np.float32
+        )
+        return np.clip(x, 0.0, 1.0), y
+
+    x_train, y_train = make(n_train, np.random.default_rng(seed + 1))
+    x_test, y_test = make(n_test, np.random.default_rng(seed + 2))
+    return FederatedDataset.from_arrays(x_train, y_train, x_test, y_test)
+
+
+def cifar10(fallback_synthetic: bool = True) -> FederatedDataset:
+    """Real CIFAR-10 from the HF hub if reachable, else the synthetic stand-in."""
+    try:
+        return FederatedDataset.from_huggingface("uoft-cs/cifar10", y_key="label")
+    except Exception:
+        if not fallback_synthetic:
+            raise
+        return synthetic_cifar10()
 
 
 def mnist(fallback_synthetic: bool = True) -> FederatedDataset:

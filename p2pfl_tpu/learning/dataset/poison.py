@@ -1,0 +1,68 @@
+"""Byzantine data poisoning for robustness experiments.
+
+Attack models used to exercise the robust aggregation rules
+(:mod:`p2pfl_tpu.learning.aggregators.robust`, BASELINE.json config #4).
+No reference analogue — p2pfl ships robust-aggregation stubs but no way to
+actually attack a federation with them.
+
+Two standard attacks:
+
+* **label flip** — a poisoned node trains on systematically wrong labels
+  (``y -> (y + offset) mod C``), producing a model update that pulls the
+  global model toward misclassification while looking statistically
+  ordinary (hard for distance-based rules at low poison rates).
+* **sign flip** — a poisoned node negates its model delta (handled at the
+  aggregation layer by tests; the data-side helpers here only cover label
+  attacks since the mesh simulation owns the update path).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from p2pfl_tpu.learning.dataset.dataset import FederatedDataset
+
+
+def flip_labels(
+    dataset: FederatedDataset,
+    num_classes: int,
+    offset: int = 1,
+) -> FederatedDataset:
+    """A copy of ``dataset`` whose TRAIN labels are shifted by ``offset``
+    (mod ``num_classes``); the test split is left clean so evaluation still
+    measures true accuracy."""
+    x, y = dataset.export_arrays(train=True)
+    flipped = ((y.astype(np.int64) + offset) % num_classes).astype(y.dtype)
+    try:
+        xt, yt = dataset.export_arrays(train=False)
+    except KeyError:
+        xt = yt = None
+    return FederatedDataset.from_arrays(x, flipped, xt, yt)
+
+
+def poison_partitions(
+    partitions: Sequence[FederatedDataset],
+    fraction: float,
+    num_classes: int,
+    seed: int = 0,
+    offset: int = 1,
+) -> Tuple[List[FederatedDataset], np.ndarray]:
+    """Label-flip a random ``fraction`` of the partitions (Byzantine nodes).
+
+    Returns ``(partitions, poisoned_indices)`` — the returned list is a new
+    list where the chosen partitions are replaced by label-flipped copies;
+    indices identify which nodes are Byzantine (ground truth for asserting
+    that a robust rule excluded or out-voted them).
+    """
+    if not 0.0 <= fraction < 1.0:
+        raise ValueError(f"fraction must be in [0, 1), got {fraction}")
+    n = len(partitions)
+    k = int(round(fraction * n))
+    rng = np.random.default_rng(seed)
+    poisoned = np.sort(rng.choice(n, size=k, replace=False))
+    out = list(partitions)
+    for i in poisoned:
+        out[i] = flip_labels(partitions[i], num_classes, offset)
+    return out, poisoned

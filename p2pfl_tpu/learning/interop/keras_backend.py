@@ -24,6 +24,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from p2pfl_tpu.learning.dataset.dataset import FederatedDataset
+from p2pfl_tpu.learning.dataset.export_strategies import TensorFlowExportStrategy
 from p2pfl_tpu.learning.interop.wire import CanonicalWireMixin
 from p2pfl_tpu.learning.learner import Learner, LearnerFactory
 from p2pfl_tpu.models.model_handle import ModelHandle
@@ -199,24 +200,27 @@ class KerasLearner(Learner):
         for epoch in range(self.epochs):
             if self._interrupt.is_set():
                 break
-            # Tuple seed = SeedSequence hash: collision-free across (fit,
-            # epoch), matching JaxLearner's fold_in-derived streams.
-            xb, yb, wb = self.get_data().export_batches(
-                self.batch_size, train=True, seed=(self.seed, fit_idx, epoch)
+            # Native batching (reference keras_dataset.py:29-69): a seeded
+            # tf.data pipeline, ragged final batch and all — no padding
+            # masks. Tuple seed = SeedSequence hash: collision-free across
+            # (fit, epoch), matching JaxLearner's fold_in-derived streams.
+            ds = self.get_data().export(
+                TensorFlowExportStrategy,
+                train=True,
+                batch_size=self.batch_size,
+                seed=(self.seed, fit_idx, epoch),
             )
             losses = []
-            for x, y, w in zip(xb, yb, wb):
-                xt = tf.constant(np.asarray(x, np.float32))
-                yt = tf.constant(np.asarray(y, np.int32))
-                wt = tf.constant(np.asarray(w, np.float32))
+            for xt, yt in ds:
+                if self._interrupt.is_set():
+                    break
+                yt = tf.cast(yt, tf.int32)
                 with tf.GradientTape() as tape:
                     logits = km(xt, training=True)
                     per = tf.nn.sparse_softmax_cross_entropy_with_logits(
                         labels=yt, logits=logits
                     )
-                    loss = tf.reduce_sum(per * wt) / tf.maximum(
-                        tf.reduce_sum(wt), 1.0
-                    )
+                    loss = tf.reduce_mean(per)
                 grads = tape.gradient(loss, km.trainable_variables)
                 if self._scaffold:
                     grads = [
@@ -226,7 +230,8 @@ class KerasLearner(Learner):
                 opt.apply_gradients(zip(grads, km.trainable_variables))
                 losses.append(float(loss))
                 total_steps += 1
-            self.report("train_loss", float(np.mean(losses)), step=epoch)
+            if losses:  # interrupt can land before the first batch
+                self.report("train_loss", float(np.mean(losses)), step=epoch)
 
         model.pull_from_model()
         model.set_contribution([self._self_addr], self.get_data().get_num_samples(True))
@@ -253,24 +258,23 @@ class KerasLearner(Learner):
     def evaluate(self) -> Dict[str, float]:
         model = self._handle()
         try:
-            xb, yb, wb = self.get_data().export_batches(
-                self.batch_size, train=False, seed=0
+            ds = self.get_data().export(
+                TensorFlowExportStrategy, train=False, batch_size=self.batch_size
             )
         except KeyError:
             return {}
         model._load()
         km = model.keras_model
         tot_loss = tot_correct = tot_n = 0.0
-        for x, y, w in zip(xb, yb, wb):
-            logits = np.asarray(km(np.asarray(x, np.float32), training=False))
-            yt = np.asarray(y, np.int64)
-            wt = np.asarray(w, np.float32)
+        for xt, yt in ds:
+            logits = np.asarray(km(xt, training=False))
+            y = np.asarray(yt, np.int64)
             logp = logits - logits.max(-1, keepdims=True)
             logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
-            per = -logp[np.arange(len(yt)), yt]
-            tot_loss += float((per * wt).sum())
-            tot_correct += float(((logits.argmax(-1) == yt) * wt).sum())
-            tot_n += float(wt.sum())
+            per = -logp[np.arange(len(y)), y]
+            tot_loss += float(per.sum())
+            tot_correct += float((logits.argmax(-1) == y).sum())
+            tot_n += float(len(y))
         tot_n = max(tot_n, 1.0)
         metrics = {"test_loss": tot_loss / tot_n, "test_acc": tot_correct / tot_n}
         for k, v in metrics.items():

@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from p2pfl_tpu.learning.dataset.dataset import FederatedDataset
+from p2pfl_tpu.learning.dataset.export_strategies import TorchExportStrategy
 from p2pfl_tpu.learning.interop.wire import CanonicalWireMixin
 from p2pfl_tpu.learning.learner import Learner, LearnerFactory
 from p2pfl_tpu.models.model_handle import ModelHandle
@@ -226,18 +227,23 @@ class TorchLearner(Learner):
         for epoch in range(self.epochs):
             if self._interrupt.is_set():
                 break
-            # Tuple seed = SeedSequence hash: collision-free across (fit,
-            # epoch), matching JaxLearner's fold_in-derived streams.
-            xb, yb, wb = self.get_data().export_batches(
-                self.batch_size, train=True, seed=(self.seed, fit_idx, epoch)
+            # Native batching (reference lightning_dataset.py:29-69):
+            # a seeded DataLoader, ragged final batch and all — no padding
+            # masks. Tuple seed = SeedSequence hash: collision-free across
+            # (fit, epoch), matching JaxLearner's fold_in-derived streams.
+            loader = self.get_data().export(
+                TorchExportStrategy,
+                train=True,
+                batch_size=self.batch_size,
+                seed=(self.seed, fit_idx, epoch),
             )
             losses = []
-            for x, y, w in zip(xb, yb, wb):
+            for xt, yt in loader:
+                if self._interrupt.is_set():
+                    break
                 opt.zero_grad()
-                logits = module(torch.from_numpy(np.asarray(x, np.float32)))
-                per = loss_fn(logits, torch.from_numpy(np.asarray(y, np.int64)))
-                wt = torch.from_numpy(np.asarray(w, np.float32))
-                loss = (per * wt).sum() / wt.sum().clamp(min=1.0)
+                per = loss_fn(module(xt), yt)
+                loss = per.mean()
                 loss.backward()
                 if self._scaffold:  # drift correction: g + c - c_i
                     for name, p in module.named_parameters():
@@ -246,7 +252,8 @@ class TorchLearner(Learner):
                 opt.step()
                 losses.append(loss.item())
                 total_steps += 1
-            self.report("train_loss", float(np.mean(losses)), step=epoch)
+            if losses:  # interrupt can land before the first batch
+                self.report("train_loss", float(np.mean(losses)), step=epoch)
 
         model.pull_from_module()
         model.set_contribution([self._self_addr], self.get_data().get_num_samples(True))
@@ -281,8 +288,8 @@ class TorchLearner(Learner):
     def evaluate(self) -> Dict[str, float]:
         model = self._handle()
         try:
-            xb, yb, wb = self.get_data().export_batches(
-                self.batch_size, train=False, seed=0
+            loader = self.get_data().export(
+                TorchExportStrategy, train=False, batch_size=self.batch_size
             )
         except KeyError:
             return {}
@@ -292,14 +299,12 @@ class TorchLearner(Learner):
         loss_fn = nn.CrossEntropyLoss(reduction="none")
         tot_loss = tot_correct = tot_n = 0.0
         with torch.no_grad():
-            for x, y, w in zip(xb, yb, wb):
-                logits = module(torch.from_numpy(np.asarray(x, np.float32)))
-                yt = torch.from_numpy(np.asarray(y, np.int64))
-                wt = torch.from_numpy(np.asarray(w, np.float32))
+            for xt, yt in loader:
+                logits = module(xt)
                 per = loss_fn(logits, yt)
-                tot_loss += float((per * wt).sum())
-                tot_correct += float(((logits.argmax(-1) == yt).float() * wt).sum())
-                tot_n += float(wt.sum())
+                tot_loss += float(per.sum())
+                tot_correct += float((logits.argmax(-1) == yt).float().sum())
+                tot_n += float(yt.numel())
         tot_n = max(tot_n, 1.0)
         metrics = {"test_loss": tot_loss / tot_n, "test_acc": tot_correct / tot_n}
         for k, v in metrics.items():

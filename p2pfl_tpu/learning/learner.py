@@ -223,6 +223,15 @@ class JaxLearner(Learner):
             observer regenerate and subtract the noise. Pinning an int is
             an explicit reproducibility opt-in; with DP enabled it voids
             the privacy claim against any adversary who learns the seed.
+        interrupt_every: check ``interrupt_fit`` every this many STEPS by
+            chunking the epoch's ``lax.scan`` into segments (at most two
+            distinct segment lengths compile). Default ``None`` keeps the
+            whole epoch as one compiled call and checks only between
+            epochs — the torch path's per-batch granularity (reference
+            lightning ``trainer.should_stop``,
+            pytorch/lightning_learner.py:98-137) costs nothing there but
+            would fragment the jitted scan here, so mid-epoch checks are
+            opt-in.
     """
 
     SUPPORTED_CALLBACKS = ("scaffold",)
@@ -240,8 +249,12 @@ class JaxLearner(Learner):
         dp_noise_multiplier: float = 0.0,
         seed: Optional[int] = None,
         callbacks: Optional[List[str]] = None,
+        interrupt_every: Optional[int] = None,
     ) -> None:
         super().__init__(model, data, self_addr)
+        if interrupt_every is not None and interrupt_every < 1:
+            raise ValueError(f"interrupt_every must be >= 1, got {interrupt_every}")
+        self.interrupt_every = interrupt_every
         self.lr = float(lr)
         self.optimizer = optimizer if optimizer is not None else optax.adam(self.lr)
         self.batch_size = int(batch_size)
@@ -419,30 +432,45 @@ class JaxLearner(Learner):
             xb, yb, wb = self.get_data().export_batches(
                 self.batch_size, train=True, seed=(self.seed, fit_idx, epoch)
             )
-            params, opt_state, loss = self._train_epoch(
-                params,
-                opt_state,
-                jnp.asarray(xb),
-                jnp.asarray(yb),
-                jnp.asarray(wb),
-                anchor,
-                c_global,
-                c_local,
-                # Fold the node identity in: nodes sharing a pinned seed
-                # must not inject identical (coherent, recomputable) DP noise.
-                jax.random.fold_in(
-                    jax.random.fold_in(fit_key, epoch),
-                    zlib.crc32(self._self_addr.encode()),
-                ),
-                apply_fn=model.apply_fn,
-                optimizer=self.optimizer,
-                fedprox_mu=self.fedprox_mu,
-                use_scaffold=self._scaffold,
-                dp_clip_norm=self.dp_clip_norm,
-                dp_noise_multiplier=self.dp_noise_multiplier,
+            # Fold the node identity in: nodes sharing a pinned seed
+            # must not inject identical (coherent, recomputable) DP noise.
+            epoch_key = jax.random.fold_in(
+                jax.random.fold_in(fit_key, epoch),
+                zlib.crc32(self._self_addr.encode()),
             )
-            total_steps += xb.shape[0]
-            last_loss = float(loss)
+            steps = xb.shape[0]
+            # Segment the epoch scan for mid-epoch interrupt checks. Segment
+            # boundaries fall on `interrupt_every` multiples, so at most two
+            # program shapes compile (full segment + one ragged tail).
+            seg = self.interrupt_every or steps
+            xb, yb, wb = jnp.asarray(xb), jnp.asarray(yb), jnp.asarray(wb)
+            seg_losses = []
+            for start in range(0, steps, seg):
+                if start > 0 and self._interrupt.is_set():
+                    break
+                stop = min(start + seg, steps)
+                params, opt_state, loss = self._train_epoch(
+                    params,
+                    opt_state,
+                    xb[start:stop],
+                    yb[start:stop],
+                    wb[start:stop],
+                    anchor,
+                    c_global,
+                    c_local,
+                    jax.random.fold_in(epoch_key, start),
+                    apply_fn=model.apply_fn,
+                    optimizer=self.optimizer,
+                    fedprox_mu=self.fedprox_mu,
+                    use_scaffold=self._scaffold,
+                    dp_clip_norm=self.dp_clip_norm,
+                    dp_noise_multiplier=self.dp_noise_multiplier,
+                )
+                total_steps += stop - start
+                seg_losses.append((stop - start, float(loss)))
+            last_loss = sum(n * l for n, l in seg_losses) / max(
+                sum(n for n, _ in seg_losses), 1
+            )
             self.report("train_loss", last_loss, step=epoch)
 
         self._opt_state = opt_state

@@ -228,6 +228,22 @@ class MeshSimulation:
         # explicit out_shardings), never on host — with a tunneled or remote
         # accelerator the naive host-side np.broadcast_to + upload dominates
         # startup by minutes.
+        if self.num_nodes % self.mesh.shape["nodes"] != 0:
+            # Loud, not silent: every stacked buffer (params, opt state,
+            # data) falls back to replication, multiplying HBM use by the
+            # node-axis size and serializing the population loop.
+            import warnings
+
+            warnings.warn(
+                f"population size {self.num_nodes} is not divisible by the "
+                f"mesh 'nodes' axis ({self.mesh.shape['nodes']}): stacked "
+                "population buffers will be REPLICATED on every device "
+                "instead of sharded. Pad the population to a multiple of "
+                "the node axis (empty partitions are fine under fedavg — "
+                "sample-count weighting zeroes them) or resize the mesh.",
+                stacklevel=3,
+            )
+
         def stacked_spec(x) -> P:
             spec = [None] * (x.ndim + 1)
             if self.num_nodes % self.mesh.shape["nodes"] == 0:
@@ -406,7 +422,7 @@ class MeshSimulation:
         (params, opt_state), losses = jax.lax.scan(epoch, (params, opt_state), ekeys)
         return params, opt_state, jnp.mean(losses)
 
-    def _round_body(self, carry, key: jax.Array, data, epochs: int):
+    def _round_body(self, carry, key: jax.Array, do_eval: jax.Array, data, epochs: int):
         params_stack, opt_stack, c_stack, c_global = carry
         x, y, sample_mask, num_samples, xt, yt = data
         kv, kt = jax.random.split(key)
@@ -465,19 +481,38 @@ class MeshSimulation:
         )
         opt_stack = jax.tree.map(lambda a, u: a.at[committee].set(u), opt_stack, o_k)
 
-        # Evaluate the aggregated model on the shared test split.
+        # Evaluate the aggregated model on the shared test split — under a
+        # runtime lax.cond so rounds with ``do_eval == False`` skip the eval
+        # FLOPs and test-split HBM reads entirely (``eval_every`` in run()).
         if xt is not None and self.task == "lm":
-            logits = self.apply_fn(agg, xt)  # [T, L, V]
-            loss = masked_lm_loss(logits, xt, jnp.ones(xt.shape[0], jnp.float32))
-            pred = jnp.argmax(logits[:, :-1], axis=-1)
-            acc = jnp.mean((pred == xt[:, 1:]).astype(jnp.float32))
+
+            def _eval(_):
+                logits = self.apply_fn(agg, xt)  # [T, L, V]
+                loss = masked_lm_loss(logits, xt, jnp.ones(xt.shape[0], jnp.float32))
+                pred = jnp.argmax(logits[:, :-1], axis=-1)
+                acc = jnp.mean((pred == xt[:, 1:]).astype(jnp.float32))
+                return loss, acc
+
         elif xt is not None:
-            logits = self.apply_fn(agg, xt)
-            loss = softmax_cross_entropy(logits, yt, jnp.ones_like(yt, jnp.float32))
-            acc = jnp.mean((jnp.argmax(logits, -1) == yt).astype(jnp.float32))
+
+            def _eval(_):
+                logits = self.apply_fn(agg, xt)
+                loss = softmax_cross_entropy(logits, yt, jnp.ones_like(yt, jnp.float32))
+                acc = jnp.mean((jnp.argmax(logits, -1) == yt).astype(jnp.float32))
+                return loss, acc
+
         else:
+            _eval = None
+        if _eval is None:
             loss = jnp.float32(0)
             acc = jnp.float32(0)
+        else:
+            loss, acc = jax.lax.cond(
+                do_eval,
+                _eval,
+                lambda _: (jnp.float32(jnp.nan), jnp.float32(jnp.nan)),
+                operand=None,
+            )
         return (
             (params_stack, opt_stack, c_stack, c_global),
             (committee, losses.mean(), loss, acc),
@@ -485,22 +520,26 @@ class MeshSimulation:
 
     @partial(
         jax.jit,
-        static_argnames=("self", "rounds", "epochs"),
+        static_argnames=("self", "rounds", "epochs", "eval_every"),
         donate_argnames=("params_stack", "opt_stack", "c_stack", "c_global"),
     )
     def _run_jit(
         self, params_stack, opt_stack, c_stack, c_global, data, start_round,
-        *, rounds: int, epochs: int,
+        final_round, *, rounds: int, epochs: int, eval_every: int = 1,
     ):
         # Per-round keys are position-independent (fold_in on the absolute
         # round index): chunking and checkpoint-resume replay identically.
         base = jax.random.key(self.seed)
-        keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
-            start_round + jnp.arange(rounds)
-        )
+        idx = start_round + jnp.arange(rounds)
+        keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(idx)
+        # Eval cadence on ABSOLUTE round indices (chunk-invariant), plus the
+        # final round unconditionally so final_test_acc always exists.
+        do_eval = ((idx + 1) % eval_every == 0) | (idx == final_round)
         carry = (params_stack, opt_stack, c_stack, c_global)
         carry, (committees, train_loss, test_loss, test_acc) = jax.lax.scan(
-            lambda c, k: self._round_body(c, k, data, epochs), carry, keys
+            lambda c, ke: self._round_body(c, ke[0], ke[1], data, epochs),
+            carry,
+            (keys, do_eval),
         )
         params_stack, opt_stack, c_stack, c_global = carry
         return params_stack, opt_stack, c_stack, c_global, committees, train_loss, test_loss, test_acc
@@ -515,6 +554,7 @@ class MeshSimulation:
         rounds_per_call: int = 1,
         checkpointer=None,
         checkpoint_every: int = 1,
+        eval_every: int = 1,
     ) -> SimulationResult:
         """Execute ``rounds`` federated rounds on the mesh.
 
@@ -531,6 +571,12 @@ class MeshSimulation:
         FLCheckpointer`), population state is snapshotted every
         ``checkpoint_every`` completed chunks; a later ``load_from`` +
         ``run`` resumes bit-identically (round keys are absolute-indexed).
+
+        ``eval_every=k`` evaluates the aggregated model only every k-th
+        round (absolute index; the final round always evaluates) — on large
+        test splits or deep models the per-round eval pass is pure overhead
+        for throughput runs. ``SimulationResult.test_acc`` then holds only
+        the evaluated rounds.
         """
         xt = jnp.asarray(self.x_test) if self.x_test is not None else None
         yt = jnp.asarray(self.y_test) if self.y_test is not None else None
@@ -558,7 +604,8 @@ class MeshSimulation:
             )
             out = self._run_jit(
                 wp, wo, wc, wcg, data, jnp.int32(start + rounds + 1),
-                rounds=chunks[0], epochs=epochs,
+                jnp.int32(start + rounds + chunks[0]),
+                rounds=chunks[0], epochs=epochs, eval_every=eval_every,
             )
             jax.block_until_ready(out[0])
             # Force true retirement (see the matching fetch after the timed
@@ -575,7 +622,8 @@ class MeshSimulation:
             for i, chunk in enumerate(chunks):
                 params_stack, opt_stack, c_stack, c_global, comm, _tr, tl, ta = self._run_jit(
                     params_stack, opt_stack, c_stack, c_global,
-                    data, jnp.int32(start + done), rounds=chunk, epochs=epochs,
+                    data, jnp.int32(start + done), jnp.int32(start + rounds - 1),
+                    rounds=chunk, epochs=epochs, eval_every=eval_every,
                 )
                 committees.append(comm)
                 test_loss.append(tl)
@@ -628,12 +676,17 @@ class MeshSimulation:
         self.params_stack, self.opt_stack = params_stack, opt_stack
         self.c_stack, self.c_global = c_stack, c_global
         self.completed_rounds = start + total_rounds
+        # Rounds skipped by eval_every carry NaN sentinels — drop them so
+        # test_acc[-1] is always the final round's real evaluation.
+        acc_all = np.concatenate([np.asarray(t) for t in test_acc])
+        loss_all = np.concatenate([np.asarray(t) for t in test_loss])
+        evaluated = ~np.isnan(acc_all)
         return SimulationResult(
             rounds=total_rounds,
             seconds_total=dt,
             seconds_per_round=dt / total_rounds,
-            test_acc=[float(a) for a in np.concatenate([np.asarray(t) for t in test_acc])],
-            test_loss=[float(l) for l in np.concatenate([np.asarray(t) for t in test_loss])],
+            test_acc=[float(a) for a in acc_all[evaluated]],
+            test_loss=[float(l) for l in loss_all[evaluated]],
             committees=np.concatenate([np.asarray(c) for c in committees]),
         )
 

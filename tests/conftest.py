@@ -23,6 +23,15 @@ import jax  # noqa: E402
 # at conftest-import time, so this is safe.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache (verified to work on the CPU backend):
+# heavy programs — the ResNet-18 federated round compiles ~14 min on this
+# 1-core box — are compiled once and reloaded on every later suite run.
+# Only slow compiles are persisted so the cache stays small.
+jax.config.update(
+    "jax_compilation_cache_dir", os.path.join(os.path.dirname(__file__), ".jax_cache")
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
 import pytest  # noqa: E402
 
 

@@ -16,6 +16,10 @@ from p2pfl_tpu.management.checkpoint import FLCheckpointer, attach_node_checkpoi
 from p2pfl_tpu.models import mlp_model
 from p2pfl_tpu.parallel.simulation import MeshSimulation
 
+# resume tests run multi-round sims repeatedly -> excluded from the fast subset
+pytestmark = pytest.mark.slow
+
+
 
 @pytest.fixture
 def parts8():

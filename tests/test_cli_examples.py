@@ -66,7 +66,16 @@ def test_mnist_example_mesh_mode():
     assert res["sec_per_round"] > 0
 
 
-@pytest.mark.parametrize("aggregator", ["fedavg", "fedmedian", "scaffold", "krum", "trimmed_mean"])
+@pytest.mark.parametrize(
+    "aggregator",
+    [
+        "fedavg",  # one aggregator stays in the fast subset as the smoke path
+        pytest.param("fedmedian", marks=pytest.mark.slow),
+        pytest.param("scaffold", marks=pytest.mark.slow),
+        pytest.param("krum", marks=pytest.mark.slow),
+        pytest.param("trimmed_mean", marks=pytest.mark.slow),
+    ],
+)
 def test_mnist_example_nodes_mode(aggregator):
     args = mnist_parser().parse_args(
         [

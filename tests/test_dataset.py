@@ -188,3 +188,118 @@ def test_vision_fast_path_respects_transforms_and_empty():
 
     with pytest.raises(ValueError, match="empty"):
         vision_pairs_to_arrays(Empty())
+
+
+# --- export strategies (reference p2pfl_dataset.py:224-248) -------------------
+
+
+def test_export_numpy_and_batched_strategies_match_legacy():
+    from p2pfl_tpu.learning.dataset import (
+        BatchedArraysExportStrategy,
+        NumpyExportStrategy,
+        synthetic_mnist,
+    )
+
+    ds = synthetic_mnist(n_train=130, n_test=32)
+    x, y = ds.export(NumpyExportStrategy)
+    assert x.shape == (130, 28, 28) and y.shape == (130,)
+
+    xb, yb, wb = ds.export(BatchedArraysExportStrategy, batch_size=64, seed=5)
+    xb2, yb2, wb2 = ds.export_batches(64, train=True, seed=5)
+    np.testing.assert_array_equal(xb, xb2)
+    np.testing.assert_array_equal(yb, yb2)
+    np.testing.assert_array_equal(wb, wb2)
+    assert xb.shape == (3, 64, 28, 28) and wb[-1].sum() == 130 - 2 * 64
+
+    # drop_remainder slices the ragged tail instead of padding it
+    xb3, _, wb3 = ds.export(
+        BatchedArraysExportStrategy, batch_size=64, drop_remainder=True
+    )
+    assert xb3.shape == (2, 64, 28, 28) and wb3.sum() == 128
+
+
+def test_export_torch_dataloader_roundtrip():
+    import torch
+
+    from p2pfl_tpu.learning.dataset import TorchExportStrategy, synthetic_mnist
+
+    ds = synthetic_mnist(n_train=100, n_test=16)
+    loader = ds.export(TorchExportStrategy, batch_size=32, seed=(1, 2, 3))
+    batches = list(loader)
+    assert sum(len(b[1]) for b in batches) == 100  # ragged tail kept
+    assert batches[0][0].dtype == torch.float32
+    assert batches[0][1].dtype == torch.int64
+    assert batches[0][0].shape == (32, 28, 28)
+
+    # seeded: same tuple seed -> same order; different seed -> different
+    a = torch.cat([b[1] for b in ds.export(TorchExportStrategy, batch_size=32, seed=(1, 2, 3))])
+    b = torch.cat([b[1] for b in ds.export(TorchExportStrategy, batch_size=32, seed=(1, 2, 3))])
+    c = torch.cat([b[1] for b in ds.export(TorchExportStrategy, batch_size=32, seed=(9, 9, 9))])
+    assert torch.equal(a, b)
+    assert not torch.equal(a, c)
+
+
+def test_export_tf_data_roundtrip():
+    pytest.importorskip("tensorflow")
+    import numpy as _np
+
+    from p2pfl_tpu.learning.dataset import TensorFlowExportStrategy, synthetic_mnist
+
+    ds = synthetic_mnist(n_train=100, n_test=16)
+    tfds = ds.export(TensorFlowExportStrategy, batch_size=32, seed=(4, 5))
+    batches = [( _np.asarray(x), _np.asarray(y)) for x, y in tfds]
+    assert sum(len(y) for _, y in batches) == 100
+    assert batches[0][0].shape == (32, 28, 28)
+    # eval export is un-shuffled and label-complete
+    te = ds.export(TensorFlowExportStrategy, train=False, batch_size=7)
+    ys = _np.concatenate([_np.asarray(y) for _, y in te])
+    _, y_test = ds.export_arrays(train=False)
+    np.testing.assert_array_equal(ys, y_test)
+
+
+# --- byzantine poisoning ------------------------------------------------------
+
+
+def test_poison_partitions_label_flip():
+    from p2pfl_tpu.learning.dataset import (
+        RandomIIDPartitionStrategy,
+        poison_partitions,
+        synthetic_mnist,
+    )
+
+    parts = synthetic_mnist(n_train=200, n_test=32).generate_partitions(
+        10, RandomIIDPartitionStrategy
+    )
+    poisoned_parts, idx = poison_partitions(parts, 0.2, num_classes=10, seed=1)
+    assert len(idx) == 2
+    for i, (orig, pois) in enumerate(zip(parts, poisoned_parts)):
+        xo, yo = orig.export_arrays(True)
+        xp, yp = pois.export_arrays(True)
+        np.testing.assert_array_equal(xo, xp)  # inputs untouched
+        if i in idx:
+            np.testing.assert_array_equal(yp, (yo + 1) % 10)
+            # test split stays clean: evaluation measures true accuracy
+            _, yt_o = orig.export_arrays(False)
+            _, yt_p = pois.export_arrays(False)
+            np.testing.assert_array_equal(yt_o, yt_p)
+        else:
+            assert pois is orig
+
+
+def test_synthetic_cifar10_shape_and_learnability_proxy():
+    from p2pfl_tpu.learning.dataset import synthetic_cifar10
+
+    ds = synthetic_cifar10(n_train=64, n_test=32, image_size=16)
+    x, y = ds.export_arrays(True)
+    assert x.shape == (64, 16, 16, 3) and x.dtype == np.float32
+    assert x.min() >= 0.0 and x.max() <= 1.0
+    # Learnability: a sample sits closer to its OWN class mean than to other
+    # class means (the class template structure survives the noise).
+    means = np.stack([x[y == c].mean(axis=0) for c in np.unique(y)])
+    classes = list(np.unique(y))
+    d = np.sqrt(((x[:, None] - means[None]) ** 2).sum(axis=(2, 3, 4)))  # [n, C]
+    nearest = np.array(classes)[np.argmin(d, axis=1)]
+    assert (nearest == y).mean() > 0.9, (nearest == y).mean()
+    ds2 = synthetic_cifar10(n_train=64, n_test=32, image_size=16)
+    x2, y2 = ds2.export_arrays(True)
+    np.testing.assert_array_equal(y, y2)  # deterministic

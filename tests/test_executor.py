@@ -15,6 +15,10 @@ from p2pfl_tpu.learning.learner import JaxLearner, Learner
 from p2pfl_tpu.models import mlp_model
 from p2pfl_tpu.parallel.executor import LearnerExecutor, VirtualNodeLearner
 
+# 20-node federation + pool crash scenarios -> excluded from the fast subset
+pytestmark = pytest.mark.slow
+
+
 
 class SlowLearner(Learner):
     """Test double: fit sleeps; records concurrency."""

@@ -19,6 +19,10 @@ from p2pfl_tpu.learning.interop import (
 from p2pfl_tpu.learning.learner import JaxLearner, LearnerFactory
 from p2pfl_tpu.models import mlp_model
 
+# torch learners train real epochs -> excluded from the fast subset
+pytestmark = pytest.mark.slow
+
+
 
 def test_handle_roundtrip_and_shape_check():
     m = torch_mlp_model(seed=0)

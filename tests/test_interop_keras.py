@@ -25,6 +25,10 @@ from p2pfl_tpu.learning.interop import (
 from p2pfl_tpu.learning.learner import LearnerFactory
 from p2pfl_tpu.models import mlp_model
 
+# keras learners train real epochs -> excluded from the fast subset
+pytestmark = pytest.mark.slow
+
+
 
 def test_keras_handle_roundtrip_and_shape_check():
     m = keras_mlp_model(seed=0)

@@ -2,6 +2,7 @@
 test/learning/frameworks_test.py:322-385) plus scaffold/fedprox specifics."""
 
 import numpy as np
+import pytest
 
 from p2pfl_tpu.learning.dataset import synthetic_mnist
 from p2pfl_tpu.learning.learner import JaxLearner, LearnerFactory
@@ -14,6 +15,7 @@ def _learner(**kw):
     return JaxLearner(model=model, data=data, self_addr="n0", batch_size=64, **kw)
 
 
+@pytest.mark.slow
 def test_fit_improves_accuracy():
     lrn = _learner(lr=3e-3)
     lrn.set_epochs(2)
@@ -40,6 +42,7 @@ def test_interrupt_before_fit_skips_training():
     assert any(np.abs(a - b).max() > 0 for a, b in zip(before, p_after_first))
 
 
+@pytest.mark.slow
 def test_scaffold_callback_produces_deltas():
     lrn = _learner(callbacks=["scaffold"])
     lrn.set_epochs(1)
@@ -53,6 +56,7 @@ def test_scaffold_callback_produces_deltas():
     assert any(np.abs(d).max() > 0 for d in info["delta_y_i"])
 
 
+@pytest.mark.slow
 def test_fedprox_keeps_params_closer_to_anchor():
     lrn_plain = _learner(lr=1e-2, seed=7)
     lrn_prox = _learner(lr=1e-2, fedprox_mu=1.0, seed=7)
@@ -124,6 +128,7 @@ def test_callback_registry_hooks_and_errors():
     assert "recorder" in CallbackFactory.registered("jax")
 
 
+@pytest.mark.slow
 def test_cnn_learner_convergence():
     """CNN model family trains through the jitted learner (BASELINE.json
     config #2's model leg; the sim-mode leg uses the MLP because bf16 convs
@@ -143,6 +148,7 @@ def test_cnn_learner_convergence():
 # --- DP-SGD (no reference analogue) ------------------------------------------
 
 
+@pytest.mark.slow
 def test_dp_grads_matches_plain_mean_when_unclipped():
     """With a huge clip bound and zero noise, the DP estimate equals the
     plain masked mean gradient."""
@@ -173,6 +179,7 @@ def test_dp_grads_matches_plain_mean_when_unclipped():
         np.testing.assert_allclose(np.asarray(g), np.asarray(r), rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_dp_grads_clips_per_example_norm():
     """With clip C and no noise, the mean gradient's norm is <= C (each
     example contributes at most C / B)."""
@@ -200,6 +207,7 @@ def test_dp_grads_clips_per_example_norm():
     assert total <= clip + 1e-6
 
 
+@pytest.mark.slow
 def test_dp_learner_still_learns():
     """DP-SGD with a moderate clip and noise still reaches >0.5 accuracy on
     the synthetic MNIST (privacy costs accuracy, not learnability)."""
@@ -232,6 +240,7 @@ def test_dp_noise_without_clip_rejected():
         )
 
 
+@pytest.mark.slow
 def test_dp_noise_differs_across_nodes_with_same_seed():
     """Two nodes with identical seeds must not inject identical DP noise
     (the node address is folded into the noise key)."""
@@ -268,6 +277,7 @@ def test_privacy_accountant_closed_form_and_monotonicity():
     assert gaussian_rdp_epsilon(1.0, 0, 1e-5) == 0.0
 
 
+@pytest.mark.slow
 def test_dp_learner_reports_privacy_spent():
     data = synthetic_mnist(n_train=128, n_test=32)
     learner = JaxLearner(
@@ -291,6 +301,7 @@ def test_dp_learner_reports_privacy_spent():
     assert learner.privacy_spent()["epsilon"] > info["epsilon"]
 
 
+@pytest.mark.slow
 def test_privacy_spent_is_inf_after_nonprivate_training():
     """A model trained without DP must never read as epsilon=0 — any
     non-private step voids the claim."""
@@ -302,3 +313,43 @@ def test_privacy_spent_is_inf_after_nonprivate_training():
     spent = learner.privacy_spent()
     assert spent["epsilon"] == float("inf")
     assert spent["nonprivate_steps"] > 0
+
+
+def test_interrupt_fit_lands_mid_epoch(monkeypatch):
+    """With interrupt_every=k the epoch scan is segmented and an interrupt
+    raised during segment 1 stops before segment 2 — the reference torch
+    path's per-batch ``should_stop`` granularity (lightning_learner.py:98-137)
+    on the jitted path."""
+    lrn = _learner(interrupt_every=2, seed=0)
+    lrn.set_epochs(1)  # 512/64 = 8 steps -> 4 segments of 2
+    calls = []
+    orig = JaxLearner._train_epoch
+
+    def spy(*args, **kw):
+        calls.append(1)
+        lrn.interrupt_fit()  # fires while the segment is "running"
+        return orig(*args, **kw)
+
+    monkeypatch.setattr(JaxLearner, "_train_epoch", staticmethod(spy))
+    lrn.fit()
+    assert len(calls) == 1  # stopped after the first 2-step segment
+
+
+def test_interrupt_every_full_epoch_unsegmented(monkeypatch):
+    lrn = _learner(seed=0)  # default: one compiled call per epoch
+    lrn.set_epochs(1)
+    calls = []
+    orig = JaxLearner._train_epoch
+
+    def spy(*args, **kw):
+        calls.append(args[2].shape[0])
+        return orig(*args, **kw)
+
+    monkeypatch.setattr(JaxLearner, "_train_epoch", staticmethod(spy))
+    lrn.fit()
+    assert calls == [8]  # 512/64 steps in a single scan
+
+
+def test_interrupt_every_validation():
+    with pytest.raises(ValueError, match="interrupt_every"):
+        _learner(interrupt_every=0)

@@ -23,6 +23,7 @@ def test_cnn_forward_shape():
     assert m.apply_fn(m.params, x).shape == (2, 10)
 
 
+@pytest.mark.slow
 def test_resnet_forward_shape():
     m = resnet18_model(seed=0)
     x = np.zeros((2, 32, 32, 3), np.float32)

@@ -13,6 +13,10 @@ from p2pfl_tpu.models.moe import (
     moe_lm_model,
     shard_moe_params,
 )
+import pytest
+
+# expert-parallel programs compile ~5-20s each on the 1-core CPU mesh -> excluded from the fast subset
+pytestmark = pytest.mark.slow
 
 
 def test_moe_mlp_matches_per_token_reference():

@@ -7,8 +7,11 @@ import os
 import socket
 import subprocess
 import sys
-
 import pytest
+
+# spawns a 2-process jax.distributed mesh -> excluded from the fast subset
+pytestmark = pytest.mark.slow
+
 
 
 def _free_port() -> int:

@@ -8,6 +8,8 @@ import time
 
 import pytest
 
+from p2pfl_tpu.comm.grpc import GrpcCommunicationProtocol
+from p2pfl_tpu.comm.memory.memory_protocol import InMemoryCommunicationProtocol
 from p2pfl_tpu.config import Settings
 from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
 from p2pfl_tpu.management.logger import logger
@@ -15,11 +17,22 @@ from p2pfl_tpu.models import mlp_model
 from p2pfl_tpu.node import Node
 from p2pfl_tpu.utils.utils import check_equal_models, wait_convergence
 
+# The heavy scenarios run over BOTH transports (reference runs its whole e2e
+# matrix over each protocol, node_test.py:79); "memory" is the in-process
+# registry, "grpc" real localhost sockets.
+PROTOCOLS = {
+    "memory": InMemoryCommunicationProtocol,
+    "grpc": GrpcCommunicationProtocol,
+}
 
-def _spawn(n, batch_size=32):
+
+def _spawn(n, batch_size=32, protocol=None, **node_kw):
     data = synthetic_mnist(n_train=256 * n, n_test=128)
     parts = data.generate_partitions(n, RandomIIDPartitionStrategy)
-    nodes = [Node(mlp_model(seed=i), parts[i], batch_size=batch_size) for i in range(n)]
+    kw = dict(batch_size=batch_size, **node_kw)
+    if protocol is not None:
+        kw["protocol"] = PROTOCOLS[protocol]
+    nodes = [Node(mlp_model(seed=i), parts[i], **kw) for i in range(n)]
     for node in nodes:
         node.start()
     return nodes
@@ -87,9 +100,12 @@ def test_e2e_convergence_small(n_nodes, rounds):
             node.stop()
 
 
+@pytest.mark.slow
 def test_e2e_line_topology_with_non_trainers():
-    """6 nodes, line connection, committee of 4 — some nodes must take the
-    WaitAggregatedModelsStage path and still converge (reference 6x3 case)."""
+    """4 nodes, line connection, committee of 2 — some nodes must take the
+    WaitAggregatedModelsStage path and still converge (fast variant of the
+    reference 6x3 case; the full shape runs in
+    test_e2e_six_node_line_three_rounds)."""
     Settings.RESOURCE_MONITOR_PERIOD = 0
     n_nodes, rounds = 4, 2
     with Settings.overridden(TRAIN_SET_SIZE=2):
@@ -110,6 +126,42 @@ def test_e2e_line_topology_with_non_trainers():
                 node.stop()
 
 
+@pytest.mark.slow
+def test_e2e_six_node_line_three_rounds():
+    """The reference's heavy parity case for real: 6 nodes in a line,
+    committee of 4, 3 rounds (node_test.py's 6x3 matrix point). Non-trainers
+    take WaitAggregatedModelsStage in every round, gossip crosses multi-hop
+    non-direct neighbors, and all six models converge equal."""
+    Settings.RESOURCE_MONITOR_PERIOD = 0
+    n_nodes, rounds = 6, 3
+    with Settings.overridden(TRAIN_SET_SIZE=4):
+        nodes = _spawn(n_nodes)
+        try:
+            for i in range(1, n_nodes):
+                nodes[i].connect(nodes[i - 1].addr)
+            wait_convergence(nodes, n_nodes - 1, wait=10)
+            nodes[0].set_start_learning(rounds=rounds, epochs=1)
+            _wait_finished(nodes, timeout=240)  # reference budget (:105)
+            waiters = sum(
+                "WaitAggregatedModelsStage" in n.learning_workflow.history
+                for n in nodes
+            )
+            assert waiters >= 1
+            for n in nodes:
+                hist = n.learning_workflow.history
+                trained = [
+                    h == "TrainStage"
+                    for h in hist
+                    if h in ("TrainStage", "WaitAggregatedModelsStage")
+                ]
+                assert hist == _expected_history(rounds, trained)
+            check_equal_models(nodes)
+        finally:
+            for node in nodes:
+                node.stop()
+
+
+@pytest.mark.slow
 def test_stop_learning_mid_run():
     Settings.RESOURCE_MONITOR_PERIOD = 0
     nodes = _spawn(2)
@@ -130,6 +182,7 @@ def test_stop_learning_mid_run():
             node.stop()
 
 
+@pytest.mark.slow
 def test_e2e_over_grpc_transport():
     """Full convergence over the real gRPC transport (reference runs its e2e
     matrix over both transports, node_test.py:79)."""
@@ -160,13 +213,16 @@ def test_e2e_over_grpc_transport():
             node.stop()
 
 
-def test_e2e_with_int8_wire_compression():
+@pytest.mark.parametrize("protocol", ["memory", "grpc"])
+@pytest.mark.slow
+def test_e2e_with_int8_wire_compression(protocol):
     """Federation converges with int8-quantized gossip (4x smaller weight
     frames; no reference analogue — it always gossips full-precision
-    pickle, p2pfl_model.py:71-86)."""
+    pickle, p2pfl_model.py:71-86). Over gRPC the quantized frames really
+    cross protobuf serialization + sockets."""
     Settings.RESOURCE_MONITOR_PERIOD = 0
     with Settings.overridden(WIRE_COMPRESSION="int8"):
-        nodes = _spawn(2)
+        nodes = _spawn(2, protocol=protocol)
         try:
             nodes[1].connect(nodes[0].addr)
             wait_convergence(nodes, 1, wait=5)
@@ -181,13 +237,16 @@ def test_e2e_with_int8_wire_compression():
                 node.stop()
 
 
-def test_node_down_during_learning():
+@pytest.mark.parametrize("protocol", ["memory", "grpc"])
+@pytest.mark.slow
+def test_node_down_during_learning(protocol):
     """Kill a node mid-experiment: survivors detect the death via heartbeats
     and finish the remaining rounds through vote/aggregation timeouts with
     equal models. The reference ships this scenario DISABLED
-    (_test_node_down_on_learning, node_test.py:160-180); here it runs."""
+    (_test_node_down_on_learning, node_test.py:160-180); here it runs — over
+    both transports (a gRPC crash leaves a dead socket, the harder case)."""
     Settings.RESOURCE_MONITOR_PERIOD = 0
-    nodes = _spawn(3)
+    nodes = _spawn(3, protocol=protocol)
     try:
         nodes[1].connect(nodes[0].addr)
         nodes[2].connect(nodes[0].addr)
@@ -217,6 +276,7 @@ def test_node_down_during_learning():
             node.stop()
 
 
+@pytest.mark.slow
 def test_e2e_scaffold_with_wire_compression():
     """SCAFFOLD federation under bf16 wire compression: the weight tensors
     compress but the control-variate deltas ride the frame METADATA

@@ -12,11 +12,15 @@ import optax
 
 from p2pfl_tpu.parallel.mesh import make_mesh
 from p2pfl_tpu.parallel.pipeline import (
+
     make_pipeline_train_step,
     pipeline_apply,
     sequential_apply,
     stack_stage_params,
 )
+
+# GPipe programs compile ~10-70s each on the 1-core CPU mesh -> excluded from the fast subset
+pytestmark = pytest.mark.slow
 
 D = 16
 

@@ -39,6 +39,7 @@ def test_vote_committee_deterministic():
     np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.slow
 def test_simulation_converges(parts16):
     sim = MeshSimulation(
         mlp_model(seed=0), parts16, train_set_size=4, batch_size=32, seed=1
@@ -52,6 +53,7 @@ def test_simulation_converges(parts16):
     assert len({tuple(c) for c in res.committees.tolist()}) > 1
 
 
+@pytest.mark.slow
 def test_simulation_rounds_chunking_equivalent(parts16):
     """rounds_per_call must not change the math, only the dispatch."""
     sim1 = MeshSimulation(mlp_model(seed=0), parts16, train_set_size=4, batch_size=32, seed=7)
@@ -65,6 +67,7 @@ def test_simulation_rounds_chunking_equivalent(parts16):
     assert np.isfinite(r1.test_loss).all() and np.isfinite(r2.test_loss).all()
 
 
+@pytest.mark.slow
 def test_simulation_on_explicit_tp_mesh(parts16):
     """nodes x model mesh: population DP + tensor parallelism compile+run,
     with the kernels *actually* partitioned over the ``model`` axis (a silent
@@ -100,6 +103,7 @@ def test_simulation_on_explicit_tp_mesh(parts16):
     assert post, "round body dropped the model-axis sharding"
 
 
+@pytest.mark.slow
 def test_simulation_all_nodes_equal_after_diffusion(parts16):
     sim = MeshSimulation(mlp_model(seed=0), parts16, train_set_size=4, batch_size=32, seed=1)
     sim.run(rounds=1, epochs=1, warmup=False)
@@ -109,6 +113,7 @@ def test_simulation_all_nodes_equal_after_diffusion(parts16):
         np.testing.assert_allclose(a, b, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_simulation_median_aggregation(parts16):
     sim = MeshSimulation(
         mlp_model(seed=0),
@@ -122,6 +127,7 @@ def test_simulation_median_aggregation(parts16):
     assert res.test_acc[-1] > 0.3
 
 
+@pytest.mark.slow
 def test_simulation_dirichlet_noniid():
     """BASELINE.json config #2 shape (non-IID leg): Dirichlet(0.1)
     partitions still converge under FedAvg on the mesh. (The CNN leg is
@@ -138,6 +144,7 @@ def test_simulation_dirichlet_noniid():
     assert res.test_acc[-1] > 0.5, res.test_acc
 
 
+@pytest.mark.slow
 def test_simulation_krum_tolerates_poisoned_nodes():
     """BASELINE.json config #4 shape: label-poisoned (Byzantine) nodes;
     Krum aggregation keeps the federation learning."""
@@ -165,6 +172,7 @@ def test_simulation_krum_tolerates_poisoned_nodes():
     assert res.test_acc[-1] > 0.5, res.test_acc
 
 
+@pytest.mark.slow
 def test_simulation_fedprox(parts16):
     """BASELINE.json config #5 shape: FedProx proximal term in the jitted
     local step — converges, and a huge mu visibly constrains movement."""
@@ -193,6 +201,7 @@ def test_simulation_fedprox(parts16):
     assert movement(100.0) < movement(0.0)
 
 
+@pytest.mark.slow
 def test_simulation_scaffold(parts16):
     """Sim-mode SCAFFOLD (BASELINE.json config #3's aggregator leg): control
     variates ride the scan carry, the federation converges, and the
@@ -236,6 +245,7 @@ def test_simulation_scaffold_rejects_bad_combos(parts16):
         )
 
 
+@pytest.mark.slow
 def test_simulation_with_dp_sgd():
     """Mesh simulation with DP-SGD local training (per-example clip +
     Gaussian noise inside the jitted round) still learns; no reference
@@ -253,6 +263,7 @@ def test_simulation_with_dp_sgd():
     assert res.test_acc[-1] > 0.5, res.test_acc
 
 
+@pytest.mark.slow
 def test_simulation_lm_with_dp_sgd():
     """DP-SGD on the federated causal-LM path: the privacy unit is one
     sequence (each batch row clipped as a whole)."""
@@ -274,3 +285,32 @@ def test_simulation_lm_with_dp_sgd():
     assert np.isfinite(res.test_loss[-1])
     assert res.test_loss[-1] < res.test_loss[0]  # it learns under DP
     assert sim.privacy_spent()["epsilon"] > 0
+
+
+@pytest.mark.slow
+def test_eval_every_reports_only_evaluated_rounds():
+    parts8 = synthetic_mnist(n_train=512, n_test=64).generate_partitions(
+        8, RandomIIDPartitionStrategy
+    )
+    sim = MeshSimulation(mlp_model(seed=0), parts8, train_set_size=4, batch_size=32, seed=3)
+    res = sim.run(rounds=5, epochs=1, warmup=False, eval_every=2)
+    # evaluated at absolute rounds 1, 3, 4(final): 3 entries, all finite
+    assert len(res.test_acc) == 3
+    assert all(np.isfinite(a) for a in res.test_acc)
+    assert res.rounds == 5
+
+    # chunk-invariant: same cadence when rounds are split across calls
+    sim2 = MeshSimulation(mlp_model(seed=0), parts8, train_set_size=4, batch_size=32, seed=3)
+    res2 = sim2.run(rounds=5, epochs=1, warmup=False, eval_every=2, rounds_per_call=2)
+    assert len(res2.test_acc) == 3
+    np.testing.assert_allclose(res.test_acc, res2.test_acc, atol=1e-5)
+
+
+def test_indivisible_population_warns_loudly():
+    """N % mesh-nodes != 0 de-shards every population buffer (replication);
+    that fallback must be loud, not silent (round-3 verdict)."""
+    parts6 = synthetic_mnist(n_train=384, n_test=64).generate_partitions(
+        6, RandomIIDPartitionStrategy
+    )
+    with pytest.warns(UserWarning, match="not divisible by the mesh"):
+        MeshSimulation(mlp_model(seed=0), parts6, train_set_size=2, batch_size=32, seed=0)

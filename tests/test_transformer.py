@@ -21,11 +21,15 @@ from p2pfl_tpu.models.transformer import (
     transformer_lm_model,
 )
 from p2pfl_tpu.parallel.sequence import (
+
     make_sequence_parallel_train_step,
     sequence_parallel_apply,
     sequence_parallel_lm_loss,
     shard_tokens,
 )
+
+# LM train steps compile ~5-12s each -> excluded from the fast subset
+pytestmark = pytest.mark.slow
 
 VOCAB, SEQ, B = 64, 32, 2
 

@@ -46,7 +46,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--poison-frac",
         type=float,
         default=0.0,
-        help="fraction of nodes training on label-flipped data (Byzantine)",
+        help="fraction of Byzantine nodes (attack per --attack)",
+    )
+    p.add_argument(
+        "--attack",
+        choices=["labelflip", "signflip", "scaled"],
+        default="labelflip",
+        help="Byzantine mechanism: data poisoning (labelflip) or in-program "
+        "model poisoning (signflip / 10x-scaled delta)",
     )
     p.add_argument(
         "--alpha",
@@ -80,6 +87,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def run(args: argparse.Namespace) -> dict:
+    if not 0.0 <= args.poison_frac < 1.0:
+        raise SystemExit(f"--poison-frac must be in [0, 1), got {args.poison_frac}")
+    if args.aggregator == "scaffold" and args.attack != "labelflip" and args.poison_frac > 0:
+        raise SystemExit(
+            "model-poisoning attacks (--attack signflip/scaled) need a robust "
+            "aggregator (krum/trimmed_mean/fedavg contrast); scaffold's server "
+            "update has no robust variant"
+        )
     from p2pfl_tpu.learning.dataset import (
         DirichletPartitionStrategy,
         poison_partitions,
@@ -102,10 +117,20 @@ def run(args: argparse.Namespace) -> dict:
         min_partition_size=max(2, args.samples_per_node // 8),
     )
     poisoned = []
-    if args.poison_frac > 0.0:
+    byzantine_mask = None
+    if args.poison_frac > 0.0 and args.attack == "labelflip":
         parts, poisoned = poison_partitions(
             parts, args.poison_frac, num_classes, seed=7
         )
+    elif args.poison_frac > 0.0:
+        import numpy as np
+
+        rng = np.random.default_rng(7)
+        k = int(round(args.poison_frac * args.nodes))
+        if k > 0:  # a zero-count mask would compile the attack branch for nothing
+            poisoned = np.sort(rng.choice(args.nodes, size=k, replace=False))
+            byzantine_mask = np.zeros(args.nodes, np.float32)
+            byzantine_mask[poisoned] = 1.0
 
     # Byzantine budget for the robust rules: the expected number of poisoned
     # committee members, rounded up (Krum needs n - f - 2 >= 1 honest-majority
@@ -133,12 +158,15 @@ def run(args: argparse.Namespace) -> dict:
         aggregate_fn=agg_fn,
         algorithm=algorithm,
         lr=lr,
+        byzantine_mask=byzantine_mask,
+        byzantine_attack=args.attack if args.attack != "labelflip" else "signflip",
     )
     res = sim.run(rounds=args.rounds, epochs=args.epochs, warmup=True)
     return {
         "mode": "mesh",
         "model": "resnet18-groupnorm",
         "aggregator": args.aggregator,
+        "attack": args.attack if len(poisoned) else None,
         "nodes": args.nodes,
         "poisoned_nodes": [int(i) for i in poisoned],
         "byzantine_budget": f if args.aggregator in ("krum", "trimmed_mean") else None,

@@ -7,13 +7,14 @@ actually attack a federation with them.
 
 Two standard attacks:
 
-* **label flip** — a poisoned node trains on systematically wrong labels
-  (``y -> (y + offset) mod C``), producing a model update that pulls the
-  global model toward misclassification while looking statistically
+* **label flip** (here) — a poisoned node trains on systematically wrong
+  labels (``y -> (y + offset) mod C``), producing a model update that pulls
+  the global model toward misclassification while looking statistically
   ordinary (hard for distance-based rules at low poison rates).
-* **sign flip** — a poisoned node negates its model delta (handled at the
-  aggregation layer by tests; the data-side helpers here only cover label
-  attacks since the mesh simulation owns the update path).
+* **model poisoning** (``MeshSimulation(byzantine_mask=...,
+  byzantine_attack="signflip"|"scaled")``) — the update itself is corrupted
+  inside the jitted round body; the data-side helpers here only cover label
+  attacks since the mesh simulation owns the update path.
 """
 
 from __future__ import annotations

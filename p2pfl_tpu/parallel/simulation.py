@@ -126,6 +126,12 @@ class MeshSimulation:
             the jitted local step (the reference only has host-side
             scaffold; sim-mode scaffold is an upgrade).
         scaffold_global_lr: SCAFFOLD server step size.
+        byzantine_mask: optional ``[N]`` 0/1 array flagging model-poisoning
+            nodes — their trained update is corrupted inside the jitted
+            round body before aggregation (for exercising robust
+            ``aggregate_fn`` rules; BASELINE config #4).
+        byzantine_attack: ``"signflip"`` (update negated around the round
+            start) or ``"scaled"`` (10x the honest delta).
     """
 
     def __init__(
@@ -147,11 +153,21 @@ class MeshSimulation:
         dp_noise_multiplier: float = 0.0,
         algorithm: str = "fedavg",
         scaffold_global_lr: float = 1.0,
+        byzantine_mask: Optional[np.ndarray] = None,
+        byzantine_attack: str = "signflip",
     ) -> None:
         if task not in ("classification", "lm"):
             raise ValueError(f"unknown task {task!r}")
         if algorithm not in ("fedavg", "scaffold"):
             raise ValueError(f"unknown algorithm {algorithm!r}")
+        if byzantine_attack not in ("signflip", "scaled"):
+            raise ValueError(f"unknown byzantine_attack {byzantine_attack!r}")
+        if byzantine_mask is not None and algorithm == "scaffold":
+            raise ValueError(
+                "model-poisoning attacks compose with robust aggregate_fn "
+                "rules (krum/trimmed-mean); scaffold's server update has no "
+                "robust variant here"
+            )
         if algorithm == "scaffold" and aggregate_fn is not None:
             raise ValueError("scaffold defines its own aggregation; drop aggregate_fn")
         if algorithm == "scaffold" and per_node_init:
@@ -197,7 +213,20 @@ class MeshSimulation:
         else:
             self.optimizer = optax.adam(lr)
         self.seed = resolve_seed(seed, self.dp_noise_multiplier)
+        # Model-poisoning attack (BASELINE config #4's gradient-attack side;
+        # complements data poisoning via dataset.poison_partitions): nodes
+        # flagged in `byzantine_mask` [N] transform their trained update
+        # INSIDE the jitted round body before aggregation —
+        # "signflip": w' = w_start - (w_trained - w_start) (pushes the
+        # global model away from descent), "scaled": 10x the honest delta.
+        self._byz_attack = byzantine_attack
+        self._byz = (
+            jnp.asarray(np.asarray(byzantine_mask, np.float32))
+            if byzantine_mask is not None
+            else None
+        )
         self.mesh = mesh if mesh is not None else make_mesh()
+        # (mask length is validated after num_nodes is known, below)
         self.aggregate_fn = aggregate_fn if aggregate_fn is not None else agg_ops.fedavg
 
         # --- data: stack partitions into [N, S, ...] with validity masks ----
@@ -206,6 +235,15 @@ class MeshSimulation:
         else:
             self.x, self.y, self.sample_mask = _stack_partitions(partitions)
         self.num_nodes = int(self.x.shape[0])
+        if self._byz is not None and self._byz.shape != (self.num_nodes,):
+            # A wrong-length mask would be silently mis-gathered inside the
+            # jitted body (JAX clamps out-of-bounds indices) and attack the
+            # wrong nodes — the experiment would report a configuration that
+            # was never applied.
+            raise ValueError(
+                f"byzantine_mask has shape {self._byz.shape}, expected "
+                f"({self.num_nodes},) — one flag per node"
+            )
         self.train_set_size = int(
             min(train_set_size or Settings.TRAIN_SET_SIZE, self.num_nodes)
         )
@@ -441,6 +479,22 @@ class MeshSimulation:
         p_k_new, o_k, losses = jax.vmap(
             partial(self._local_train, c_global=c_global, epochs=epochs)
         )(p_k, o_k, keys, x_k, y_k, w_k, c_k)
+
+        if self._byz is not None:
+            # Byzantine committee members corrupt their update in-program
+            # (one fused where over the stacked pytree — no extra pass).
+            bz = self._byz[committee]  # [K] 0/1
+
+            def corrupt(new, old):
+                delta = new.astype(jnp.float32) - old.astype(jnp.float32)
+                if self._byz_attack == "signflip":
+                    attacked = old.astype(jnp.float32) - delta
+                else:  # "scaled"
+                    attacked = old.astype(jnp.float32) + 10.0 * delta
+                sel = bz.reshape((-1,) + (1,) * (new.ndim - 1)) > 0
+                return jnp.where(sel, attacked, new.astype(jnp.float32)).astype(new.dtype)
+
+            p_k_new = jax.tree.map(corrupt, p_k_new, p_k)
 
         if self.algorithm == "scaffold":
             # Server step (same jitted kernel as the host-mode Scaffold

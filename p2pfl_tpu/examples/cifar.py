@@ -125,10 +125,13 @@ def run(args: argparse.Namespace) -> dict:
     elif args.poison_frac > 0.0:
         import numpy as np
 
-        rng = np.random.default_rng(7)
-        k = int(round(args.poison_frac * args.nodes))
-        if k > 0:  # a zero-count mask would compile the attack branch for nothing
-            poisoned = np.sort(rng.choice(args.nodes, size=k, replace=False))
+        from p2pfl_tpu.learning.dataset import select_poisoned
+
+        # Same selection as poison_partitions (shared helper): labelflip and
+        # signflip/scaled runs at equal --poison-frac attack identical nodes.
+        chosen = select_poisoned(args.nodes, args.poison_frac, seed=7)
+        if len(chosen):  # a zero-count mask would compile the attack branch for nothing
+            poisoned = chosen
             byzantine_mask = np.zeros(args.nodes, np.float32)
             byzantine_mask[poisoned] = 1.0
 
@@ -159,7 +162,7 @@ def run(args: argparse.Namespace) -> dict:
         algorithm=algorithm,
         lr=lr,
         byzantine_mask=byzantine_mask,
-        byzantine_attack=args.attack if args.attack != "labelflip" else "signflip",
+        byzantine_attack=args.attack,
     )
     res = sim.run(rounds=args.rounds, epochs=args.epochs, warmup=True)
     return {

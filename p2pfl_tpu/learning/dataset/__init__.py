@@ -17,6 +17,7 @@ from p2pfl_tpu.learning.dataset.export_strategies import (  # noqa: F401
 from p2pfl_tpu.learning.dataset.poison import (  # noqa: F401
     flip_labels,
     poison_partitions,
+    select_poisoned,
 )
 from p2pfl_tpu.learning.dataset.partition import (  # noqa: F401
     DirichletPartitionStrategy,

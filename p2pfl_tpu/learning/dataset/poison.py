@@ -43,6 +43,20 @@ def flip_labels(
     return FederatedDataset.from_arrays(x, flipped, xt, yt)
 
 
+def select_poisoned(n: int, fraction: float, seed: int = 0) -> np.ndarray:
+    """The Byzantine node set for a population of ``n``: ``round(fraction*n)``
+    distinct indices, sorted. Shared by data-poisoning
+    (:func:`poison_partitions`) and model-poisoning (``MeshSimulation``
+    byzantine_mask builders) so the two attack families select identical
+    node sets for the same ``(n, fraction, seed)`` — apples-to-apples
+    defense comparisons depend on it."""
+    if not 0.0 <= fraction < 1.0:
+        raise ValueError(f"fraction must be in [0, 1), got {fraction}")
+    k = int(round(fraction * n))
+    rng = np.random.default_rng(seed)
+    return np.sort(rng.choice(n, size=k, replace=False))
+
+
 def poison_partitions(
     partitions: Sequence[FederatedDataset],
     fraction: float,
@@ -57,12 +71,7 @@ def poison_partitions(
     indices identify which nodes are Byzantine (ground truth for asserting
     that a robust rule excluded or out-voted them).
     """
-    if not 0.0 <= fraction < 1.0:
-        raise ValueError(f"fraction must be in [0, 1), got {fraction}")
-    n = len(partitions)
-    k = int(round(fraction * n))
-    rng = np.random.default_rng(seed)
-    poisoned = np.sort(rng.choice(n, size=k, replace=False))
+    poisoned = select_poisoned(len(partitions), fraction, seed)
     out = list(partitions)
     for i in poisoned:
         out[i] = flip_labels(partitions[i], num_classes, offset)

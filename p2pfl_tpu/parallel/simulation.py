@@ -160,7 +160,7 @@ class MeshSimulation:
             raise ValueError(f"unknown task {task!r}")
         if algorithm not in ("fedavg", "scaffold"):
             raise ValueError(f"unknown algorithm {algorithm!r}")
-        if byzantine_attack not in ("signflip", "scaled"):
+        if byzantine_mask is not None and byzantine_attack not in ("signflip", "scaled"):
             raise ValueError(f"unknown byzantine_attack {byzantine_attack!r}")
         if byzantine_mask is not None and algorithm == "scaffold":
             raise ValueError(

@@ -98,6 +98,7 @@ def run(args: argparse.Namespace) -> dict:
     from p2pfl_tpu.learning.dataset import (
         DirichletPartitionStrategy,
         poison_partitions,
+        select_poisoned,
         synthetic_cifar10,
     )
     from p2pfl_tpu.models.resnet import resnet18_model
@@ -124,8 +125,6 @@ def run(args: argparse.Namespace) -> dict:
         )
     elif args.poison_frac > 0.0:
         import numpy as np
-
-        from p2pfl_tpu.learning.dataset import select_poisoned
 
         # Same selection as poison_partitions (shared helper): labelflip and
         # signflip/scaled runs at equal --poison-frac attack identical nodes.

@@ -307,3 +307,51 @@ def test_e2e_scaffold_with_wire_compression():
         finally:
             for node in nodes:
                 node.stop()
+
+
+@pytest.mark.slow
+def test_e2e_krum_excludes_poisoned_node():
+    """Nodes-mode robust aggregation composition (BASELINE config #4 over
+    the real protocol): one of four nodes trains on label-flipped data;
+    Krum-aggregating nodes converge to a model that still learns. The
+    reference ships Krum only as an unrunnable stub — here the rule runs
+    inside a live gossip federation."""
+    from p2pfl_tpu.learning.aggregators import Krum
+    from p2pfl_tpu.learning.dataset import flip_labels
+
+    Settings.RESOURCE_MONITOR_PERIOD = 0
+    data = synthetic_mnist(n_train=1024, n_test=128)
+    parts = data.generate_partitions(4, RandomIIDPartitionStrategy)
+    parts[3] = flip_labels(parts[3], num_classes=10)  # the Byzantine node
+    nodes = [
+        Node(
+            mlp_model(seed=i),
+            parts[i],
+            aggregator=Krum(num_byzantine=1, num_selected=2),
+            batch_size=32,
+        )
+        for i in range(4)
+    ]
+    for node in nodes:
+        node.start()
+    try:
+        for i in range(1, 4):
+            nodes[i].connect(nodes[0].addr)
+        wait_convergence(nodes, 3, wait=8)
+        nodes[0].set_start_learning(rounds=2, epochs=1)
+        _wait_finished(nodes)
+        check_equal_models(nodes)
+        # The point of the rule: the Byzantine node's model was EXCLUDED —
+        # provenance on the aggregated model (robust.py stamps only the
+        # selected contributors) must not contain its address. Accuracy
+        # alone can't catch Krum degrading to average-everything (3 clean +
+        # 1 flipped still clears 0.5).
+        contributors = nodes[0].learner.get_model().get_contributors()
+        assert contributors, "aggregated model lost provenance"
+        assert nodes[3].addr not in contributors, contributors
+        # test split is clean: accuracy measures true performance
+        acc = nodes[0].learner.evaluate()["test_acc"]
+        assert acc > 0.5, acc
+    finally:
+        for node in nodes:
+            node.stop()

@@ -386,70 +386,75 @@ def bench_mfu(device_kind: str) -> dict:
     }
 
 
+def scale_bench_body(kind: str, n: int = SCALE_NODES, s: int = SCALE_SAMPLES,
+                     rounds: int = SCALE_ROUNDS, committee: int = SCALE_COMMITTEE) -> dict:
+    """The measurable body of the --scale-500 mode (probe-free, so the CPU
+    mesh can rehearse it at reduced scale): Dirichlet non-IID data generated
+    on device, FedProx, 10% committee sampling, eval every 5 rounds."""
+    import jax
+    import jax.numpy as jnp
+
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.parallel.simulation import MeshSimulation
+
+    @jax.jit
+    def make(key):
+        kt, kd, ky, kn, kyt, knt = jax.random.split(key, 6)
+        templates = jax.random.uniform(kt, (10, 28, 28), jnp.float32)
+        # Per-node class mixture ~ Dir(alpha): the FEMNIST-style
+        # writer-skew each node sees a few classes mostly.
+        probs = jax.random.dirichlet(kd, jnp.full((10,), SCALE_ALPHA), (n,))
+        logits = jnp.broadcast_to(jnp.log(probs + 1e-9)[:, None, :], (n, s, 10))
+        y = jax.random.categorical(ky, logits, axis=-1).astype(jnp.int32)
+        x = jnp.clip(
+            templates[y] + NOISE * jax.random.normal(kn, (n, s, 28, 28)), 0.0, 1.0
+        )
+        yt = jax.random.randint(kyt, (TEST_SAMPLES,), 0, 10).astype(jnp.int32)
+        xt = jnp.clip(
+            templates[yt] + NOISE * jax.random.normal(knt, (TEST_SAMPLES, 28, 28)),
+            0.0, 1.0,
+        )
+        return x, y, jnp.ones((n, s), jnp.float32), xt, yt
+
+    _phase(f"scale bench: generating {n}-node Dirichlet data on device")
+    x, y, mask, xt, yt = make(jax.random.key(11))
+    jax.block_until_ready(x)
+    sim = MeshSimulation(
+        mlp_model(seed=0), (x, y, mask), test_data=(xt, yt),
+        train_set_size=committee, batch_size=BATCH, seed=1,
+        fedprox_mu=SCALE_FEDPROX_MU,
+    )
+    _phase("scale bench: warmup compile + timed run")
+    res = sim.run(
+        rounds=rounds, epochs=1, warmup=True,
+        rounds_per_call=rounds, eval_every=5,
+    )
+    return {
+        "metric": f"sec_per_round_{n}node_dirichlet_fedprox",
+        "value": round(res.seconds_per_round, 6),
+        "unit": "s/round",
+        "extra": {
+            "nodes": n, "committee": committee, "rounds": rounds,
+            "samples_per_node": s, "alpha": SCALE_ALPHA,
+            "fedprox_mu": SCALE_FEDPROX_MU,
+            "final_test_acc": round(res.test_acc[-1], 4),
+            "device_kind": kind,
+            "note": "reference collapses at 100 in-process nodes "
+            f"(BASELINE.md: heartbeat convergence fails); this is {n} nodes "
+            f"with {100 * committee // max(n, 1)}% committee sampling",
+        },
+    }
+
+
 def run_scale_500() -> None:
     """Subprocess-style mode: config #5 shape at 5x the reference's collapse
-    point — 500 nodes, Dirichlet non-IID, FedProx, 10% committee sampling.
-    Prints ONE JSON line. Data is generated on device (Dirichlet class
-    mixtures per node) so startup is not dominated by a ~180MB host upload
-    over the tunnel."""
+    point — 512 nodes, Dirichlet non-IID, FedProx, 10% committee sampling.
+    Prints ONE JSON line. Data is generated on device so startup is not
+    dominated by a ~180MB host upload over the tunnel."""
     out: dict = {}
     try:
         kind = probe_backend()
-        import jax
-        import jax.numpy as jnp
-
-        from p2pfl_tpu.models import mlp_model
-        from p2pfl_tpu.parallel.simulation import MeshSimulation
-
-        n, s = SCALE_NODES, SCALE_SAMPLES
-
-        @jax.jit
-        def make(key):
-            kt, kd, ky, kn, kyt, knt = jax.random.split(key, 6)
-            templates = jax.random.uniform(kt, (10, 28, 28), jnp.float32)
-            # Per-node class mixture ~ Dir(alpha): the FEMNIST-style
-            # writer-skew each node sees a few classes mostly.
-            probs = jax.random.dirichlet(kd, jnp.full((10,), SCALE_ALPHA), (n,))
-            logits = jnp.broadcast_to(jnp.log(probs + 1e-9)[:, None, :], (n, s, 10))
-            y = jax.random.categorical(ky, logits, axis=-1).astype(jnp.int32)
-            x = jnp.clip(
-                templates[y] + NOISE * jax.random.normal(kn, (n, s, 28, 28)), 0.0, 1.0
-            )
-            yt = jax.random.randint(kyt, (TEST_SAMPLES,), 0, 10).astype(jnp.int32)
-            xt = jnp.clip(
-                templates[yt] + NOISE * jax.random.normal(knt, (TEST_SAMPLES, 28, 28)),
-                0.0, 1.0,
-            )
-            return x, y, jnp.ones((n, s), jnp.float32), xt, yt
-
-        _phase(f"scale-500: generating {n}-node Dirichlet data on device")
-        x, y, mask, xt, yt = make(jax.random.key(11))
-        jax.block_until_ready(x)
-        sim = MeshSimulation(
-            mlp_model(seed=0), (x, y, mask), test_data=(xt, yt),
-            train_set_size=SCALE_COMMITTEE, batch_size=BATCH, seed=1,
-            fedprox_mu=SCALE_FEDPROX_MU,
-        )
-        _phase("scale-500: warmup compile + timed run")
-        res = sim.run(
-            rounds=SCALE_ROUNDS, epochs=1, warmup=True,
-            rounds_per_call=SCALE_ROUNDS, eval_every=5,
-        )
-        out = {
-            "metric": f"sec_per_round_{SCALE_NODES}node_dirichlet_fedprox",
-            "value": round(res.seconds_per_round, 6),
-            "unit": "s/round",
-            "extra": {
-                "nodes": n, "committee": SCALE_COMMITTEE, "rounds": SCALE_ROUNDS,
-                "samples_per_node": s, "alpha": SCALE_ALPHA,
-                "fedprox_mu": SCALE_FEDPROX_MU,
-                "final_test_acc": round(res.test_acc[-1], 4),
-                "device_kind": kind,
-                "note": "reference collapses at 100 in-process nodes "
-                "(BASELINE.md: heartbeat convergence fails); this is 5x that "
-                "with 10% committee sampling",
-            },
-        }
+        out = scale_bench_body(kind)
     except Exception as e:  # noqa: BLE001
         traceback.print_exc(file=sys.stderr)
         out["error"] = f"{type(e).__name__}: {e}"

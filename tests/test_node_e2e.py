@@ -346,7 +346,9 @@ def test_e2e_krum_excludes_poisoned_node():
         # selected contributors) must not contain its address. Accuracy
         # alone can't catch Krum degrading to average-everything (3 clean +
         # 1 flipped still clears 0.5).
-        contributors = nodes[0].learner.get_model().get_contributors()
+        # (raw attribute: get_contributors() raises on empty, which would
+        # mask the crafted message below)
+        contributors = nodes[0].learner.get_model().contributors
         assert contributors, "aggregated model lost provenance"
         assert nodes[3].addr not in contributors, contributors
         # test split is clean: accuracy measures true performance

@@ -350,3 +350,21 @@ def test_byzantine_mask_rejects_scaffold(parts16):
             mlp_model(seed=0), parts16, algorithm="scaffold",
             byzantine_mask=np.ones(16, np.float32),
         )
+
+
+@pytest.mark.slow
+def test_scale_bench_body_rehearsal():
+    """bench.py --scale-500's measurable body (probe-free) runs end-to-end
+    at reduced scale on the CPU mesh: on-device Dirichlet data generation,
+    FedProx, 12.5% committee sampling, eval_every cadence. De-risks the
+    real-TPU mode so its first contact with hardware can't be a crash."""
+    import sys
+
+    sys.path.insert(0, "/root/repo")
+    import bench
+
+    out = bench.scale_bench_body("cpu-rehearsal", n=64, s=64, rounds=4, committee=8)
+    assert out["metric"] == "sec_per_round_64node_dirichlet_fedprox"
+    assert out["value"] > 0
+    assert out["extra"]["final_test_acc"] > 0.3  # observed 0.57
+    assert "64 nodes" in out["extra"]["note"]

@@ -441,7 +441,7 @@ def scale_bench_body(kind: str, n: int = SCALE_NODES, s: int = SCALE_SAMPLES,
             "device_kind": kind,
             "note": "reference collapses at 100 in-process nodes "
             f"(BASELINE.md: heartbeat convergence fails); this is {n} nodes "
-            f"with {100 * committee // max(n, 1)}% committee sampling",
+            f"with {100.0 * committee / max(n, 1):.1f}% committee sampling",
         },
     }
 

@@ -358,9 +358,10 @@ def test_scale_bench_body_rehearsal():
     at reduced scale on the CPU mesh: on-device Dirichlet data generation,
     FedProx, 12.5% committee sampling, eval_every cadence. De-risks the
     real-TPU mode so its first contact with hardware can't be a crash."""
+    import os
     import sys
 
-    sys.path.insert(0, "/root/repo")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     import bench
 
     out = bench.scale_bench_body("cpu-rehearsal", n=64, s=64, rounds=4, committee=8)

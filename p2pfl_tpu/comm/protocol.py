@@ -211,14 +211,31 @@ class CommunicationProtocol:
 
     # --- inbound (called by transport servers) ------------------------------
 
+    def _dispatch_contained(self, env: Envelope, **kwargs: Any) -> None:
+        """Dispatch with APPLICATION errors contained at the receiving node.
+
+        An unknown command (version-skewed peer) or a handler exception must
+        never surface as a transport failure: the gRPC server would return
+        an error Ack, the SENDER's broadcast path would treat that as a dead
+        link and remove the neighbor — one stray command dismantling
+        connectivity. Transport-level problems (undecodable frames) still
+        propagate from the server adapters.
+        """
+        args = () if env.is_weights else tuple(env.args)  # weights ride kwargs only
+        try:
+            self.dispatcher.dispatch(env.cmd, env.source, env.round, *args, **kwargs)
+        except Exception:  # noqa: BLE001 — any app error is the receiver's own
+            log.exception(
+                "(%s) contained error dispatching %r from %s",
+                self._addr, env.cmd, env.source,
+            )
+
     def handle_envelope(self, env: Envelope) -> None:
         """Inbound dispatch with dedup + TTL re-gossip
         (reference grpc_server.py:161-212)."""
         if env.is_weights:
-            self.dispatcher.dispatch(
-                env.cmd,
-                env.source,
-                env.round,
+            self._dispatch_contained(
+                env,
                 weights=env.payload,
                 contributors=env.contributors,
                 num_samples=env.num_samples,
@@ -226,7 +243,7 @@ class CommunicationProtocol:
             return
         if not self.gossiper.check_and_set_processed(env.msg_id):
             return
-        self.dispatcher.dispatch(env.cmd, env.source, env.round, *env.args)
+        self._dispatch_contained(env)
         if env.ttl > 1:
             fwd = Envelope(
                 source=env.source,

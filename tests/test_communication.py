@@ -245,3 +245,28 @@ def test_grpc_mtls_rejects_unauthenticated_client(tmp_path):
                 client.stop()
     finally:
         server.stop()
+
+
+def test_unknown_command_is_contained(protocol_class):
+    """A version-skewed or malicious peer sending an unregistered command
+    must not crash the receiver OR tear down the link: dispatch errors are
+    contained at the receiving node (CommunicationProtocol's
+    _dispatch_contained logs them), so the gRPC Ack stays CLEAN — an error
+    Ack would make the sender treat the link as dead and remove the
+    neighbor (the bug this test caught). Registered commands keep working."""
+    a, b = _mk(2, protocol_class)
+    try:
+        cmd = MockCommand()
+        b.add_command(cmd)
+        a.connect(b.addr)
+        assert _wait(lambda: b.addr in a.get_neighbors(only_direct=True))
+        # Unknown command: delivery must not raise on the sender and must
+        # not kill the receiver.
+        a.broadcast(a.build_msg("no-such-command", args=["x"]))
+        time.sleep(0.3)
+        # The receiver still dispatches registered commands afterwards.
+        a.broadcast(a.build_msg("mock", args=["after"]))
+        assert _wait(lambda: any(args == ("after",) for _, _, args in cmd.calls))
+    finally:
+        for p in (a, b):
+            p.stop()

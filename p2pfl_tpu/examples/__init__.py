@@ -16,6 +16,12 @@ EXAMPLES = {
         "--protocol/--aggregator/--mode (mesh = one sharded XLA program, "
         "nodes = full async gossip protocol).",
     ),
+    "cifar": (
+        "p2pfl_tpu.examples.cifar",
+        "Federated CIFAR-10 ResNet-18 (configs #3/#4): --aggregator "
+        "{scaffold,krum,trimmed_mean,fedavg,fedmedian}/--poison-frac/"
+        "--attack {labelflip,signflip,scaled}/--nodes/--alpha.",
+    ),
     "longcontext": (
         "p2pfl_tpu.examples.longcontext",
         "Federated long-context LM fine-tuning over the mesh (task='lm'): "

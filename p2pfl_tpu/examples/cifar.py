@@ -151,7 +151,11 @@ def run(args: argparse.Namespace) -> dict:
     algorithm = "scaffold" if args.aggregator == "scaffold" else "fedavg"
     lr = args.lr if args.lr is not None else (0.05 if algorithm == "scaffold" else 1e-3)
 
-    sim = MeshSimulation(
+    # Context-managed: the jit cache pins every simulation that ran (static
+    # `self`), so back-to-back runs in one process (the bench's
+    # scaffold/krum/fedavg trio) must close() each or HBM fills with dead
+    # populations.
+    with MeshSimulation(
         resnet18_model(seed=0, input_shape=(args.image_size, args.image_size, 3)),
         parts,
         train_set_size=committee,
@@ -162,8 +166,8 @@ def run(args: argparse.Namespace) -> dict:
         lr=lr,
         byzantine_mask=byzantine_mask,
         byzantine_attack=args.attack,
-    )
-    res = sim.run(rounds=args.rounds, epochs=args.epochs, warmup=True)
+    ) as sim:
+        res = sim.run(rounds=args.rounds, epochs=args.epochs, warmup=True)
     return {
         "mode": "mesh",
         "model": "resnet18-groupnorm",

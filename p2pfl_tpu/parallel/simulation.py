@@ -313,6 +313,12 @@ class MeshSimulation:
             )
 
         self.params_stack = broadcast_population(template)
+        # Kept for _reinit_population(): a pristine simulation can DONATE its
+        # real state to the warmup execution (halving peak HBM vs warming up
+        # on copies — the difference between ResNet-18 at 56 nodes fitting a
+        # 16 GB chip or OOMing) and rebuild the identical initial state after.
+        self._broadcast_population = broadcast_population
+        self._template = template
 
         # Optimizer state gets explicit shardings too, mirroring the param
         # layout: leading-N leaves over ``nodes``, param-shaped moments also
@@ -331,9 +337,10 @@ class MeshSimulation:
 
         opt_shapes = jax.eval_shape(jax.vmap(self.optimizer.init), self.params_stack)
         opt_shardings = jax.tree.map(opt_sharding, opt_shapes)
-        self.opt_stack = jax.jit(
+        self._opt_init = jax.jit(
             jax.vmap(self.optimizer.init), out_shardings=opt_shardings
-        )(self.params_stack)
+        )
+        self.opt_stack = self._opt_init(self.params_stack)
 
         def shard_stacked(x) -> jax.Array:
             spec = P("nodes") if x.shape[0] % self.mesh.shape["nodes"] == 0 else P()
@@ -358,6 +365,7 @@ class MeshSimulation:
             def zeros_stack(t: Pytree) -> Pytree:
                 return jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), t)
 
+            self._zeros_stack = zeros_stack
             self.c_stack = zeros_stack(self.params_stack)
             self.c_global = jax.device_put(
                 jax.tree.map(lambda p: np.zeros(p.shape, np.float32), template),
@@ -381,6 +389,11 @@ class MeshSimulation:
         # from a checkpoint replays the exact key sequence regardless of how
         # rounds are chunked into compiled calls.
         self.completed_rounds = 0
+        # True until the population state diverges from its deterministic
+        # initial value (rounds run or a checkpoint restored): the warmup in
+        # run() may then donate the real state and rebuild it afterwards.
+        self._pristine = True
+        self._closed = False
         # Abstract state (shapes/dtypes/shardings) so load_from() can rebuild
         # the population even after a failed donated step deleted it.
         self._abstract_state = jax.tree.map(
@@ -620,6 +633,12 @@ class MeshSimulation:
 
         With ``warmup`` (default) one extra call triggers XLA compilation
         before timing, so the timed run measures steady-state throughput.
+        On a pristine simulation (no rounds run, no checkpoint restored)
+        the warmup DONATES the live population buffers and rebuilds the
+        bit-identical initial state afterwards — peak HBM stays ~1x state
+        instead of the ~2x of warming up on copies — so any reference
+        taken from ``params_stack``/``state_dict()`` before the first
+        ``run`` is deleted by it; re-read state from the simulation after.
 
         With a ``checkpointer`` (:class:`~p2pfl_tpu.management.checkpoint.
         FLCheckpointer`), population state is snapshotted every
@@ -632,6 +651,16 @@ class MeshSimulation:
         for throughput runs. ``SimulationResult.test_acc`` then holds only
         the evaluated rounds.
         """
+        if self._closed:
+            raise RuntimeError(
+                "simulation is closed (close() also released its data) — "
+                "construct a new MeshSimulation"
+            )
+        if self.params_stack is None:
+            raise RuntimeError(
+                "population state lost in a failed donated step — "
+                "load_from(checkpointer) to restore before running again"
+            )
         xt = jnp.asarray(self.x_test) if self.x_test is not None else None
         yt = jnp.asarray(self.y_test) if self.y_test is not None else None
         data = (self.x, self.y, self.sample_mask, self.num_samples, xt, yt)
@@ -646,26 +675,41 @@ class MeshSimulation:
         if warmup:
             # Population/opt buffers are donated to the round program (the
             # state is updated in place — half the HBM high-water of a
-            # copy-in/copy-out loop), so warm up on throwaway copies to keep
-            # the real state alive for the timed run. The warmup uses a
+            # copy-in/copy-out loop). A PRISTINE simulation donates its real
+            # state to the warmup and deterministically rebuilds the initial
+            # population after (peak HBM stays ~1x state — the difference
+            # between ResNet-18 at 56 nodes fitting a 16 GB chip or OOMing);
+            # once state carries training progress the warmup falls back to
+            # throwaway copies (~2x state). Either way the warmup uses a
             # start_round the real run never sees: a remote/tunneled backend
             # may recognize a repeated (program, inputs) execution and replay
             # its cached result, which would make the first timed chunk—
             # value-identical to the warmup otherwise—report fantasy timings.
-            wp, wo, wc, wcg = jax.tree.map(
-                jnp.copy,
-                (self.params_stack, self.opt_stack, self.c_stack, self.c_global),
-            )
-            out = self._run_jit(
-                wp, wo, wc, wcg, data, jnp.int32(start + rounds + 1),
-                jnp.int32(start + rounds + chunks[0]),
-                rounds=chunks[0], epochs=epochs, eval_every=eval_every,
-            )
-            jax.block_until_ready(out[0])
-            # Force true retirement (see the matching fetch after the timed
-            # loop): otherwise the in-flight warmup bleeds into the timing.
-            np.asarray(out[6])
-            del out
+            if self._pristine:
+                wp, wo = self.params_stack, self.opt_stack
+                wc, wcg = self.c_stack, self.c_global
+            else:
+                wp, wo, wc, wcg = jax.tree.map(
+                    jnp.copy,
+                    (self.params_stack, self.opt_stack, self.c_stack, self.c_global),
+                )
+            try:
+                out = self._run_jit(
+                    wp, wo, wc, wcg, data, jnp.int32(start + rounds + 1),
+                    jnp.int32(start + rounds + chunks[0]),
+                    rounds=chunks[0], epochs=epochs, eval_every=eval_every,
+                )
+                jax.block_until_ready(out[0])
+                # Force true retirement (see the matching fetch after the
+                # timed loop): otherwise the in-flight warmup bleeds into
+                # the timing.
+                np.asarray(out[6])
+                del out
+            finally:
+                if self._pristine:
+                    # The real state was donated (even a failed execution
+                    # deletes it) — rebuild the identical initial population.
+                    self._reinit_population()
 
         params_stack, opt_stack = self.params_stack, self.opt_stack
         c_stack, c_global = self.c_stack, self.c_global
@@ -712,6 +756,7 @@ class MeshSimulation:
             # load_from() + run() resumes cleanly.
             self.params_stack = self.opt_stack = None
             self.c_stack = self.c_global = None
+            self._pristine = False
             raise RuntimeError(
                 "simulation step failed after its population buffers were "
                 "donated; restore with load_from(checkpointer) before "
@@ -730,6 +775,7 @@ class MeshSimulation:
         self.params_stack, self.opt_stack = params_stack, opt_stack
         self.c_stack, self.c_global = c_stack, c_global
         self.completed_rounds = start + total_rounds
+        self._pristine = False
         # Rounds skipped by eval_every carry NaN sentinels — drop them so
         # test_acc[-1] is always the final round's real evaluation.
         acc_all = np.concatenate([np.asarray(t) for t in test_acc])
@@ -759,11 +805,59 @@ class MeshSimulation:
             nonprivate_steps=self._nonprivate_steps_per_node,
         )
 
+    def close(self) -> None:
+        """Release the population's device buffers (and all jit executables).
+
+        The round program is jitted with ``self`` as a static argument, so
+        the global jit cache holds a strong reference to every simulation
+        that ever ran — dropping the Python reference does NOT free its
+        params/optimizer/data HBM. Sequential experiments in one process
+        (e.g. the CIFAR scaffold/krum/fedavg trio) must ``close()`` each
+        simulation before building the next or the dead populations
+        accumulate until RESOURCE_EXHAUSTED. ``jax.clear_caches()`` here
+        also evicts compiled executables (other live jits recompile on next
+        call — correctness is unaffected).
+        """
+        self.params_stack = self.opt_stack = None
+        self.c_stack = self.c_global = None
+        self.x = self.y = self.sample_mask = self.num_samples = None
+        self.x_test = self.y_test = None
+        self._template = None
+        self._pristine = False
+        # Unlike a failed donated step (params gone, data intact,
+        # load_from() recovers), a closed simulation also dropped its data —
+        # it is not restorable; run()/load_from() raise accordingly.
+        self._closed = True
+        jax.clear_caches()
+
+    def __enter__(self) -> "MeshSimulation":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _reinit_population(self) -> None:
+        """Rebuild the deterministic initial population state (params,
+        optimizer, SCAFFOLD variates). The pristine-state warmup in
+        :meth:`run` donates the real buffers to the warmup execution and
+        restores them here — same seed, bit-identical state, ~1x state HBM
+        peak instead of the copies path's ~2x."""
+        self.params_stack = self._broadcast_population(self._template)
+        self.opt_stack = self._opt_init(self.params_stack)
+        if self.algorithm == "scaffold":
+            self.c_stack = self._zeros_stack(self.params_stack)
+            self.c_global = jax.device_put(
+                jax.tree.map(lambda p: np.zeros(p.shape, np.float32), self._template),
+                NamedSharding(self.mesh, P()),
+            )
+
     def final_model(self, node: int = 0) -> ModelHandle:
         """Extract one node's model (they're all equal after diffusion)."""
         if self.params_stack is None:
             raise RuntimeError(
-                "population state lost in a failed donated step; "
+                "simulation closed — extract the model before close()"
+                if self._closed
+                else "population state lost in a failed donated step; "
                 "load_from(checkpointer) to restore"
             )
         params = jax.tree.map(lambda a: a[node], self.params_stack)
@@ -773,6 +867,10 @@ class MeshSimulation:
 
     def state_dict(self) -> Pytree:
         """Checkpointable population state (device arrays, shardings kept)."""
+        if self._closed:
+            raise RuntimeError(
+                "simulation is closed — snapshot state before close()"
+            )
         state = {"params_stack": self.params_stack, "opt_stack": self.opt_stack}
         if self.algorithm == "scaffold":
             state["c_stack"] = self.c_stack
@@ -807,6 +905,12 @@ class MeshSimulation:
         ``fold_in(key(seed), round)``, so resuming under a different seed
         would silently diverge from the original run's key sequence.
         """
+        if self._closed:
+            raise RuntimeError(
+                "simulation is closed (close() also released its training "
+                "data, which checkpoints do not carry) — construct a new "
+                "MeshSimulation and load_from() that"
+            )
         template = (
             self.state_dict() if self.params_stack is not None else self._abstract_state
         )
@@ -817,6 +921,9 @@ class MeshSimulation:
             self.c_stack = state["c_stack"]
             self.c_global = state["c_global"]
         self.completed_rounds = int(meta.get("completed_rounds", 0))
+        # Restored state carries training progress: the warmup in run() must
+        # copy, never donate-and-reinit, or resumed progress would be lost.
+        self._pristine = False
         self._dp_steps_per_node = max(
             self._dp_steps_per_node, int(meta.get("dp_steps_per_node", 0))
         )

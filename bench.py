@@ -488,7 +488,9 @@ def run_cifar_bench() -> None:
     on synthetic CIFAR at 56 nodes. Three points: SCAFFOLD (clean, config
     #3), Multi-Krum with 10% of nodes mounting the 10x-scaled-delta
     model-poisoning attack, and FedAvg under the same attack (the
-    undefended contrast). Prints ONE JSON line."""
+    undefended contrast). Prints ONE JSON line; each completed leg is also
+    echoed to stderr immediately (the tunnel can wedge a later leg for
+    hours — a stall must not destroy the legs already measured)."""
     out: dict = {}
     try:
         kind = probe_backend()
@@ -516,6 +518,7 @@ def run_cifar_bench() -> None:
                 "final_test_acc": round(r["final_test_acc"], 4),
                 "poisoned_nodes": len(r["poisoned_nodes"]),
             }
+            _phase(f"cifar leg done: {json.dumps({label: runs[label]})}")
         out = {
             "metric": "cifar_resnet18_federated",
             "value": runs["krum_poisoned"]["sec_per_round"],

@@ -371,6 +371,27 @@ def test_scale_bench_body_rehearsal():
     assert "64 nodes" in out["extra"]["note"]
 
 
+@pytest.mark.slow
+def test_attn_bench_body_rehearsal():
+    """bench.py --attn's measurable body runs end-to-end at tiny scale on
+    the CPU mesh (flash falls back to Pallas interpret mode): all three
+    variants produce timings, the fwd+bwd path computes full q/k/v grads,
+    and the headline reflects the flash fwd throughput."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench
+
+    out = bench.attn_bench_body("cpu-rehearsal", seqs=(128,), iters_cap=8)
+    assert out["metric"] == "attention_kernel_microbench"
+    row = out["extra"]["per_seq"]["128"]
+    for variant in ("dense", "blockwise", "flash"):
+        assert isinstance(row[f"fwd_{variant}_ms"], float)
+        assert isinstance(row[f"fwdbwd_{variant}_ms"], float)
+    assert out["value"] == row["fwd_flash_tflops"]
+
+
 def _tiny_stacked(n=8, s=64):
     rng = np.random.default_rng(0)
     x = rng.normal(size=(n, s, 28, 28)).astype(np.float32)

@@ -124,9 +124,11 @@ SCALE_FEDPROX_MU = 0.01
 
 # --- CIFAR ResNet-18 config (BASELINE.json configs #3/#4) ---------------------
 CIFAR_NODES = 56  # >= 50-node shape, divisible by an 8-wide nodes mesh axis
-CIFAR_SAMPLES = 128
+CIFAR_SAMPLES = 256
 CIFAR_COMMITTEE = 8
-CIFAR_ROUNDS = 20
+CIFAR_ROUNDS = 60  # device time is trivial (~0.2 s/round); training volume
+CIFAR_ROUNDS_PER_CALL = 10  # fuse rounds into one lax.scan'd call
+CIFAR_EVAL_EVERY = 5
 CIFAR_POISON = 0.1
 # 10x-scaled-delta model poisoning: the attack where the defended/undefended
 # contrast is visible at bench scale (label flipping at 10% is survivable by
@@ -639,6 +641,8 @@ def run_cifar_bench() -> None:
             "--nodes", str(CIFAR_NODES), "--rounds", str(CIFAR_ROUNDS),
             "--train-set-size", str(CIFAR_COMMITTEE),
             "--samples-per-node", str(CIFAR_SAMPLES), "--batch-size", "32",
+            "--rounds-per-call", str(CIFAR_ROUNDS_PER_CALL),
+            "--eval-every", str(CIFAR_EVAL_EVERY),
             "--seed", "1",
         ]
         runs = {}
@@ -655,6 +659,7 @@ def run_cifar_bench() -> None:
             runs[label] = {
                 "sec_per_round": round(r["sec_per_round"], 4),
                 "final_test_acc": round(r["final_test_acc"], 4),
+                "acc_curve": [round(a, 3) for a in r["test_acc"]],
                 "poisoned_nodes": len(r["poisoned_nodes"]),
             }
             _phase(f"cifar leg done: {json.dumps({label: runs[label]})}")
@@ -665,6 +670,9 @@ def run_cifar_bench() -> None:
             "extra": {
                 "model": "resnet18-groupnorm", "nodes": CIFAR_NODES,
                 "committee": CIFAR_COMMITTEE, "rounds": CIFAR_ROUNDS,
+                "rounds_per_call": CIFAR_ROUNDS_PER_CALL,
+                "eval_every": CIFAR_EVAL_EVERY,
+                "samples_per_node": CIFAR_SAMPLES,
                 "poison_frac": CIFAR_POISON, "attack": CIFAR_ATTACK,
                 "device_kind": kind,
                 "runs": runs,

@@ -43,6 +43,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--train-set-size", type=int, default=8, help="committee size")
     p.add_argument("--samples-per-node", type=int, default=128)
     p.add_argument(
+        "--rounds-per-call", type=int, default=1,
+        help="rounds fused into one compiled call (amortizes dispatch)",
+    )
+    p.add_argument(
+        "--eval-every", type=int, default=1,
+        help="evaluate every k-th round (final round always evaluated)",
+    )
+    p.add_argument(
         "--poison-frac",
         type=float,
         default=0.0,
@@ -89,6 +97,10 @@ def build_parser() -> argparse.ArgumentParser:
 def run(args: argparse.Namespace) -> dict:
     if not 0.0 <= args.poison_frac < 1.0:
         raise SystemExit(f"--poison-frac must be in [0, 1), got {args.poison_frac}")
+    if args.rounds_per_call < 1:
+        raise SystemExit(f"--rounds-per-call must be >= 1, got {args.rounds_per_call}")
+    if args.eval_every < 1:
+        raise SystemExit(f"--eval-every must be >= 1, got {args.eval_every}")
     if args.aggregator == "scaffold" and args.attack != "labelflip" and args.poison_frac > 0:
         raise SystemExit(
             "model-poisoning attacks (--attack signflip/scaled) need a robust "
@@ -167,7 +179,10 @@ def run(args: argparse.Namespace) -> dict:
         byzantine_mask=byzantine_mask,
         byzantine_attack=args.attack,
     ) as sim:
-        res = sim.run(rounds=args.rounds, epochs=args.epochs, warmup=True)
+        res = sim.run(
+            rounds=args.rounds, epochs=args.epochs, warmup=True,
+            rounds_per_call=args.rounds_per_call, eval_every=args.eval_every,
+        )
     return {
         "mode": "mesh",
         "model": "resnet18-groupnorm",

@@ -264,6 +264,14 @@ def bench_tpu(budget_deadline: float = float("inf")) -> dict:
         if best is None or out["sec_per_round"] < best["sec_per_round"]:
             best = out
     best["rounds_per_call_sweep"] = {str(k): round(v, 6) for k, v in sweep.items()}
+    if 1 in sweep and 10 in sweep:
+        # The sweep doubles as a dispatch probe: going 1->10 rounds/call
+        # removes 9 of 10 per-call overheads, so the spread estimates the
+        # tunnel's fixed cost — the floor under sec/round on THIS link
+        # (non-tunneled hardware would sit lower at identical device time).
+        best["est_dispatch_s_per_call"] = round(
+            max(0.0, (sweep[1] - sweep[10]) * 10.0 / 9.0), 4
+        )
     return best
 
 
@@ -957,6 +965,7 @@ def main() -> None:
             "label_flip": LABEL_FLIP,
             "rounds_per_call": tpu["rounds_per_call"],
             "rounds_per_call_sweep": tpu.get("rounds_per_call_sweep"),
+            "est_dispatch_s_per_call": tpu.get("est_dispatch_s_per_call"),
             "baseline": base.get("baseline"),
             "baseline_sec_per_round": round(base["sec_per_round"], 4),
             # Baseline's own shape: makes a ladder fall-through (e.g. the

@@ -35,10 +35,26 @@ aggregates initial weights and that accuracy stays ~random — see the
 baseline "note" field and SURVEY.md §7 quirks.)
 
 If the TPU probe fails (the tunneled chip can be unreachable for hours),
-the metric is measured at reduced scale on the 8-device virtual CPU mesh
-in a fresh subprocess (the wedged client init holds jax's backend lock in
-this process) and labeled with an explicit ``scale_note`` — an honest
-smaller number instead of no number.
+the bench does NOT give up after minutes (rounds 3 and 4 lost the capture
+race exactly that way — the outage pattern is hours-scale with spontaneous
+recovery). Instead the parent process is a pure orchestrator that never
+imports jax (so a wedged backend init can never poison it) and:
+
+1. probes the chip in a SUBPROCESS (a hang is killed, not inherited);
+2. while the tunnel is down, pre-computes the honest degraded fallback
+   (reduced-scale CPU-mesh measurement + matched-node-count reference
+   baseline) so a numeric answer is ready at any instant;
+3. keeps re-probing with backoff until only the measurement reserve of
+   the soft budget remains, then prints the degraded line;
+4. if the tunnel returns in time, runs the full TPU measurement (itself a
+   subprocess) followed by the reference baseline, and prints the real
+   line;
+5. on SIGTERM/SIGINT (an impatient driver), immediately prints the best
+   line it has — degraded beats empty.
+
+The soft budget defaults to 3000 s and is tunable via
+``P2PFL_TPU_BENCH_BUDGET``; the wait ladder consumes whatever the
+measurement reserve (~900 s) does not need.
 
 Always prints exactly ONE JSON line:
 {"metric", "value", "unit", "vs_baseline", "extra", ["error"]}.
@@ -48,6 +64,7 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import threading
@@ -136,6 +153,18 @@ CIFAR_POISON = 0.1
 # while Multi-Krum's distance filter excludes the attackers).
 CIFAR_ATTACK = "scaled"
 
+# --- multi-host config (--multihost: the bench path across processes) -------
+# 2 OS processes x 4 virtual CPU devices each -> an 8-wide process-spanning
+# "nodes" mesh axis (the CI-runnable analogue of a DCN-spanning pod slice;
+# the reference's counterpart is Ray-cluster scale-out, actor_pool.py:69).
+# 96 nodes ~ the north-star population rounded to the mesh axis width.
+MH_PROCS = 2
+MH_DEVICES_PER_PROC = 4
+MH_NODES = 96
+MH_SAMPLES = 192  # CPU-affordable; override via P2PFL_TPU_MH_* for full shape
+MH_ROUNDS = 10
+MH_RPC = 5
+
 # Reference-baseline attempt ladder: (nodes, rounds, subprocess timeout).
 # The reference's flax learner is unjitted at batch size 1, so its rounds
 # take minutes; measuring it at fewer nodes than the 100-node metric shape
@@ -178,6 +207,63 @@ def probe_backend(attempts: int = 2, timeout: float = 180.0) -> str:
         if attempt < attempts:  # no backoff after the final attempt
             time.sleep(min(30.0, 5.0 * attempt))
     raise RuntimeError(f"TPU backend unavailable: {last_err[0]}")
+
+
+def _subprocess_tpu_probe(timeout: float = 90.0) -> str | None:
+    """Probe the tunneled chip in a THROWAWAY subprocess.
+
+    The tunnel's failure mode is a backend init that hangs forever while
+    holding jax's process-wide backend lock — an in-process probe that
+    wedges poisons every later in-process retry (round-2 lesson). A
+    subprocess probe is killed on timeout and leaves the parent pristine,
+    so the wait ladder can probe for as long as the budget allows.
+    Returns the device kind (e.g. "TPU v5 lite") or None.
+    """
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # the parent may have pinned cpu
+    code = (
+        "import jax\n"
+        "d = jax.devices()[0]\n"
+        "print(f'{d.platform}|{d.device_kind}', flush=True)\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout, env=env,
+        )
+        line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+        platform, _, kind = line.partition("|")
+        if platform.lower() == "tpu" and kind:
+            return kind
+    except subprocess.TimeoutExpired:
+        pass
+    except Exception:  # noqa: BLE001 — a broken probe reads as "down"
+        traceback.print_exc(file=sys.stderr)
+    return None
+
+
+def wait_for_tpu(deadline: float, probe_timeout: float = 90.0) -> str | None:
+    """Retry ladder: subprocess-probe the chip with backoff until it
+    answers or ``deadline`` (time.monotonic clock) nears. The outage
+    pattern is hours-scale with spontaneous recovery, so patience here is
+    the whole game — six minutes of it lost rounds 3 and 4."""
+    attempt = 0
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining < probe_timeout:
+            _phase("wait ladder: reserve reached, giving up on the tunnel")
+            return None
+        attempt += 1
+        _phase(
+            f"wait ladder: probe {attempt} (up to {probe_timeout:.0f}s; "
+            f"{remaining:.0f}s of wait budget left)"
+        )
+        kind = _subprocess_tpu_probe(probe_timeout)
+        if kind:
+            _phase(f"wait ladder: tunnel UP after {attempt} probe(s): {kind}")
+            return kind
+        # Short sleeps early (catch a quick flap), 120s cruise after.
+        time.sleep(min(120.0, 30.0 * attempt))
 
 
 def _make_data(num_nodes: int, samples: int, test_samples: int, seed: int = 42):
@@ -335,6 +421,81 @@ def measure_cpu_fallback(budget: float) -> dict:
     return _json_subprocess(["--cpu-fallback"], max(120.0, budget), env)
 
 
+def _train_path_probe(
+    device_kind: str, model, x, y, matmul_params: int,
+    members: int = COMMITTEE, batch: int = MFU_BATCH, steps: int = 64,
+) -> dict:
+    """Isolated fit-path utilization: ``members`` vmapped member steps
+    chained under ONE ``lax.scan`` — no vote, no gather/diffuse, no eval,
+    no optimizer-state re-init. Round 4 claimed "66-83% once per-round
+    machinery amortizes" from component isolation but never landed it in
+    an artifact (VERDICT r4 weak #6); this measures that exact quantity
+    into the MFU probe's JSON. Params chain step-to-step, so every
+    iteration's inputs differ structurally (replay-proof by construction).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax import lax
+
+    samples = x.shape[1]
+    n_batches = samples // batch
+    xk = x[:members, : n_batches * batch].reshape(
+        members, n_batches, batch, *x.shape[2:]
+    )
+    yk = y[:members, : n_batches * batch].reshape(members, n_batches, batch)
+    tx = optax.adam(1e-3)
+    p0 = model.params
+    stack = jax.tree.map(
+        lambda a: jnp.broadcast_to(a, (members,) + a.shape) + 0.0, p0
+    )
+    opt0 = jax.vmap(tx.init)(stack)
+
+    def member_step(p, o, bx, by):
+        def loss_fn(pp):
+            logits = model.apply_fn(pp, bx)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, by
+            ).mean()
+
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o, loss
+
+    @jax.jit
+    def run(stack, opt):
+        def body(carry, i):
+            stack, opt = carry
+            bi = i % n_batches
+            bx = lax.dynamic_index_in_dim(xk, bi, axis=1, keepdims=False)
+            by = lax.dynamic_index_in_dim(yk, bi, axis=1, keepdims=False)
+            stack, opt, loss = jax.vmap(member_step)(stack, opt, bx, by)
+            return (stack, opt), loss.mean()
+
+        (stack, opt), losses = lax.scan(body, (stack, opt), jnp.arange(steps))
+        return stack, opt, losses[-1]
+
+    stack1, opt1, last = run(stack, opt0)  # compile + warmup
+    np.asarray(last)
+    t0 = time.monotonic()
+    stack2, opt2, last = run(stack1, opt1)  # warmed call, distinct inputs
+    np.asarray(last)
+    dt = time.monotonic() - t0
+    flops = members * steps * 6.0 * batch * matmul_params
+    achieved = flops / dt
+    peak = PEAK_FLOPS.get(device_kind)
+    return {
+        "members": members, "batch": batch, "steps": steps,
+        "seconds": round(dt, 4),
+        "achieved_tflops": round(achieved / 1e12, 3),
+        "mfu": round(achieved / peak, 4) if peak else None,
+        "note": "pure fit path (vmapped member steps under one scan): no "
+        "vote/gather/diffuse/eval — the training-kernel ceiling the "
+        "full-round MFU is measured against",
+    }
+
+
 def bench_mfu(device_kind: str) -> dict:
     """Wide-MLP utilization probe: analytic FLOPs / measured time vs peak."""
     from p2pfl_tpu.models import mlp_model
@@ -356,6 +517,12 @@ def bench_mfu(device_kind: str) -> dict:
             rounds=MFU_ROUNDS, epochs=MFU_EPOCHS, warmup=True,
             rounds_per_call=MFU_ROUNDS, eval_every=MFU_EVAL_EVERY,
         )
+
+    try:
+        train_path = _train_path_probe(device_kind, model, x, y, matmul_params)
+    except Exception as e:  # noqa: BLE001 — the probe must not kill the MFU row
+        traceback.print_exc(file=sys.stderr)
+        train_path = {"error": f"{type(e).__name__}: {e}"}
 
     steps_per_epoch = MFU_SAMPLES_PER_NODE // MFU_BATCH
     steps_per_round = steps_per_epoch * MFU_EPOCHS
@@ -413,8 +580,199 @@ def bench_mfu(device_kind: str) -> dict:
         "assumed_peak_tflops": round(peak / 1e12, 1) if peak else None,
         "mfu": round(achieved / peak, 4) if peak else None,
         "roofline": roofline,
+        "train_path_probe": train_path,
         "note": "utilization probe (random labels); parity metrics come from the 100-node config",
     }
+
+
+def _mh_cfg() -> dict:
+    """Multi-host shape, env-overridable (the slow test shrinks it)."""
+    g = lambda k, d: int(os.environ.get(f"P2PFL_TPU_MH_{k}", d))  # noqa: E731
+    return {
+        "procs": g("PROCS", MH_PROCS),
+        "devices_per_proc": g("DEVICES", MH_DEVICES_PER_PROC),
+        "nodes": g("NODES", MH_NODES),
+        "samples": g("SAMPLES", MH_SAMPLES),
+        "rounds": g("ROUNDS", MH_ROUNDS),
+        "rpc": g("RPC", MH_RPC),
+    }
+
+
+def run_multihost() -> None:
+    """Orchestrator for ``--multihost``: spawn N worker processes that join
+    one jax.distributed deployment (N x 4 virtual CPU devices -> one
+    process-spanning ``nodes`` mesh axis) and run the FULL bench path —
+    MeshSimulation with fused rounds_per_call, warmup, eval — as a single
+    SPMD program across processes. Process 0's JSON line is reprinted here.
+
+    This is the runnable counterpart of the reference's Ray-cluster
+    scale-out (actor_pool.py:69): same launch shape as a real pod slice
+    (per-host processes + a coordinator), CPU devices standing in for
+    chips. Launch: ``python bench.py --multihost``.
+    """
+    import socket
+
+    cfg = _mh_cfg()
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {k: v for k, v in os.environ.items() if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, os.path.join(REPO, "bench.py"),
+                "--multihost-worker", str(port), str(pid),
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+        )
+        for pid in range(cfg["procs"])
+    ]
+    # Drain all worker pipes CONCURRENTLY: the workers run one lockstep
+    # SPMD program, so a worker blocked writing >64KB of unread stdout
+    # (jax warnings + _phase lines) inside a collective would deadlock the
+    # whole deployment if we drained sequentially.
+    outs: list[str] = [""] * len(procs)
+
+    def _drain(i: int, p) -> None:
+        try:
+            outs[i], _ = p.communicate(timeout=1800)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            outs[i], _ = p.communicate()
+
+    drains = [
+        threading.Thread(target=_drain, args=(i, p), daemon=True)
+        for i, p in enumerate(procs)
+    ]
+    for t in drains:
+        t.start()
+    for t in drains:
+        t.join()
+    line = None
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        tail = out[-2500:]
+        if p.returncode != 0:
+            print(json.dumps({"error": f"multihost worker {pid} rc={p.returncode}: {tail}"}))
+            os._exit(1)
+        if pid == 0:
+            for ln in reversed(out.strip().splitlines()):
+                if ln.startswith("{"):
+                    line = ln
+                    break
+    if line is None:
+        print(json.dumps({"error": f"worker 0 printed no JSON: {outs[0][-2500:]}"}))
+        os._exit(1)
+    print(line, flush=True)
+    os._exit(0)
+
+
+def run_multihost_worker(port: int, pid: int) -> None:
+    """Worker body for ``--multihost``: join the deployment, build the
+    process-spanning mesh, run the metric simulation, report (pid 0)."""
+    cfg = _mh_cfg()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={cfg['devices_per_proc']}"
+    ).strip()
+    import jax
+    import numpy as np
+
+    jax.config.update("jax_platforms", "cpu")
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.parallel.mesh import initialize_multihost, make_mesh
+    from p2pfl_tpu.parallel.simulation import MeshSimulation
+
+    initialize_multihost(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=cfg["procs"], process_id=pid,
+    )
+    n_global = cfg["procs"] * cfg["devices_per_proc"]
+    assert len(jax.devices()) == n_global, (len(jax.devices()), n_global)
+    mesh = make_mesh()
+    _phase(f"multihost worker {pid}: mesh over {n_global} devices, "
+           f"{jax.process_count()} processes")
+
+    # Host-side numpy data with identical seeds in every process (SPMD
+    # requires all processes to feed the same logical arrays); semantics
+    # mirror _make_data (class templates + noise + label flip).
+    n, s = cfg["nodes"], cfg["samples"]
+    rng = np.random.default_rng(42)
+    templates = rng.uniform(size=(10, 28, 28)).astype(np.float32)
+    y = rng.integers(0, 10, size=(n, s)).astype(np.int32)
+    x = np.clip(
+        templates[y] + NOISE * rng.normal(size=(n, s, 28, 28)), 0.0, 1.0
+    ).astype(np.float32)
+    flip = rng.uniform(size=y.shape) < LABEL_FLIP
+    y[flip] = rng.integers(0, 10, size=int(flip.sum()))
+    yt = rng.integers(0, 10, size=TEST_SAMPLES).astype(np.int32)
+    xt = np.clip(
+        templates[yt] + NOISE * rng.normal(size=(TEST_SAMPLES, 28, 28)), 0.0, 1.0
+    ).astype(np.float32)
+    flip_t = rng.uniform(size=yt.shape) < LABEL_FLIP
+    yt[flip_t] = rng.integers(0, 10, size=int(flip_t.sum()))
+    mask = np.ones((n, s), np.float32)
+
+    with MeshSimulation(
+        mlp_model(seed=0), (x, y, mask), test_data=(xt, yt),
+        train_set_size=COMMITTEE, batch_size=BATCH, seed=1, mesh=mesh,
+    ) as sim:
+        res = sim.run(
+            rounds=cfg["rounds"], epochs=EPOCHS, warmup=True,
+            rounds_per_call=cfg["rpc"],
+        )
+    out = {
+        "metric": f"sec_per_round_{n}node_mnist_fedavg_multihost_cpu",
+        "value": round(res.seconds_per_round, 6),
+        "unit": "s/round",
+        "extra": {
+            "processes": cfg["procs"],
+            "devices_per_process": cfg["devices_per_proc"],
+            "global_devices": n_global,
+            "nodes": n, "rounds": cfg["rounds"], "rounds_per_call": cfg["rpc"],
+            "samples_per_node": s, "committee": COMMITTEE,
+            "final_test_acc": round(float(res.test_acc[-1]), 4),
+            "note": "bench path over a 2-process jax.distributed mesh (CPU "
+            "devices standing in for chips); launch: python bench.py --multihost",
+        },
+    }
+    if pid == 0:
+        print(json.dumps(out), flush=True)
+    else:
+        print(f"MULTIHOST_WORKER_OK pid={pid} acc={res.test_acc[-1]:.4f}", flush=True)
+    os._exit(0)
+
+
+def run_tpu_metric(budget: float) -> None:
+    """Subprocess body: the full on-chip measurement — backend init, the
+    rounds_per_call metric sweep, and the MFU probe — in a FRESH process.
+
+    The orchestrating parent never imports jax, so a backend wedge here
+    (tunnel flapping mid-init) dies with this subprocess instead of
+    poisoning the parent's later options. Prints ONE JSON line:
+    {"tpu": {...}, "mfu": {...}, "kind": "..."} or {"error": "..."}.
+    """
+    out: dict = {}
+    t0 = time.monotonic()
+    try:
+        kind = probe_backend()
+        tpu = bench_tpu(budget_deadline=t0 + budget * 0.6)
+        if time.monotonic() - t0 > budget * 0.7:
+            _phase("tpu-metric: soft budget tight, skipping MFU probe")
+            mfu: dict = {"skipped": "soft time budget"}
+        else:
+            try:
+                mfu = bench_mfu(kind)
+            except Exception as e:  # noqa: BLE001 — MFU must not kill the metric
+                traceback.print_exc(file=sys.stderr)
+                mfu = {"error": f"{type(e).__name__}: {e}"}
+        out = {"tpu": tpu, "mfu": mfu, "kind": kind}
+    except Exception as e:  # noqa: BLE001
+        traceback.print_exc(file=sys.stderr)
+        out = {"error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(out), flush=True)
+    os._exit(1 if "error" in out else 0)
 
 
 def scale_bench_body(kind: str, n: int = SCALE_NODES, s: int = SCALE_SAMPLES,
@@ -461,7 +819,10 @@ def scale_bench_body(kind: str, n: int = SCALE_NODES, s: int = SCALE_SAMPLES,
             rounds_per_call=rounds, eval_every=5,
         )
     return {
-        "metric": f"sec_per_round_{n}node_dirichlet_fedprox",
+        # "synthetic" in the metric name: the accuracy column is on
+        # template+noise Dirichlet blobs and must not read as a real-CIFAR
+        # parity claim (VERDICT r4 weak #5); the THROUGHPUT is the point.
+        "metric": f"sec_per_round_{n}node_dirichlet_fedprox_synthetic",
         "value": round(res.seconds_per_round, 6),
         "unit": "s/round",
         "extra": {
@@ -469,6 +830,8 @@ def scale_bench_body(kind: str, n: int = SCALE_NODES, s: int = SCALE_SAMPLES,
             "samples_per_node": s, "alpha": SCALE_ALPHA,
             "fedprox_mu": SCALE_FEDPROX_MU,
             "final_test_acc": round(res.test_acc[-1], 4),
+            "accuracy_data": "synthetic template+noise blobs (class-template "
+            "MNIST-shaped); throughput is the comparison, accuracy is sanity",
             "device_kind": kind,
             "note": "reference collapses at 100 in-process nodes "
             f"(BASELINE.md: heartbeat convergence fails); this is {n} nodes "
@@ -536,14 +899,18 @@ def attn_bench_body(kind: str, seqs=(1024, 2048, 4096, 8192), iters_cap: int = 6
                 if grad:
                     dq, dk, dv = body(q, k, v)
                     # Fold every grad back in: keeps dk/dv live and makes
-                    # each iteration's inputs distinct (replay-proof).
-                    q = q + (1e-6 * dq).astype(q.dtype)
-                    k = k + (1e-6 * dk).astype(k.dtype)
-                    v = v + (1e-6 * dv).astype(v.dtype)
+                    # each iteration's inputs distinct (replay-proof). The
+                    # 1e-2 scale sits above bf16 ulp at |x|~1 (~4e-3), so
+                    # the change is structural, not just in rare tiny
+                    # elements; softmax saturation from the slow drift
+                    # changes no FLOPs.
+                    q = q + (1e-2 * dq).astype(q.dtype)
+                    k = k + (1e-2 * dk).astype(k.dtype)
+                    v = v + (1e-2 * dv).astype(v.dtype)
                     probe = dq.reshape(-1)[0]
                 else:
                     out = body(q, k, v)
-                    q = q + (1e-6 * out).astype(q.dtype)  # data-dependence
+                    q = q + (1e-2 * out).astype(q.dtype)  # data-dependence
                     probe = out.reshape(-1)[0]
                 return (q, k, v), probe
             (q, k, v), last = lax.scan(step, (q, k, v), None, length=iters)
@@ -632,6 +999,134 @@ def run_attn_bench() -> None:
     os._exit(1 if "error" in out else 0)
 
 
+def _production_mfu_row(model: str, kind: str, cost: dict, sec_per_round: float) -> dict:
+    """MFU + roofline for a production model's federated round, from XLA's
+    own cost analysis of the compiled program (VERDICT r4 #6: no more
+    purpose-built-MLP-only utilization numbers)."""
+    flops_per_round = cost["flops_per_round"]
+    bytes_per_round = cost.get("bytes_accessed_per_round", 0.0)
+    achieved = flops_per_round / sec_per_round
+    peak = PEAK_FLOPS.get(kind)
+    bw = HBM_BW.get(kind)
+    row = {
+        "model": model,
+        "flops_per_round": flops_per_round,
+        "bytes_accessed_per_round": bytes_per_round,
+        "sec_per_round": round(sec_per_round, 6),
+        "achieved_tflops": round(achieved / 1e12, 3),
+        "assumed_peak_tflops": round(peak / 1e12, 1) if peak else None,
+        "mfu": round(achieved / peak, 4) if peak else None,
+        "source": "XLA cost_analysis of the compiled round program",
+    }
+    if peak and bw and bytes_per_round:
+        t_flops = flops_per_round / peak
+        t_hbm = bytes_per_round / bw
+        row["roofline"] = {
+            "arithmetic_intensity_flop_per_byte": round(
+                flops_per_round / bytes_per_round, 1
+            ),
+            "ridge_flop_per_byte": round(peak / bw, 1),
+            "t_mxu_ms": round(t_flops * 1e3, 2),
+            "t_hbm_ms": round(t_hbm * 1e3, 2),
+            "mfu_ceiling": round(t_flops / max(t_flops, t_hbm), 3),
+            "note": "XLA 'bytes accessed' counts logical operand traffic; "
+            "fusion makes real HBM traffic lower, so t_hbm is pessimistic",
+        }
+    return row
+
+
+# --- transformer-LM MFU config (--lm-mfu) ------------------------------------
+# A production-shaped causal-LM federated round: 8 nodes, committee 4, flash
+# attention, bf16. Sized so one fused 5-round call is compute-dominated on
+# the tunnel (~1s+ of device work) without a long compile.
+LM_NODES = 8
+LM_COMMITTEE = 4
+LM_SEQS_PER_NODE = 64
+LM_SEQ_LEN = 1024
+LM_VOCAB = 8192
+LM_LAYERS = 4
+LM_HEADS = 8
+LM_EMBED = 512
+LM_BATCH = 8
+LM_ROUNDS = 5
+
+
+def lm_mfu_body(kind: str, nodes: int = LM_NODES, seqs: int = LM_SEQS_PER_NODE,
+                seq_len: int = LM_SEQ_LEN, rounds: int = LM_ROUNDS,
+                vocab: int = LM_VOCAB, layers: int = LM_LAYERS,
+                heads: int = LM_HEADS, embed: int = LM_EMBED,
+                batch: int = LM_BATCH, attention: str = "flash") -> dict:
+    """Federated transformer-LM round (MeshSimulation task='lm', flash
+    attention) with XLA-cost-analysis MFU — the measurable body, probe-free
+    and fully parameterized so the CPU mesh can rehearse it at tiny scale."""
+    import numpy as np
+
+    from p2pfl_tpu.models import transformer_lm_model
+    from p2pfl_tpu.parallel.simulation import MeshSimulation
+
+    rng = np.random.default_rng(5)
+    starts = rng.integers(0, vocab, size=(nodes, seqs, 1))
+    x = ((starts + np.arange(seq_len)[None, None, :]) % vocab).astype(np.int32)
+    y = np.zeros((nodes, seqs), np.int32)  # unused for task="lm"
+    mask = np.ones((nodes, seqs), np.float32)
+    xt = (
+        (rng.integers(0, vocab, size=(16, 1)) + np.arange(seq_len)) % vocab
+    ).astype(np.int32)
+
+    model = transformer_lm_model(
+        seed=0, seq_len=seq_len, vocab_size=vocab, num_layers=layers,
+        num_heads=heads, embed_dim=embed, attention_kind=attention,
+    )
+    _phase(f"lm-mfu: {layers}L/{embed}d/{heads}h seq={seq_len} "
+           f"vocab={vocab} nodes={nodes}")
+    with MeshSimulation(
+        model, (x, y, mask), test_data=(xt, None),
+        train_set_size=min(LM_COMMITTEE, nodes), batch_size=batch,
+        lr=3e-4, seed=1, task="lm",
+    ) as sim:
+        res = sim.run(rounds=rounds, epochs=1, warmup=True, rounds_per_call=rounds)
+        cost = sim.round_cost_analysis(rounds_per_call=rounds)
+    out = {
+        "metric": "transformer_lm_federated_round_mfu",
+        "value": None,
+        "unit": "mfu",
+        "extra": {
+            "device_kind": kind,
+            "nodes": nodes, "committee": min(LM_COMMITTEE, nodes),
+            "seq_len": seq_len, "layers": layers, "embed": embed,
+            "heads": heads, "vocab": vocab, "batch": batch,
+            "rounds": rounds, "attention": attention,
+            "sec_per_round": round(res.seconds_per_round, 6),
+            "final_token_loss": round(res.test_loss[-1], 4),
+        },
+    }
+    if cost:
+        row = _production_mfu_row(
+            f"transformer-lm-{layers}L-{embed}d-federated-round",
+            kind, cost, res.seconds_per_round,
+        )
+        out["value"] = row.get("mfu")
+        out["extra"]["mfu_row"] = row
+    else:
+        out["extra"]["mfu_row"] = {"error": "backend exposes no cost analysis"}
+        out["value"] = 0.0
+    return out
+
+
+def run_lm_mfu() -> None:
+    """Subprocess-style mode: transformer-LM federated-round MFU on the
+    real chip. Prints ONE JSON line."""
+    out: dict = {}
+    try:
+        kind = probe_backend()
+        out = lm_mfu_body(kind)
+    except Exception as e:  # noqa: BLE001
+        traceback.print_exc(file=sys.stderr)
+        out["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out), flush=True)
+    os._exit(1 if "error" in out else 0)
+
+
 def run_cifar_bench() -> None:
     """Subprocess-style mode: configs #3/#4 — federated GroupNorm ResNet-18
     on synthetic CIFAR at 56 nodes. Three points: SCAFFOLD (clean, config
@@ -654,11 +1149,14 @@ def run_cifar_bench() -> None:
             "--seed", "1",
         ]
         runs = {}
+        mfu_row = None
         poison = [
             "--poison-frac", str(CIFAR_POISON), "--attack", CIFAR_ATTACK,
         ]
         for label, extra in (
-            ("scaffold_clean", ["--aggregator", "scaffold"]),
+            # Cost analysis on the first leg only: the program's FLOPs are
+            # identical across legs modulo the aggregation rule's epsilon.
+            ("scaffold_clean", ["--aggregator", "scaffold", "--cost-analysis"]),
             ("krum_poisoned", ["--aggregator", "krum", *poison]),
             ("fedavg_poisoned", ["--aggregator", "fedavg", *poison]),
         ):
@@ -670,6 +1168,11 @@ def run_cifar_bench() -> None:
                 "acc_curve": [round(a, 3) for a in r["test_acc"]],
                 "poisoned_nodes": len(r["poisoned_nodes"]),
             }
+            if r.get("cost_analysis"):
+                mfu_row = _production_mfu_row(
+                    "resnet18-groupnorm-federated-round", kind,
+                    r["cost_analysis"], r["sec_per_round"],
+                )
             _phase(f"cifar leg done: {json.dumps({label: runs[label]})}")
         out = {
             "metric": "cifar_resnet18_federated",
@@ -684,6 +1187,7 @@ def run_cifar_bench() -> None:
                 "poison_frac": CIFAR_POISON, "attack": CIFAR_ATTACK,
                 "device_kind": kind,
                 "runs": runs,
+                "mfu": mfu_row,
                 "note": "BASELINE configs #3/#4: reference has no runnable "
                 "CIFAR/robust composition to compare against",
             },
@@ -881,6 +1385,70 @@ def bench_torch_cpu_fallback() -> dict:
     }
 
 
+def _assemble(out: dict, tpu: dict, base: dict, kind: str, mfu: dict) -> None:
+    """Fill the output line from a measurement + baseline pair. ONE
+    assembler for the TPU and degraded paths so their JSON shapes can
+    never drift apart."""
+    value = tpu["sec_per_round"]
+    out["value"] = round(value, 6)
+    out["vs_baseline"] = round(base["sec_per_round"] / value, 3)
+    out["extra"] = {
+        "rounds_per_sec": round(tpu["rounds_per_sec"], 3),
+        "final_test_acc": round(tpu["final_test_acc"], 4),
+        "label_flip": LABEL_FLIP,
+        "rounds_per_call": tpu["rounds_per_call"],
+        "rounds_per_call_sweep": tpu.get("rounds_per_call_sweep"),
+        "est_dispatch_s_per_call": tpu.get("est_dispatch_s_per_call"),
+        "baseline": base.get("baseline"),
+        "baseline_sec_per_round": round(base["sec_per_round"], 4),
+        # Baseline's own shape: makes a ladder fall-through (e.g. the
+        # matched-count rung failing in degraded mode) visible in the
+        # JSON rather than silently skewing vs_baseline.
+        "baseline_nodes": base.get("nodes"),
+        "baseline_rounds": base.get("rounds"),
+        "baseline_final_test_acc": base.get("final_test_acc"),
+        "baseline_note": base.get("note"),
+        "device_kind": kind,
+        "mfu_probe": mfu,
+        "rounds": tpu.get("rounds", ROUNDS),
+        "nodes": tpu.get("nodes", NUM_NODES),
+        "committee": COMMITTEE,
+    }
+
+
+def _measure_degraded(out_template: dict) -> dict:
+    """The honest tunnel-down answer: reduced-scale CPU-mesh measurement
+    plus a matched-node-count reference baseline (apples-to-apples ratio),
+    assembled into a fully-labeled degraded output line. Takes ~4 min; the
+    orchestrator runs it BEFORE settling into the wait ladder so a numeric
+    line is on hand the moment anything (deadline, SIGTERM) ends the wait."""
+    tpu = measure_cpu_fallback(450.0)
+    try:
+        base = measure_reference_baseline(
+            900.0, ladder=[(tpu["nodes"], 1, 700.0), (4, 1, 240.0)]
+        )
+    except Exception as e:  # noqa: BLE001
+        traceback.print_exc(file=sys.stderr)
+        _phase(f"degraded baseline failed ({e}); falling back to torch loop")
+        base = bench_torch_cpu_fallback()
+    d = json.loads(json.dumps(out_template))
+    _assemble(
+        d, tpu, base, "cpu (TPU unavailable)",
+        {"skipped": "TPU unavailable (reduced-scale CPU fallback)"},
+    )
+    # Relabel the metric and flag degradation at TOP level: a consumer
+    # parsing only {metric, value, vs_baseline} must never mistake the
+    # reduced-scale CPU number for the 100-node result.
+    d["metric"] = f"sec_per_round_{tpu['nodes']}node_mnist_fedavg_cpu_fallback"
+    d["degraded"] = True
+    d["extra"]["scale_note"] = (
+        f"TPU tunnel down: measured at {tpu['nodes']} nodes x "
+        f"{tpu['rounds']} rounds on the 8-device virtual CPU mesh "
+        f"(metric shape is {NUM_NODES} nodes x {ROUNDS} rounds)"
+    )
+    return d
+
+
 def main() -> None:
     out = {
         "metric": "sec_per_round_100node_mnist_fedavg",
@@ -889,65 +1457,67 @@ def main() -> None:
         "vs_baseline": None,
         "extra": {},
     }
+    best: dict = {}  # best-available complete line (the degraded fallback)
+
+    def _bail(signum, _frame):
+        # An impatient driver sends TERM: a degraded-but-numeric line (if
+        # the fallback finished measuring) still beats an empty capture.
+        line = best or {
+            **out,
+            "degraded": True,
+            "error": f"terminated by signal {signum} while waiting for TPU",
+        }
+        print(json.dumps(line), flush=True)
+        os._exit(1 if "error" in line else 0)
+
+    signal.signal(signal.SIGTERM, _bail)
+    signal.signal(signal.SIGINT, _bail)
+
     t_start = time.monotonic()
     try:
         try:
-            soft_budget = float(os.environ.get("P2PFL_TPU_BENCH_BUDGET", "1500"))
+            soft_budget = float(os.environ.get("P2PFL_TPU_BENCH_BUDGET", "3000"))
         except ValueError:
-            soft_budget = 1500.0
-        scale_note = None
-        try:
-            kind = probe_backend()
-        except RuntimeError as probe_err:
-            # The tunneled chip can be down for hours; a reduced-scale CPU
-            # measurement (same code path, same measured baseline, honestly
-            # labeled) beats an error line with no number.
-            _phase(f"{probe_err}; falling back to reduced-scale CPU-mesh run")
-            kind = None
+            soft_budget = 3000.0
+        # Reserve: TPU-metric subprocess (~300-500s: 3 sweep compiles + MFU)
+        # + 20-node reference baseline (~350s) + margin.
+        reserve = min(900.0, soft_budget * 0.5)
+
+        kind = _subprocess_tpu_probe(90.0)
         if kind is None:
-            tpu = measure_cpu_fallback(soft_budget * 0.3)
-            kind = "cpu (TPU unavailable)"
-            mfu = {"skipped": "TPU unavailable (reduced-scale CPU fallback)"}
-            # Relabel the metric and flag degradation at TOP level: a
-            # consumer parsing only {metric, value, vs_baseline} must never
-            # mistake the reduced-scale CPU number for the 100-node result.
-            out["metric"] = (
-                f"sec_per_round_{tpu['nodes']}node_mnist_fedavg_cpu_fallback"
+            _phase(
+                "tunnel down at first probe: pre-computing the degraded "
+                "fallback, then holding the wait ladder until the reserve"
             )
-            out["degraded"] = True
-            scale_note = (
-                f"TPU tunnel down: measured at {tpu['nodes']} nodes x "
-                f"{tpu['rounds']} rounds on the 8-device virtual CPU mesh "
-                f"(metric shape is {NUM_NODES} nodes x {ROUNDS} rounds)"
+            try:
+                best = _measure_degraded(out)
+                _phase(f"degraded fallback ready: {best['metric']} = {best['value']}")
+            except Exception as e:  # noqa: BLE001 — waiting is still worthwhile
+                traceback.print_exc(file=sys.stderr)
+                _phase(f"degraded fallback failed ({e}); wait ladder anyway")
+            kind = wait_for_tpu(deadline=t_start + soft_budget - reserve)
+        if kind is None:
+            if best:
+                print(json.dumps(best), flush=True)
+                os._exit(0)
+            raise RuntimeError(
+                "TPU unavailable for the whole wait budget and the degraded "
+                "fallback also failed"
             )
-        else:
-            tpu = bench_tpu(budget_deadline=t_start + soft_budget * 0.45)
-            # A slow tunnel/compile must not push the whole bench past the
-            # driver's patience: when over half the soft budget is gone, skip
-            # the MFU probe and use the fast fallback baseline.
-            tight = time.monotonic() - t_start > soft_budget * 0.5
-            if tight:
-                _phase("soft budget tight: skipping MFU probe")
-                mfu = {"skipped": "soft time budget"}
-            else:
-                try:
-                    mfu = bench_mfu(kind)
-                except Exception as e:  # noqa: BLE001 — MFU must not kill the metric
-                    traceback.print_exc(file=sys.stderr)
-                    mfu = {"error": f"{type(e).__name__}: {e}"}
+
+        # --- tunnel is up: full measurement, subprocess-contained ---------
+        remaining = soft_budget - (time.monotonic() - t_start)
+        metric_cap = max(420.0, remaining - 420.0)  # keep ~7 min for baseline
+        _phase(f"TPU up ({kind}): metric subprocess (cap {metric_cap:.0f}s)")
+        tm = _json_subprocess(
+            ["--tpu-metric", str(metric_cap * 0.9)], metric_cap, dict(os.environ)
+        )
         _phase("measuring reference baseline (subprocess, CPU)")
         try:
             remaining = soft_budget - (time.monotonic() - t_start)
             if remaining < 240.0:
                 _phase("soft budget tight: using torch-loop fallback baseline")
                 base = bench_torch_cpu_fallback()
-            elif scale_note is not None:
-                # Degraded run: baseline at the SAME node count as the
-                # fallback measurement (apples-to-apples ratio).
-                base = measure_reference_baseline(
-                    remaining,
-                    ladder=[(tpu["nodes"], 1, 700.0), (4, 1, 240.0)],
-                )
             else:
                 base = measure_reference_baseline(remaining)
         except Exception as e:  # noqa: BLE001
@@ -955,36 +1525,25 @@ def main() -> None:
             _phase(f"reference baseline failed ({e}); falling back to torch loop")
             base = bench_torch_cpu_fallback()
         _phase("baseline done")
-
-        value = tpu["sec_per_round"]
-        out["value"] = round(value, 6)
-        out["vs_baseline"] = round(base["sec_per_round"] / value, 3)
-        out["extra"] = {
-            "rounds_per_sec": round(tpu["rounds_per_sec"], 3),
-            "final_test_acc": round(tpu["final_test_acc"], 4),
-            "label_flip": LABEL_FLIP,
-            "rounds_per_call": tpu["rounds_per_call"],
-            "rounds_per_call_sweep": tpu.get("rounds_per_call_sweep"),
-            "est_dispatch_s_per_call": tpu.get("est_dispatch_s_per_call"),
-            "baseline": base.get("baseline"),
-            "baseline_sec_per_round": round(base["sec_per_round"], 4),
-            # Baseline's own shape: makes a ladder fall-through (e.g. the
-            # matched-count rung failing in degraded mode) visible in the
-            # JSON rather than silently skewing vs_baseline.
-            "baseline_nodes": base.get("nodes"),
-            "baseline_rounds": base.get("rounds"),
-            "baseline_final_test_acc": base.get("final_test_acc"),
-            "baseline_note": base.get("note"),
-            "device_kind": kind,
-            "mfu_probe": mfu,
-            "rounds": tpu.get("rounds", ROUNDS),
-            "nodes": tpu.get("nodes", NUM_NODES),
-            "committee": COMMITTEE,
-        }
-        if scale_note:
-            out["extra"]["scale_note"] = scale_note
+        _assemble(out, tm["tpu"], base, tm["kind"], tm["mfu"])
     except Exception as e:  # noqa: BLE001
         traceback.print_exc(file=sys.stderr)
+        if not best:
+            # Degraded-beats-empty applies on EVERY path: when the first
+            # probe succeeded and the tunnel flapped mid-measurement, the
+            # fallback was never pre-computed — measure it now (late but
+            # numeric beats punctual but empty).
+            try:
+                _phase(f"TPU path failed ({e}); measuring degraded fallback now")
+                best = _measure_degraded(out)
+            except Exception:  # noqa: BLE001
+                traceback.print_exc(file=sys.stderr)
+        if best:
+            # The TPU path died after recovery (e.g. the tunnel flapped
+            # mid-measurement): the degraded line is still a real answer.
+            best["extra"]["tpu_attempt_error"] = f"{type(e).__name__}: {e}"
+            print(json.dumps(best), flush=True)
+            os._exit(0)
         out["error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(out), flush=True)
     # _exit (not sys.exit): a wedged backend thread must not turn success
@@ -998,11 +1557,21 @@ if __name__ == "__main__":
         run_reference_baseline(int(sys.argv[i + 1]), int(sys.argv[i + 2]))
     elif "--cpu-fallback" in sys.argv:
         run_cpu_fallback()
+    elif "--multihost-worker" in sys.argv:
+        i = sys.argv.index("--multihost-worker")
+        run_multihost_worker(int(sys.argv[i + 1]), int(sys.argv[i + 2]))
+    elif "--multihost" in sys.argv:
+        run_multihost()
+    elif "--tpu-metric" in sys.argv:
+        i = sys.argv.index("--tpu-metric")
+        run_tpu_metric(float(sys.argv[i + 1]) if len(sys.argv) > i + 1 else 900.0)
     elif "--scale-500" in sys.argv:
         run_scale_500()
     elif "--cifar" in sys.argv:
         run_cifar_bench()
     elif "--attn" in sys.argv:
         run_attn_bench()
+    elif "--lm-mfu" in sys.argv:
+        run_lm_mfu()
     else:
         main()

@@ -1,0 +1,60 @@
+"""Regenerate ``node_pb2.py`` from ``node.proto``.
+
+Parity: the reference ships the same convenience as
+``p2pfl/communication/protocols/grpc/proto/generate_proto.py`` (it shells
+out to grpc_tools.protoc). This image has the ``protoc`` binary but not
+``grpc_tools``, and the transport registers its RPC methods manually
+(grpc_protocol.py builds ``grpc.unary_unary`` handlers itself), so plain
+``--python_out`` is the whole job — no ``_grpc`` stub module exists.
+
+Usage::
+
+    python -m p2pfl_tpu.comm.grpc.generate_proto [--check]
+
+``--check`` regenerates into a temp dir and exits nonzero if the committed
+``node_pb2.py`` is stale (useful as a CI gate after editing node.proto).
+"""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+HERE = Path(__file__).resolve().parent
+
+
+def generate(out_dir: Path) -> Path:
+    protoc = shutil.which("protoc")
+    if protoc is None:
+        raise RuntimeError("protoc not found on PATH")
+    subprocess.run(
+        [protoc, f"--proto_path={HERE}", f"--python_out={out_dir}", "node.proto"],
+        check=True,
+    )
+    return out_dir / "node_pb2.py"
+
+
+def main(argv: list[str]) -> int:
+    if "--check" in argv:
+        with tempfile.TemporaryDirectory() as td:
+            fresh = generate(Path(td)).read_bytes()
+        committed = (HERE / "node_pb2.py").read_bytes()
+        if fresh != committed:
+            print(
+                "node_pb2.py is stale (or protoc version drift): regenerate "
+                "with `python -m p2pfl_tpu.comm.grpc.generate_proto`",
+                file=sys.stderr,
+            )
+            return 1
+        print("node_pb2.py is up to date")
+        return 0
+    path = generate(HERE)
+    print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

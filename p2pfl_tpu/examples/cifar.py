@@ -85,6 +85,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--measure-time", action="store_true")
     p.add_argument(
+        "--cost-analysis", action="store_true",
+        help="report XLA's flops/bytes for the compiled round program "
+        "(may AOT-recompile once; cheap under the persistent compile cache)",
+    )
+    p.add_argument(
         "--platform",
         choices=["default", "cpu", "tpu"],
         default="default",
@@ -183,6 +188,14 @@ def run(args: argparse.Namespace) -> dict:
             rounds=args.rounds, epochs=args.epochs, warmup=True,
             rounds_per_call=args.rounds_per_call, eval_every=args.eval_every,
         )
+        cost = (
+            sim.round_cost_analysis(
+                epochs=args.epochs, rounds_per_call=args.rounds_per_call,
+                eval_every=args.eval_every,
+            )
+            if args.cost_analysis
+            else None
+        )
     return {
         "mode": "mesh",
         "model": "resnet18-groupnorm",
@@ -194,6 +207,10 @@ def run(args: argparse.Namespace) -> dict:
         "sec_per_round": res.seconds_per_round,
         "test_acc": [round(a, 4) for a in res.test_acc],
         "final_test_acc": res.test_acc[-1] if res.test_acc else None,
+        # XLA cost analysis of the exact compiled round program — the
+        # bench's production-model MFU rows divide flops_per_round by the
+        # measured sec_per_round (no hand-counted conv FLOPs).
+        "cost_analysis": cost,
     }
 
 

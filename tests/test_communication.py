@@ -270,3 +270,38 @@ def test_unknown_command_is_contained(protocol_class):
     finally:
         for p in (a, b):
             p.stop()
+
+
+def test_proto_schema_not_stale():
+    """The committed node_pb2.py must match what protoc generates from
+    node.proto (parity with the reference's generate_proto.py tooling,
+    reference grpc/proto/generate_proto.py). Skips when protoc is absent;
+    when the byte-compare fails but the embedded serialized DESCRIPTOR is
+    identical, the diff is protoc codegen drift, not a schema change —
+    skip rather than fail."""
+    import re
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+    from pathlib import Path
+
+    if shutil.which("protoc") is None:
+        pytest.skip("protoc not on PATH")
+    proc = subprocess.run(
+        [sys.executable, "-m", "p2pfl_tpu.comm.grpc.generate_proto", "--check"],
+        capture_output=True, text=True,
+    )
+    if proc.returncode != 0:
+        from p2pfl_tpu.comm.grpc import generate_proto
+
+        with tempfile.TemporaryDirectory() as td:
+            fresh = generate_proto.generate(Path(td)).read_text()
+        committed = (
+            Path(generate_proto.__file__).parent / "node_pb2.py"
+        ).read_text()
+        pat = re.compile(r"AddSerializedFile\((.+?)\)", re.S)
+        m_fresh, m_committed = pat.search(fresh), pat.search(committed)
+        if m_fresh and m_committed and m_fresh.group(1) == m_committed.group(1):
+            pytest.skip("protoc codegen drift with identical schema descriptor")
+        pytest.fail(f"node.proto schema drifted from node_pb2.py: {proc.stderr}")

@@ -54,3 +54,34 @@ def test_two_process_mesh_fedavg_round():
     # Both processes computed the same (replicated) accuracy.
     accs = {line.split("acc=")[1] for out in outs for line in out.splitlines() if "MULTIHOST_OK" in line}
     assert len(accs) == 1, accs
+
+
+def test_multihost_bench_mode():
+    """`python bench.py --multihost` (VERDICT r4 ask #5): the FULL bench
+    path — MeshSimulation with warmup, fused rounds_per_call, eval cadence,
+    committee sampling — composes over a 2-process jax.distributed mesh,
+    not just one FedAvg round. Tiny shape via env so CI stays affordable;
+    the documented launch command (no env) runs the 96-node shape."""
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    env.update(
+        P2PFL_TPU_MH_NODES="16", P2PFL_TPU_MH_SAMPLES="64",
+        P2PFL_TPU_MH_ROUNDS="4", P2PFL_TPU_MH_RPC="2",
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--multihost"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    import json
+
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "sec_per_round_16node_mnist_fedavg_multihost_cpu"
+    assert out["value"] > 0
+    ex = out["extra"]
+    assert ex["processes"] == 2 and ex["global_devices"] == 8
+    # 4 rounds x 64 samples on the template task already clears chance.
+    assert ex["final_test_acc"] > 0.3, out

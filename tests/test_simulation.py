@@ -365,7 +365,7 @@ def test_scale_bench_body_rehearsal():
     import bench
 
     out = bench.scale_bench_body("cpu-rehearsal", n=64, s=64, rounds=4, committee=8)
-    assert out["metric"] == "sec_per_round_64node_dirichlet_fedprox"
+    assert out["metric"] == "sec_per_round_64node_dirichlet_fedprox_synthetic"
     assert out["value"] > 0
     assert out["extra"]["final_test_acc"] > 0.3  # observed 0.57
     assert "64 nodes" in out["extra"]["note"]
@@ -439,3 +439,54 @@ def test_closed_simulation_raises_everywhere():
         sim.final_model()
     with pytest.raises(RuntimeError, match="closed"):
         sim.load_from(checkpointer=None)
+
+
+@pytest.mark.slow
+def test_round_cost_analysis_and_lm_mfu_rehearsal():
+    """VERDICT r4 #6 groundwork: XLA cost analysis of the compiled round
+    program (the production-model MFU source) works on the CPU mesh, and
+    bench.py --lm-mfu's measurable body runs end-to-end at tiny scale."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench
+
+    out = bench.lm_mfu_body(
+        "cpu-rehearsal", nodes=4, seqs=8, seq_len=128, rounds=2,
+        vocab=256, layers=2, heads=2, embed=64, batch=4,
+    )
+    assert out["metric"] == "transformer_lm_federated_round_mfu"
+    row = out["extra"]["mfu_row"]
+    # CPU backends expose cost analysis too; if this ever regresses the
+    # bench degrades gracefully, but the rehearsal should catch it.
+    assert "error" not in row, row
+    assert row["flops_per_round"] > 0
+    assert out["extra"]["sec_per_round"] > 0
+
+
+@pytest.mark.slow
+def test_train_path_probe_rehearsal():
+    """bench.py's isolated fit-path probe (the '66-83%' artifact row) runs
+    end-to-end at tiny scale: vmapped member steps under one scan, loss
+    finite, throughput positive."""
+    import os
+    import sys
+
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench
+    from p2pfl_tpu.models import mlp_model
+
+    x = np.random.default_rng(0).random((4, 256, 28, 28), dtype=np.float32)
+    y = np.random.default_rng(1).integers(0, 10, (4, 256)).astype(np.int32)
+    model = mlp_model(seed=0)
+    out = bench._train_path_probe(
+        "cpu-rehearsal", model, jnp.asarray(x), jnp.asarray(y),
+        matmul_params=784 * 256 + 256 * 128 + 128 * 10,
+        members=4, batch=64, steps=4,
+    )
+    assert "error" not in out, out
+    assert out["achieved_tflops"] > 0
+    assert out["seconds"] > 0

@@ -389,29 +389,43 @@ def run_cpu_fallback() -> None:
     os._exit(0)
 
 
+# Live measurement children: _bail (the SIGTERM hedge) must kill them
+# before exiting, or an orphaned --baseline-ref subprocess keeps saturating
+# the single core for minutes and skews whatever the driver measures next.
+_live_children: set = set()
+
+
 def _json_subprocess(args: list, timeout: float, env: dict) -> dict:
     """Run a bench subprocess mode, parse its single JSON line; on any
-    failure raise with a stderr tail so crashes are diagnosable."""
+    failure raise with a stderr tail so crashes are diagnosable. Children
+    are tracked in ``_live_children`` for the signal hedge."""
     stderr_tail = ""
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "bench.py"), *args],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=REPO,
+    )
+    _live_children.add(proc)
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.join(REPO, "bench.py"), *args],
-            capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
-        )
-        stderr_tail = (proc.stderr or "")[-1500:]
-        line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+        try:
+            stdout, stderr = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            stdout, stderr = proc.communicate()
+            stderr_tail = (stderr or "")[-1500:]
+            raise
+        stderr_tail = (stderr or "")[-1500:]
+        line = stdout.strip().splitlines()[-1] if stdout.strip() else ""
         out = json.loads(line)
         if "error" in out:
             raise RuntimeError(out["error"])
         return out
     except Exception as e:  # noqa: BLE001
-        if isinstance(e, subprocess.TimeoutExpired) and e.stderr:
-            stderr_tail = (
-                e.stderr[-1500:] if isinstance(e.stderr, str) else e.stderr.decode()[-1500:]
-            )
         raise RuntimeError(
             f"{type(e).__name__}: {e}\n--- subprocess stderr tail ---\n{stderr_tail}"
         ) from e
+    finally:
+        _live_children.discard(proc)
 
 
 def measure_cpu_fallback(budget: float) -> dict:
@@ -1416,16 +1430,29 @@ def _assemble(out: dict, tpu: dict, base: dict, kind: str, mfu: dict) -> None:
     }
 
 
-def _measure_degraded(out_template: dict) -> dict:
+def _measure_degraded(out_template: dict, soft_budget: float = 3000.0) -> dict:
     """The honest tunnel-down answer: reduced-scale CPU-mesh measurement
     plus a matched-node-count reference baseline (apples-to-apples ratio),
-    assembled into a fully-labeled degraded output line. Takes ~4 min; the
-    orchestrator runs it BEFORE settling into the wait ladder so a numeric
-    line is on hand the moment anything (deadline, SIGTERM) ends the wait."""
-    tpu = measure_cpu_fallback(450.0)
+    assembled into a fully-labeled degraded output line. Typically ~4 min;
+    the orchestrator runs it BEFORE settling into the wait ladder so a
+    numeric line is on hand the moment anything (deadline, SIGTERM) ends
+    the wait. Caps scale with the soft budget so a slow fallback cannot
+    starve the ladder of the patience the budget implies."""
+    # Caps scale down with the budget but keep FLOORS that fit the measured
+    # costs (~25 s CPU fallback, ~190 s 8-node baseline): a tiny budget must
+    # not push the baseline down to the torch loop, whose different shape
+    # makes vs_baseline meaningless.
+    tpu = measure_cpu_fallback(min(450.0, max(150.0, soft_budget * 0.15)))
     try:
         base = measure_reference_baseline(
-            900.0, ladder=[(tpu["nodes"], 1, 700.0), (4, 1, 240.0)]
+            min(900.0, max(520.0, soft_budget * 0.3)),
+            ladder=[
+                # The 8-node rung measures ~260 s wall on this box; the
+                # floor must cover it or tiny budgets fall through to the
+                # torch loop (observed: vs_baseline 0.13 nonsense).
+                (tpu["nodes"], 1, min(700.0, max(420.0, soft_budget * 0.25))),
+                (4, 1, 240.0),
+            ],
         )
     except Exception as e:  # noqa: BLE001
         traceback.print_exc(file=sys.stderr)
@@ -1462,6 +1489,14 @@ def main() -> None:
     def _bail(signum, _frame):
         # An impatient driver sends TERM: a degraded-but-numeric line (if
         # the fallback finished measuring) still beats an empty capture.
+        # Kill in-flight measurement children first — an orphaned
+        # --baseline-ref subprocess would keep saturating the single core
+        # and skew whatever the driver runs next.
+        for child in list(_live_children):
+            try:
+                child.kill()
+            except Exception:  # noqa: BLE001
+                pass
         line = best or {
             **out,
             "degraded": True,
@@ -1490,7 +1525,7 @@ def main() -> None:
                 "fallback, then holding the wait ladder until the reserve"
             )
             try:
-                best = _measure_degraded(out)
+                best = _measure_degraded(out, soft_budget)
                 _phase(f"degraded fallback ready: {best['metric']} = {best['value']}")
             except Exception as e:  # noqa: BLE001 — waiting is still worthwhile
                 traceback.print_exc(file=sys.stderr)
@@ -1509,8 +1544,13 @@ def main() -> None:
         remaining = soft_budget - (time.monotonic() - t_start)
         metric_cap = max(420.0, remaining - 420.0)  # keep ~7 min for baseline
         _phase(f"TPU up ({kind}): metric subprocess (cap {metric_cap:.0f}s)")
+        # Sanitize like the probe does: a leftover JAX_PLATFORMS=cpu (e.g.
+        # from a documented CPU smoke run) must not make the metric child
+        # measure the host CPU after the probe found a real chip.
+        tpu_env = dict(os.environ)
+        tpu_env.pop("JAX_PLATFORMS", None)
         tm = _json_subprocess(
-            ["--tpu-metric", str(metric_cap * 0.9)], metric_cap, dict(os.environ)
+            ["--tpu-metric", str(metric_cap * 0.9)], metric_cap, tpu_env
         )
         _phase("measuring reference baseline (subprocess, CPU)")
         try:
@@ -1535,7 +1575,7 @@ def main() -> None:
             # numeric beats punctual but empty).
             try:
                 _phase(f"TPU path failed ({e}); measuring degraded fallback now")
-                best = _measure_degraded(out)
+                best = _measure_degraded(out, soft_budget)
             except Exception:  # noqa: BLE001
                 traceback.print_exc(file=sys.stderr)
         if best:

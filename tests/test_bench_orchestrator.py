@@ -1,0 +1,80 @@
+"""Unit tests for bench.py's round-5 orchestration logic (wait ladder,
+output assembly, MFU row math, multihost config) — the pure-Python pieces
+that must be right for BENCH_r05.json to be trustworthy, testable without
+a TPU or a jit."""
+
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench
+
+
+def test_wait_for_tpu_respects_deadline(monkeypatch):
+    """A deadline closer than one probe timeout must return None without
+    probing (the reserve is sacred: it funds the measurement itself)."""
+    calls = []
+    monkeypatch.setattr(bench, "_subprocess_tpu_probe", lambda t=90.0: calls.append(t))
+    out = bench.wait_for_tpu(deadline=time.monotonic() + 10.0, probe_timeout=90.0)
+    assert out is None
+    assert calls == []
+
+
+def test_wait_for_tpu_returns_kind_on_recovery(monkeypatch):
+    seq = iter([None, None, "TPU v5 lite"])
+    monkeypatch.setattr(bench, "_subprocess_tpu_probe", lambda t=90.0: next(seq))
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    out = bench.wait_for_tpu(deadline=time.monotonic() + 3600.0, probe_timeout=1.0)
+    assert out == "TPU v5 lite"
+
+
+def test_assemble_shapes_and_ratio():
+    out = {"metric": "m", "value": None, "unit": "s/round", "vs_baseline": None, "extra": {}}
+    tpu = {
+        "sec_per_round": 0.02, "rounds_per_sec": 50.0, "final_test_acc": 0.9,
+        "rounds_per_call": 10, "nodes": 100, "rounds": 10,
+        "rounds_per_call_sweep": {"10": 0.02},
+    }
+    base = {"sec_per_round": 200.0, "baseline": "ref", "nodes": 20, "rounds": 1}
+    bench._assemble(out, tpu, base, "TPU v5 lite", {"mfu": 0.4})
+    assert out["value"] == 0.02
+    assert out["vs_baseline"] == pytest.approx(10000.0)
+    ex = out["extra"]
+    # The degraded and TPU paths share this assembler; these keys are the
+    # contract BENCH_r0N.json consumers read.
+    for key in (
+        "rounds_per_call_sweep", "baseline_sec_per_round", "baseline_nodes",
+        "device_kind", "mfu_probe", "final_test_acc", "nodes", "rounds",
+    ):
+        assert key in ex, key
+    assert ex["device_kind"] == "TPU v5 lite"
+
+
+def test_production_mfu_row_math():
+    cost = {"flops_per_round": 1e12, "bytes_accessed_per_round": 1e9}
+    row = bench._production_mfu_row("m", "TPU v5 lite", cost, sec_per_round=0.01)
+    # 1e12 flops / 0.01 s = 100 TFLOP/s; v5 lite peak 197.
+    assert row["achieved_tflops"] == pytest.approx(100.0)
+    assert row["mfu"] == pytest.approx(100.0 / 197.0, abs=1e-3)
+    rl = row["roofline"]
+    assert rl["arithmetic_intensity_flop_per_byte"] == pytest.approx(1000.0)
+    assert 0.0 < rl["mfu_ceiling"] <= 1.0
+
+
+def test_production_mfu_row_unknown_device():
+    cost = {"flops_per_round": 1e12, "bytes_accessed_per_round": 1e9}
+    row = bench._production_mfu_row("m", "cpu-rehearsal", cost, sec_per_round=0.01)
+    assert row["mfu"] is None
+    assert "roofline" not in row
+
+
+def test_mh_cfg_env_overrides(monkeypatch):
+    monkeypatch.setenv("P2PFL_TPU_MH_NODES", "32")
+    monkeypatch.setenv("P2PFL_TPU_MH_RPC", "3")
+    cfg = bench._mh_cfg()
+    assert cfg["nodes"] == 32
+    assert cfg["rpc"] == 3
+    assert cfg["procs"] == bench.MH_PROCS  # untouched knobs keep defaults

@@ -279,7 +279,6 @@ def test_proto_schema_not_stale():
     when the byte-compare fails but the embedded serialized DESCRIPTOR is
     identical, the diff is protoc codegen drift, not a schema change —
     skip rather than fail."""
-    import re
     import shutil
     import subprocess
     import sys
@@ -300,8 +299,20 @@ def test_proto_schema_not_stale():
         committed = (
             Path(generate_proto.__file__).parent / "node_pb2.py"
         ).read_text()
-        pat = re.compile(r"AddSerializedFile\((.+?)\)", re.S)
-        m_fresh, m_committed = pat.search(fresh), pat.search(committed)
-        if m_fresh and m_committed and m_fresh.group(1) == m_committed.group(1):
+
+        def descriptor_literal(src: str) -> str:
+            # The serialized-descriptor bytes literal may itself contain
+            # ')' bytes, so a non-greedy regex would truncate it and mask
+            # real schema drift; slice from the call to the end of its
+            # statement instead (the generated file always follows the
+            # AddSerializedFile line with a _builder.Build* call).
+            body = src.split("AddSerializedFile(", 1)[1]
+            return body.split("_builder.Build", 1)[0].rsplit(")", 1)[0]
+
+        try:
+            same = descriptor_literal(fresh) == descriptor_literal(committed)
+        except IndexError:
+            same = False
+        if same:
             pytest.skip("protoc codegen drift with identical schema descriptor")
         pytest.fail(f"node.proto schema drifted from node_pb2.py: {proc.stderr}")

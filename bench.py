@@ -894,6 +894,11 @@ def attn_bench_body(kind: str, seqs=(1024, 2048, 4096, 8192), iters_cap: int = 6
         "dense": lambda q, k, v: dense_attention(q, k, v, causal=True),
         "blockwise": lambda q, k, v: blockwise_attention(q, k, v, causal=True),
         "flash": lambda q, k, v: flash_attention(q, k, v, causal=True),
+        # Same forward kernel, old remat-through-blockwise backward: its
+        # fwdbwd row quantifies what the Pallas backward kernels buy.
+        "flashremat": lambda q, k, v: flash_attention(
+            q, k, v, True, 512, 512, None, "remat"
+        ),
     }
 
     def timed_call(fn, s: int, iters: int, grad: bool) -> float:
@@ -955,6 +960,8 @@ def attn_bench_body(kind: str, seqs=(1024, 2048, 4096, 8192), iters_cap: int = 6
         row: dict = {"iters": iters}
         for name, fn in variants.items():
             for grad, suffix, factor in ((False, "fwd", 1.0), (True, "fwdbwd", 3.5)):
+                if name == "flashremat" and not grad:
+                    continue  # its forward is byte-identical to "flash"
                 try:
                     dt = timed_call(fn, s, iters, grad)
                     row[f"{suffix}_{name}_ms"] = round(dt * 1e3, 3)
@@ -993,8 +1000,9 @@ def attn_bench_body(kind: str, seqs=(1024, 2048, 4096, 8192), iters_cap: int = 6
             "device_kind": kind,
             "per_seq": results,
             "note": "causal-convention FLOPs (lower triangle); fwd+bwd "
-            "counted at 3.5x fwd; flash bwd rematerializes via the "
-            "blockwise path (ops/attention.py custom VJP)",
+            "counted at 3.5x fwd; flash bwd is the FlashAttention-2 "
+            "Pallas kernel pair (ops/attention.py), flashremat rows show "
+            "the old remat-through-blockwise backward for contrast",
         },
     }
 

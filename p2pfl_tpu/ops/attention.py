@@ -12,11 +12,15 @@ for this framework. Design:
   the inner matmuls onto the MXU. Used as the per-chunk compute of ring
   attention (:mod:`p2pfl_tpu.ops.ring_attention`) and as the autodiff
   backward for the Pallas forward.
-* ``flash_attention`` — Pallas kernel (grid over [batch, head, q-block],
-  ``fori_loop`` over k-blocks with m/l/acc accumulators in VMEM); forward on
-  the MXU in the input dtype with float32 accumulation. Backward is a
-  rematerialized blockwise pass via ``jax.custom_vjp`` (standard
-  flash-attention practice: recompute instead of storing S^2 probabilities).
+* ``flash_attention`` — Pallas kernel (grid over [batch, head, q-block,
+  k-block] with online-softmax m/l/acc accumulators in VMEM scratch);
+  forward on the MXU in the input dtype with float32 accumulation, emitting
+  the per-row logsumexp. Backward is a pair of Pallas kernels
+  (FlashAttention-2 style): a dq kernel accumulating over k blocks and a
+  dk/dv kernel accumulating over q blocks, both recomputing probabilities
+  from the saved logsumexp — O(block) VMEM, no S^2 residuals. Set
+  ``bwd_kernel="remat"`` to fall back to differentiating the blockwise
+  scan instead.
 
 All functions take ``[batch, seq, heads, head_dim]`` ("BSHD") tensors and an
 optional additive position offset pair so callers (ring attention) can apply
@@ -175,7 +179,7 @@ def blockwise_update(
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, causal: bool
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *, causal: bool
 ):
     """One (batch, head, q-block, k-block) program.
 
@@ -204,9 +208,7 @@ def _flash_kernel(
         vb = v_ref[0, 0].astype(jnp.float32)
         s = jnp.dot(q * (1.0 / math.sqrt(d)), kb.T, preferred_element_type=jnp.float32)
         if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+            s = _causal_mask(s, q_start, k_start)
         # m/l scratch carry the per-row stats broadcast across the 128-lane
         # minor dim (TPU-friendly tile shape); column 0 is authoritative.
         m = m_ref[:, :1]
@@ -230,6 +232,9 @@ def _flash_kernel(
         o_ref[0, 0] = (
             acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-30)
         ).astype(o_ref.dtype)
+        # Per-row logsumexp (lane-broadcast like m/l): the backward kernels
+        # recompute p = exp(s - lse) from it instead of storing S^2 probs.
+        lse_ref[0, 0] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))
 
 
 def _pick_block(n: int, target: int) -> int:
@@ -249,7 +254,9 @@ def _flash_forward(
     block_q: int,
     block_k: int,
     interpret: bool,
-) -> jax.Array:
+) -> tuple:
+    """Returns ``(out [B,Sq,H,D], lse [B,H,Sq,128])`` — lse is lane-broadcast
+    (column 0 authoritative) so the backward kernels read TPU-tiled blocks."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
     block_q = _pick_block(sq, block_q)
@@ -260,16 +267,22 @@ def _flash_forward(
     vt = jnp.moveaxis(v, 2, 1)
     kernel = functools.partial(_flash_kernel, causal=causal)
     grid = (b, h, sq // block_q, sk // block_k)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 128), jnp.float32),
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
             pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        out_specs=(
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 128), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ),
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),  # running max m (lane-bcast)
             pltpu.VMEM((block_q, 128), jnp.float32),  # running denom l (lane-bcast)
@@ -277,10 +290,173 @@ def _flash_forward(
         ],
         interpret=interpret,
     )(qt, kt, vt)
-    return jnp.moveaxis(out, 1, 2)
+    return jnp.moveaxis(out, 1, 2), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, dd_ref, dq_ref, dq_acc, *, causal: bool
+):
+    """dq for one (batch, head, q-block): accumulate over the k-block grid
+    axis. Probabilities are recomputed from the forward's logsumexp."""
+    q = q_ref[0, 0].astype(jnp.float32)
+    block_q, d = q.shape
+    block_k = k_ref.shape[2]
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    q_start = qi * block_q
+    k_start = ki * block_k
+    scale = 1.0 / math.sqrt(d)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _fold():
+        kb = k_ref[0, 0].astype(jnp.float32)
+        vb = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        dd = dd_ref[0, 0][:, :1]
+        s = jnp.dot(q * scale, kb.T, preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, q_start, k_start)
+        p = jnp.exp(s - lse)  # masked entries underflow to exactly 0
+        dp = jnp.dot(do, vb.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - dd)
+        dq_acc[:] = dq_acc[:] + scale * jnp.dot(
+            ds, kb, preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        pl.when(k_start < q_start + block_q)(_fold)
+    else:
+        _fold()
+
+    @pl.when(ki == pl.num_programs(3) - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    k_ref, v_ref, q_ref, do_ref, lse_ref, dd_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc, *, causal: bool
+):
+    """dk/dv for one (batch, head, k-block): accumulate over the q-block
+    grid axis (innermost), mirroring the dq kernel."""
+    kb = k_ref[0, 0].astype(jnp.float32)
+    block_k, d = kb.shape
+    block_q = q_ref.shape[2]
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    k_start = ki * block_k
+    q_start = qi * block_q
+    scale = 1.0 / math.sqrt(d)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _fold():
+        vb = v_ref[0, 0].astype(jnp.float32)
+        qb = q_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        dd = dd_ref[0, 0][:, :1]
+        s = jnp.dot(qb * scale, kb.T, preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, q_start, k_start)
+        p = jnp.exp(s - lse)  # [block_q, block_k]
+        dv_acc[:] = dv_acc[:] + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, vb.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - dd)
+        dk_acc[:] = dk_acc[:] + scale * jnp.dot(
+            ds.T, qb, preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        # A q block contributes iff its last row can see this k block.
+        pl.when(q_start + block_q > k_start)(_fold)
+    else:
+        _fold()
+
+    @pl.when(qi == pl.num_programs(3) - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(
+    q, k, v, out, lse, g, causal: bool, block_q: int, block_k: int, interpret: bool
+):
+    """FlashAttention-2-style backward: a dq kernel (k-block accumulation)
+    and a dk/dv kernel (q-block accumulation), both O(block) VMEM."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
+    qt = jnp.moveaxis(q, 2, 1)
+    kt = jnp.moveaxis(k, 2, 1)
+    vt = jnp.moveaxis(v, 2, 1)
+    dot = jnp.moveaxis(g, 2, 1).astype(jnp.float32)
+    ot = jnp.moveaxis(out, 2, 1).astype(jnp.float32)
+    # D_i = sum_d dO * O per row (lane-broadcast for TPU-tiled reads).
+    dd = jnp.broadcast_to(
+        jnp.sum(dot * ot, axis=-1, keepdims=True), (b, h, sq, 128)
+    )
+
+    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+    row_spec = pl.BlockSpec(
+        (1, 1, block_q, 128), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+    )
+    k_spec = pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0))
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, causal=causal),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        grid=(b, h, sq // block_q, sk // block_k),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot.astype(q.dtype), lse, dd)
+
+    # dkv grid: (b, h, k-block, q-block) — q innermost so dk/dv scratch
+    # accumulates across it; index maps swap qi/ki roles accordingly.
+    kv_spec = pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0))
+    qv_spec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0))
+    rowv_spec = pl.BlockSpec(
+        (1, 1, block_q, 128), lambda bi, hi, ki, qi: (bi, hi, qi, 0)
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, causal=causal),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, sk, d), v.dtype),
+        ),
+        grid=(b, h, sk // block_k, sq // block_q),
+        in_specs=[kv_spec, kv_spec, qv_spec, qv_spec, rowv_spec, rowv_spec],
+        out_specs=(kv_spec, kv_spec),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(kt, vt, qt, dot.astype(q.dtype), lse, dd)
+    return (
+        jnp.moveaxis(dq, 1, 2),
+        jnp.moveaxis(dk, 1, 2),
+        jnp.moveaxis(dv, 1, 2),
+    )
+
+
+def _resolve_interpret(interpret: Optional[bool]) -> bool:
+    """Pallas interpret mode default: real kernels on TPU, interpreter
+    elsewhere (the virtual CPU test mesh). One definition — forward and
+    backward must never disagree."""
+    return jax.default_backend() != "tpu" if interpret is None else interpret
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -289,25 +465,41 @@ def flash_attention(
     block_q: int = 512,
     block_k: int = 512,
     interpret: Optional[bool] = None,
+    bwd_kernel: str = "pallas",
 ) -> jax.Array:
     """Pallas TPU flash attention over ``[B, S, H, D]`` tensors.
 
-    On non-TPU backends (tests run on a virtual CPU mesh) the kernel runs in
-    Pallas interpret mode automatically. Backward rematerializes through
-    :func:`blockwise_attention` (no S^2 residuals).
+    On non-TPU backends (tests run on a virtual CPU mesh) the kernels run in
+    Pallas interpret mode automatically. Backward is the FlashAttention-2
+    Pallas kernel pair by default (probabilities recomputed from the saved
+    logsumexp — O(block) VMEM); ``bwd_kernel="remat"`` differentiates the
+    blockwise scan instead (kept as the independently-derived cross-check;
+    ``tests/test_attention.py`` asserts both match dense gradients).
     """
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return _flash_forward(
+        q, k, v, causal, block_q, block_k, _resolve_interpret(interpret)
+    )[0]
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, bwd_kernel):
+    out, lse = _flash_forward(
+        q, k, v, causal, block_q, block_k, _resolve_interpret(interpret)
+    )
+    # The remat path recomputes everything from q/k/v — carrying out+lse
+    # (~[B,S,H,D] + [B,H,S,128] f32) to the backward would inflate its
+    # activation memory for nothing.
+    if bwd_kernel == "pallas":
+        return out, (q, k, v, out, lse)
+    return out, (q, k, v, None, None)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
-    q, k, v = residuals
+def _flash_bwd(causal, block_q, block_k, interpret, bwd_kernel, residuals, g):
+    q, k, v, out, lse = residuals
+    if bwd_kernel == "pallas":
+        return _flash_backward(
+            q, k, v, out, lse, g, causal, block_q, block_k,
+            _resolve_interpret(interpret),
+        )
     _, vjp = jax.vjp(
         lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal, block_k=block_k),
         q,

@@ -36,7 +36,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--epochs", type=int, default=1, help="local epochs per round")
     p.add_argument(
         "--aggregator",
-        choices=["fedavg", "fedmedian", "scaffold", "krum", "trimmed_mean"],
+        choices=["fedavg", "fedmedian", "scaffold", "krum", "trimmed_mean", "geomedian"],
         default="krum",
     )
     p.add_argument("--batch-size", type=int, default=32)
@@ -164,6 +164,7 @@ def run(args: argparse.Namespace) -> dict:
             stacked, w, num_byzantine=f, num_selected=max(1, committee - f)
         )[0],
         "trimmed_mean": lambda stacked, w: agg_ops.trimmed_mean(stacked, trim=f),
+        "geomedian": agg_ops.geometric_median,
     }.get(args.aggregator)
     algorithm = "scaffold" if args.aggregator == "scaffold" else "fedavg"
     lr = args.lr if args.lr is not None else (0.05 if algorithm == "scaffold" else 1e-3)

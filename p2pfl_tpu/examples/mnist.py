@@ -42,7 +42,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--aggregator",
-        choices=["fedavg", "fedmedian", "scaffold", "krum", "trimmed_mean"],
+        choices=["fedavg", "fedmedian", "scaffold", "krum", "trimmed_mean", "geomedian"],
         default="fedavg",
     )
     p.add_argument("--mode", choices=["mesh", "nodes"], default="mesh")
@@ -98,6 +98,7 @@ def _make_aggregator(name: str):
     from p2pfl_tpu.learning.aggregators import (
         FedAvg,
         FedMedian,
+        GeometricMedian,
         Krum,
         Scaffold,
         TrimmedMean,
@@ -109,6 +110,7 @@ def _make_aggregator(name: str):
         "scaffold": Scaffold,
         "krum": Krum,
         "trimmed_mean": TrimmedMean,
+        "geomedian": GeometricMedian,
     }[name]()
 
 
@@ -125,6 +127,7 @@ def run_mesh(args: argparse.Namespace) -> dict:
         "fedmedian": lambda stacked, w: agg_ops.fedmedian(stacked),
         "krum": lambda stacked, w: agg_ops.krum(stacked, w, num_byzantine=1)[0],
         "trimmed_mean": lambda stacked, w: agg_ops.trimmed_mean(stacked, trim=trim),
+        "geomedian": agg_ops.geometric_median,
     }.get(args.aggregator)
     algorithm = "scaffold" if args.aggregator == "scaffold" else "fedavg"
 

@@ -3,7 +3,14 @@
 from p2pfl_tpu.learning.aggregators.base import Aggregator  # noqa: F401
 from p2pfl_tpu.learning.aggregators.fedavg import FedAvg  # noqa: F401
 from p2pfl_tpu.learning.aggregators.fedmedian import FedMedian  # noqa: F401
-from p2pfl_tpu.learning.aggregators.robust import Krum, TrimmedMean  # noqa: F401
+from p2pfl_tpu.learning.aggregators.robust import (  # noqa: F401
+    GeometricMedian,
+    Krum,
+    TrimmedMean,
+)
 from p2pfl_tpu.learning.aggregators.scaffold import Scaffold  # noqa: F401
 
-__all__ = ["Aggregator", "FedAvg", "FedMedian", "Krum", "TrimmedMean", "Scaffold"]
+__all__ = [
+    "Aggregator", "FedAvg", "FedMedian", "GeometricMedian", "Krum",
+    "TrimmedMean", "Scaffold",
+]

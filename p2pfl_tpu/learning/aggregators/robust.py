@@ -38,6 +38,30 @@ class TrimmedMean(Aggregator):
         return models[0].build_copy(params=out, contributors=contributors, num_samples=total)
 
 
+class GeometricMedian(Aggregator):
+    """Weighted geometric median via Weiszfeld iterations (RFA, Pillutla et
+    al. 2019): rotation-invariant robust aggregation tolerating up to half
+    the total weight being adversarial — no discrete-subset commitment like
+    Krum, no per-coordinate independence assumption like trimmed mean."""
+
+    partial_aggregation = False
+
+    def __init__(self, iters: int = 8) -> None:
+        super().__init__()
+        if iters < 1:
+            raise ValueError("iters must be >= 1")
+        self.iters = int(iters)
+
+    def aggregate(self, models: List[ModelHandle]) -> ModelHandle:
+        if not models:
+            raise ValueError("nothing to aggregate")
+        stacked = agg_ops.tree_stack([m.params for m in models])
+        weights = jnp.asarray([m.get_num_samples() for m in models], jnp.float32)
+        out = agg_ops.geometric_median(stacked, weights, iters=self.iters)
+        contributors, total = self._merge_metadata(models)
+        return models[0].build_copy(params=out, contributors=contributors, num_samples=total)
+
+
 class Krum(Aggregator):
     """(Multi-)Krum (Blanchard et al. 2017): select the model(s) closest to
     their peers, discarding up to ``num_byzantine`` outliers."""

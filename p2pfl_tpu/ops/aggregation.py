@@ -20,6 +20,7 @@ arrays.
 
 from __future__ import annotations
 
+import math
 from functools import partial
 from typing import Any
 
@@ -143,6 +144,45 @@ def krum(
     idx = krum_select(stacked, num_byzantine, num_selected)
     sel = jax.tree.map(lambda x: x[idx], stacked)
     return fedavg(sel, jnp.asarray(weights, dtype=jnp.float32)[idx]), idx
+
+
+@partial(jax.jit, static_argnames=("iters",))
+def geometric_median(
+    stacked: Pytree, weights: jax.Array, iters: int = 8, eps: float = 1e-6
+) -> Pytree:
+    """Weighted geometric median over the model axis (Weiszfeld iterations).
+
+    The strongest classic robust rule in the family here: unlike the
+    coordinate-wise median/trimmed-mean it is rotation-invariant, and unlike
+    Krum it does not have to commit to a discrete subset — RFA (Pillutla et
+    al. 2019) shows it tolerates up to half the total weight being
+    adversarial. No reference counterpart (its robust story is config #4's
+    wish list); fixed ``iters`` keeps the loop jit-compilable and the whole
+    solve runs as ``iters`` fused weighted means (one flattened [N, P]
+    matrix — MXU-friendly, same layout Krum uses).
+    """
+    x = _flatten_stack(stacked)  # [N, P] float32
+    w = jnp.asarray(weights, dtype=jnp.float32)
+    w = w / jnp.maximum(w.sum(), 1e-12)
+
+    def step(z, _):
+        d = jnp.sqrt(jnp.maximum(jnp.sum((x - z) ** 2, axis=1), eps * eps))
+        beta = w / d
+        z = (beta @ x) / jnp.maximum(beta.sum(), 1e-12)
+        return z, None
+
+    z0 = w @ x  # start from the weighted mean
+    z, _ = jax.lax.scan(step, z0, None, length=iters)
+
+    # Unflatten back into the stacked pytree's structure/dtypes.
+    out, offset = [], 0
+    for leaf in jax.tree.leaves(stacked):
+        size = math.prod(leaf.shape[1:])  # static shapes -> Python int
+        out.append(
+            z[offset : offset + size].reshape(leaf.shape[1:]).astype(leaf.dtype)
+        )
+        offset += size
+    return jax.tree.unflatten(jax.tree.structure(stacked), out)
 
 
 @jax.jit

@@ -400,10 +400,13 @@ def _flash_backward(
     vt = jnp.moveaxis(v, 2, 1)
     dot = jnp.moveaxis(g, 2, 1).astype(jnp.float32)
     ot = jnp.moveaxis(out, 2, 1).astype(jnp.float32)
-    # D_i = sum_d dO * O per row (lane-broadcast for TPU-tiled reads).
+    # D_i = sum_d dO * O per row (lane-broadcast for TPU-tiled reads); the
+    # lse residual arrives compact [B,H,Sq,1] and is re-broadcast the same
+    # way (XLA materializes these only for the kernel's lifetime).
     dd = jnp.broadcast_to(
         jnp.sum(dot * ot, axis=-1, keepdims=True), (b, h, sq, 128)
     )
+    lse = jnp.broadcast_to(lse, (b, h, sq, 128))
 
     q_spec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0))
     row_spec = pl.BlockSpec(
@@ -482,14 +485,18 @@ def flash_attention(
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, bwd_kernel):
+    if bwd_kernel not in ("pallas", "remat"):
+        raise ValueError(f"bwd_kernel must be 'pallas' or 'remat', got {bwd_kernel!r}")
     out, lse = _flash_forward(
         q, k, v, causal, block_q, block_k, _resolve_interpret(interpret)
     )
     # The remat path recomputes everything from q/k/v — carrying out+lse
-    # (~[B,S,H,D] + [B,H,S,128] f32) to the backward would inflate its
-    # activation memory for nothing.
+    # to the backward would inflate its activation memory for nothing. The
+    # pallas path keeps only column 0 of the lane-broadcast lse (the
+    # authoritative one): the saved residual is [B,H,S,1], not the 128x
+    # kernel-layout tile; _flash_backward re-broadcasts it.
     if bwd_kernel == "pallas":
-        return out, (q, k, v, out, lse)
+        return out, (q, k, v, out, lse[..., :1])
     return out, (q, k, v, None, None)
 
 

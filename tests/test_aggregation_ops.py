@@ -85,3 +85,33 @@ def test_stack_unstack_roundtrip():
     out = agg.tree_unstack(stacked, 3)
     for a, b in zip(trees, out):
         np.testing.assert_array_equal(a["w"], np.asarray(b["w"]))
+
+
+def test_geometric_median_resists_outliers():
+    """Weiszfeld iterations land near the honest cluster even with 2/7 of
+    the weight placed far away (the mean would be dragged ~28 units)."""
+    rng = np.random.default_rng(2)
+    base = rng.normal(size=(3, 4)).astype(np.float32)
+    models = [
+        {"a": base + 0.01 * rng.normal(size=(3, 4)).astype(np.float32),
+         "b": np.float32(1.0) + 0.01 * rng.normal()}
+        for _ in range(5)
+    ]
+    models += [{"a": base + 100.0, "b": np.float32(101.0)} for _ in range(2)]
+    stacked = agg.tree_stack(models)
+    out = agg.geometric_median(stacked, np.ones((7,), np.float32), iters=16)
+    assert np.abs(np.asarray(out["a"]) - base).max() < 1.0
+    assert abs(float(out["b"]) - 1.0) < 1.0
+    # Structure and dtypes preserved through the flatten/unflatten.
+    assert out["a"].shape == base.shape and out["a"].dtype == base.dtype
+
+
+def test_geometric_median_matches_mean_when_symmetric():
+    """With two symmetric points and equal weights the geometric median is
+    their midpoint (= the mean), so the kernel agrees with fedavg there."""
+    models = [{"p": np.full((4,), -1.0, np.float32)}, {"p": np.full((4,), 3.0, np.float32)}]
+    stacked = agg.tree_stack(models)
+    w = np.ones((2,), np.float32)
+    gm = np.asarray(agg.geometric_median(stacked, w, iters=32)["p"])
+    fa = np.asarray(agg.fedavg(stacked, w)["p"])
+    np.testing.assert_allclose(gm, fa, atol=1e-3)

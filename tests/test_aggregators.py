@@ -188,3 +188,15 @@ def test_scaffold_requires_callback_info():
     with pytest.raises((ValueError, RuntimeError)):
         agg.wait_and_get_aggregation(timeout=0.1)
     assert agg.get_required_callbacks() == ["scaffold"]
+
+
+def test_geometric_median_rule():
+    """Node-mode GeometricMedian: output sits with the honest majority and
+    provenance covers all contributors (no discrete selection to hide)."""
+    from p2pfl_tpu.learning.aggregators import GeometricMedian
+
+    honest = [_model(2.0, [f"h{i}"]) for i in range(4)]
+    bad = _model(500.0, ["byz"])
+    out = GeometricMedian(iters=16).aggregate(honest + [bad])
+    np.testing.assert_allclose(out.get_parameters()[0], np.full((4, 4), 2.0), atol=0.5)
+    assert set(out.get_contributors()) == {"h0", "h1", "h2", "h3", "byz"}

@@ -490,3 +490,27 @@ def test_train_path_probe_rehearsal():
     assert "error" not in out, out
     assert out["achieved_tflops"] > 0
     assert out["seconds"] > 0
+
+
+@pytest.mark.slow
+def test_simulation_geometric_median_tolerates_poisoned_nodes():
+    """The geomedian rule composes with the mesh simulation's in-program
+    model poisoning: 2/16 nodes mount the 10x-scaled-delta attack and the
+    federation still learns (rotation-invariant robustness, no committee
+    subset selection)."""
+    data = synthetic_mnist(n_train=1600, n_test=256)
+    parts = data.generate_partitions(16, RandomIIDPartitionStrategy)
+    mask = np.zeros(16, np.float32)
+    mask[[0, 1]] = 1.0
+    sim = MeshSimulation(
+        mlp_model(seed=0),
+        parts,
+        train_set_size=4,
+        batch_size=32,
+        seed=3,
+        byzantine_mask=mask,
+        byzantine_attack="scaled",
+        aggregate_fn=agg_ops.geometric_median,
+    )
+    res = sim.run(rounds=4, epochs=1, warmup=False)
+    assert res.test_acc[-1] > 0.5, res.test_acc

@@ -46,6 +46,17 @@ def build_parser() -> argparse.ArgumentParser:
         default="fedavg",
     )
     p.add_argument("--mode", choices=["mesh", "nodes"], default="mesh")
+    p.add_argument(
+        "--server-opt",
+        choices=["none", "fedavgm", "fedadam", "fedyogi"],
+        default="none",
+        help="FedOpt server optimizer (mesh mode; Reddi et al. 2021)",
+    )
+    p.add_argument(
+        "--server-lr", type=float, default=0.01,
+        help="server step size for --server-opt (adaptive variants want "
+        "~0.003-0.01; fedavgm ~1.0)",
+    )
     p.add_argument("--batch-size", type=int, default=64)
     p.add_argument("--train-set-size", type=int, default=4, help="committee size")
     p.add_argument("--samples-per-node", type=int, default=300)
@@ -149,6 +160,8 @@ def run_mesh(args: argparse.Namespace) -> dict:
         lr=0.05 if algorithm == "scaffold" else 1e-3,
         dp_clip_norm=args.dp_clip,
         dp_noise_multiplier=args.dp_noise,
+        server_optimizer=None if args.server_opt == "none" else args.server_opt,
+        server_lr=args.server_lr,
     )
     res = sim.run(rounds=args.rounds, epochs=args.epochs, warmup=True)
     out = {

@@ -155,6 +155,8 @@ class MeshSimulation:
         scaffold_global_lr: float = 1.0,
         byzantine_mask: Optional[np.ndarray] = None,
         byzantine_attack: str = "signflip",
+        server_optimizer: "Optional[optax.GradientTransformation | str]" = None,
+        server_lr: float = 1.0,
     ) -> None:
         if task not in ("classification", "lm"):
             raise ValueError(f"unknown task {task!r}")
@@ -180,6 +182,46 @@ class MeshSimulation:
                 "control-variate scale 1/(steps*lr) is only valid for SGD at "
                 "exactly lr — pass lr=... instead of optimizer=..."
             )
+        # FedOpt family (Reddi et al. 2021, "Adaptive Federated
+        # Optimization"): the server treats x_t - aggregate as a
+        # pseudo-gradient and applies a server-side optimizer to the global
+        # model. State rides the existing c_global carry slot, so
+        # checkpointing/donation/reinit need no new plumbing. No reference
+        # analogue (its server update is always the plain weighted mean).
+        if server_optimizer is not None and algorithm == "scaffold":
+            raise ValueError(
+                "server_optimizer composes with fedavg-style aggregation; "
+                "scaffold defines its own server update"
+            )
+        if server_optimizer is not None and per_node_init:
+            raise ValueError(
+                "server_optimizer needs a shared round-start model "
+                "(per_node_init=False): the pseudo-gradient is x_t - aggregate"
+            )
+        # Pinned into checkpoint meta (like the DP parameters): resuming
+        # under a different server optimizer/lr would silently apply the
+        # restored moments through the wrong update rule.
+        self._server_opt_name = (
+            server_optimizer
+            if isinstance(server_optimizer, str)
+            else ("custom" if server_optimizer is not None else None)
+        )
+        self._server_lr = float(server_lr)
+        if isinstance(server_optimizer, str):
+            try:
+                server_optimizer = {
+                    # Reddi et al.'s recommended server settings: adaptivity
+                    # eps 1e-3 (much larger than local Adam's 1e-8).
+                    "fedavgm": optax.sgd(server_lr, momentum=0.9),
+                    "fedadam": optax.adam(server_lr, b1=0.9, b2=0.99, eps=1e-3),
+                    "fedyogi": optax.yogi(server_lr, b1=0.9, b2=0.99, eps=1e-3),
+                }[server_optimizer]
+            except KeyError:
+                raise ValueError(
+                    f"unknown server_optimizer {server_optimizer!r}: pass "
+                    "'fedavgm' | 'fedadam' | 'fedyogi' or an optax transformation"
+                ) from None
+        self.server_tx = server_optimizer
         self.task = task
         self.algorithm = algorithm
         self.scaffold_global_lr = float(scaffold_global_lr)
@@ -371,6 +413,18 @@ class MeshSimulation:
                 jax.tree.map(lambda p: np.zeros(p.shape, np.float32), template),
                 NamedSharding(self.mesh, P()),
             )
+        elif self.server_tx is not None:
+            # FedOpt server state (momentum / adaptive moments over the
+            # global model): replicated, riding the c_global carry slot.
+            self.c_stack = {}
+            self.c_global = jax.device_put(
+                {
+                    "server_opt": self.server_tx.init(
+                        jax.tree.map(lambda p: jnp.asarray(p, jnp.float32), template)
+                    )
+                },
+                NamedSharding(self.mesh, P()),
+            )
         else:
             self.c_stack = {}
             self.c_global = {}
@@ -540,6 +594,25 @@ class MeshSimulation:
         else:
             # FedAvg over the committee, weighted by true sample counts.
             agg = self.aggregate_fn(p_k_new, num_samples[committee])
+            if self.server_tx is not None:
+                # FedOpt server step: pseudo-gradient g = x_t - aggregate,
+                # applied through the server optimizer (sgd(1.0) reduces
+                # exactly to plain FedAvg; momentum/adam/yogi add server
+                # adaptivity). Runs inside the same jitted round body.
+                anchor = jax.tree.map(
+                    lambda a: a[0].astype(jnp.float32), params_stack
+                )
+                pseudo_grad = jax.tree.map(
+                    lambda x, g: x - g.astype(jnp.float32), anchor, agg
+                )
+                updates, new_sstate = self.server_tx.update(
+                    pseudo_grad, c_global["server_opt"], anchor
+                )
+                agg = jax.tree.map(
+                    lambda x, u, t: (x + u).astype(t.dtype),
+                    anchor, updates, agg,
+                )
+                c_global = {"server_opt": new_sstate}
 
         # Diffusion: every node adopts the aggregated model (gossip's fixed
         # point); committee members keep their updated optimizer state.
@@ -894,6 +967,17 @@ class MeshSimulation:
                 jax.tree.map(lambda p: np.zeros(p.shape, np.float32), self._template),
                 NamedSharding(self.mesh, P()),
             )
+        elif self.server_tx is not None:
+            self.c_global = jax.device_put(
+                {
+                    "server_opt": self.server_tx.init(
+                        jax.tree.map(
+                            lambda p: jnp.asarray(p, jnp.float32), self._template
+                        )
+                    )
+                },
+                NamedSharding(self.mesh, P()),
+            )
 
     def final_model(self, node: int = 0) -> ModelHandle:
         """Extract one node's model (they're all equal after diffusion)."""
@@ -918,6 +1002,7 @@ class MeshSimulation:
         state = {"params_stack": self.params_stack, "opt_stack": self.opt_stack}
         if self.algorithm == "scaffold":
             state["c_stack"] = self.c_stack
+        if self.algorithm == "scaffold" or self.server_tx is not None:
             state["c_global"] = self.c_global
         return state
 
@@ -938,6 +1023,12 @@ class MeshSimulation:
                 "nonprivate_steps_per_node": self._nonprivate_steps_per_node,
                 "dp_noise_multiplier": self.dp_noise_multiplier,
                 "dp_clip_norm": self.dp_clip_norm,
+                # FedOpt config pin: load_from rejects a resume under a
+                # different server optimizer/lr (adam and yogi share a
+                # state structure, so a mismatch would restore cleanly and
+                # silently diverge).
+                "server_opt": self._server_opt_name,
+                "server_lr": self._server_lr,
             },
         )
 
@@ -963,6 +1054,7 @@ class MeshSimulation:
         self.opt_stack = state["opt_stack"]
         if self.algorithm == "scaffold":
             self.c_stack = state["c_stack"]
+        if self.algorithm == "scaffold" or self.server_tx is not None:
             self.c_global = state["c_global"]
         self.completed_rounds = int(meta.get("completed_rounds", 0))
         # Restored state carries training progress: the warmup in run() must
@@ -994,6 +1086,18 @@ class MeshSimulation:
                     f"clip={self.dp_clip_norm}); resuming would re-price the "
                     "restored steps and invalidate privacy_spent()"
                 )
+        saved_opt = meta.get("server_opt")
+        if saved_opt != self._server_opt_name or (
+            saved_opt not in (None, "custom")
+            and float(meta.get("server_lr", 0.0)) != self._server_lr
+        ):
+            raise ValueError(
+                f"checkpoint was written with server_optimizer={saved_opt!r} "
+                f"(lr={meta.get('server_lr')}) but this simulation uses "
+                f"{self._server_opt_name!r} (lr={self._server_lr}); resuming "
+                "would apply the restored server moments through a different "
+                "update rule ('custom' transforms are matched by label only)"
+            )
         if "seed" in meta and int(meta["seed"]) != self.seed:
             self.seed = int(meta["seed"])
         return self.completed_rounds

@@ -250,3 +250,58 @@ def test_dp_resume_rejects_changed_noise_parameters(tmp_path):
     ok.load_from(ckpt)
     assert ok.privacy_spent()["steps"] == sim.privacy_spent()["steps"]
     ckpt.close()
+
+
+def test_simulation_fedopt_resume_bit_identical(tmp_path, parts8):
+    """FedOpt server state (adam moments in the c_global slot) must survive
+    checkpoint + restore: 4 straight rounds == 2 + save/restore + 2. A
+    resume that silently re-initialized the server moments would diverge."""
+    kw = dict(
+        train_set_size=4, batch_size=16, seed=5,
+        server_optimizer="fedadam", server_lr=0.003,
+    )
+
+    sim_full = MeshSimulation(mlp_model(seed=0), parts8, **kw)
+    res_full = sim_full.run(rounds=4, epochs=1, warmup=False)
+
+    sim_a = MeshSimulation(mlp_model(seed=0), parts8, **kw)
+    sim_a.run(rounds=2, epochs=1, warmup=False)
+    with FLCheckpointer(str(tmp_path / "fedopt")) as ck:
+        sim_a.save_to(ck)
+        ck.wait()
+
+        sim_b = MeshSimulation(mlp_model(seed=0), parts8, **kw)
+        assert sim_b.load_from(ck) == 2
+    res_b = sim_b.run(rounds=2, epochs=1, warmup=False)
+
+    _trees_equal(sim_full.params_stack, sim_b.params_stack)
+    _trees_equal(sim_full.c_global, sim_b.c_global)
+    assert res_full.test_acc[2:] == pytest.approx(res_b.test_acc, abs=1e-6)
+
+
+def test_fedopt_resume_rejects_changed_server_optimizer(tmp_path, parts8):
+    """adam and yogi share a state structure, so a mismatched resume would
+    restore cleanly and silently diverge — the meta pin must reject it."""
+    kw = dict(train_set_size=4, batch_size=16, seed=5)
+    sim_a = MeshSimulation(
+        mlp_model(seed=0), parts8, server_optimizer="fedadam",
+        server_lr=0.003, **kw,
+    )
+    sim_a.run(rounds=1, epochs=1, warmup=False)
+    with FLCheckpointer(str(tmp_path / "pin")) as ck:
+        sim_a.save_to(ck)
+        ck.wait()
+        for bad in (
+            dict(server_optimizer="fedyogi", server_lr=0.003),  # rule swap
+            dict(server_optimizer="fedadam", server_lr=0.1),    # lr swap
+            dict(),                                             # dropped entirely
+        ):
+            sim_b = MeshSimulation(mlp_model(seed=0), parts8, **kw, **bad)
+            with pytest.raises((ValueError, Exception)):
+                sim_b.load_from(ck)
+        # The matching config still restores.
+        sim_ok = MeshSimulation(
+            mlp_model(seed=0), parts8, server_optimizer="fedadam",
+            server_lr=0.003, **kw,
+        )
+        assert sim_ok.load_from(ck) == 1

@@ -65,12 +65,14 @@ class SelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        if self.axis_name is not None and self.attention_kind != "ring":
+        if self.axis_name is not None and self.attention_kind not in (
+            "ring", "ring_flash"
+        ):
             # A non-ring kernel under a mapped sequence axis would silently
             # attend only within the local shard.
             raise ValueError(
-                f"axis_name={self.axis_name!r} requires attention_kind='ring', "
-                f"got {self.attention_kind!r}"
+                f"axis_name={self.axis_name!r} requires attention_kind="
+                f"'ring' or 'ring_flash', got {self.attention_kind!r}"
             )
         b, s, e = x.shape
         head_dim = e // self.num_heads
@@ -90,11 +92,14 @@ class SelfAttention(nn.Module):
             out = blockwise_attention(q, k, v, causal=True, block_k=self.block_k)
         elif self.attention_kind == "flash":
             out = flash_attention(q, k, v, True, min(self.block_k, s), self.block_k)
-        elif self.attention_kind == "ring":
+        elif self.attention_kind in ("ring", "ring_flash"):
             if self.axis_name is None:
-                raise ValueError("attention_kind='ring' requires axis_name")
+                raise ValueError(
+                    f"attention_kind={self.attention_kind!r} requires axis_name"
+                )
             out = ring_attention(
-                q, k, v, self.axis_name, causal=True, block_k=self.block_k
+                q, k, v, self.axis_name, causal=True, block_k=self.block_k,
+                impl="flash" if self.attention_kind == "ring_flash" else "blockwise",
             )
         else:
             raise ValueError(f"unknown attention_kind {self.attention_kind!r}")

@@ -27,17 +27,33 @@ def sequence_parallel_attention(
     seq_axis: str = "seq",
     causal: bool = True,
     block_k: int = 512,
+    impl: str = "blockwise",
 ) -> Callable:
     """Return ``f(q, k, v) -> out`` computing exact attention with
-    ``[B, S, H, D]`` inputs sharded over ``seq_axis`` on dim 1."""
+    ``[B, S, H, D]`` inputs sharded over ``seq_axis`` on dim 1.
+
+    ``impl="flash"`` folds each ring rotation through the Pallas
+    flash-carry kernel (faster forward on TPU). vma checking stays ON
+    wherever the real kernel runs; it is disabled only for the
+    interpreted (non-TPU) flash path, because the Pallas interpreter
+    cannot trace varying-mesh-axis values through a kernel call.
+    """
     from p2pfl_tpu.ops.ring_attention import ring_attention
 
+    flash_interpreted = (
+        impl == "flash"
+        and next(iter(mesh.devices.flat)).platform != "tpu"
+    )
     spec = P(None, seq_axis, None, None)
     return jax.shard_map(
-        partial(ring_attention, axis_name=seq_axis, causal=causal, block_k=block_k),
+        partial(
+            ring_attention, axis_name=seq_axis, causal=causal,
+            block_k=block_k, impl=impl,
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        check_vma=not flash_interpreted,
     )
 
 

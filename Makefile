@@ -1,0 +1,21 @@
+# Convenience targets; everything also runs as the plain commands shown.
+
+.PHONY: test test-fast bench dryrun proto-check api-docs
+
+test:            ## full suite on the virtual 8-device CPU mesh (~30 min, 1 core)
+	python -m pytest tests/ -q
+
+test-fast:       ## CI subset (~2 min)
+	python -m pytest tests/ -m "not slow" -q
+
+bench:           ## north-star benchmark (real TPU; waits for the tunnel)
+	python bench.py
+
+dryrun:          ## 5-phase multichip dryrun on an 8-device virtual CPU mesh
+	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+proto-check:     ## fail if node_pb2.py is stale w.r.t. node.proto
+	python -m p2pfl_tpu.comm.grpc.generate_proto --check
+
+api-docs:        ## regenerate docs/api.md from the live package
+	PYTHONPATH=. python scripts/gen_api_docs.py

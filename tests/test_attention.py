@@ -16,6 +16,7 @@ from p2pfl_tpu.ops.attention import (
     blockwise_attention,
     dense_attention,
     flash_attention,
+    flash_chunk_update,
 )
 from p2pfl_tpu.ops.ring_attention import ring_attention
 
@@ -210,3 +211,23 @@ def test_ring_flash_grads_match_dense():
     g_out = jax.grad(lambda *a: jnp.sum(jax.jit(ring)(*a) ** 2), (0, 1, 2))(q, k, v)
     for a, b in zip(g_out, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-3)
+
+
+def test_flash_chunk_update_matches_flash_forward():
+    """One whole-sequence fold through the carry kernel, finalized, equals
+    the plain flash forward — pins the two kernels to each other (the ring
+    is built from the carry kernel; the bench measures the forward one)."""
+    q, k, v = _qkv(seed=7)
+    qt, kt, vt = (jnp.moveaxis(t, 2, 1) for t in (q, k, v))
+    b, h, s, d = qt.shape
+    m0 = jnp.full((b, h, s, 128), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, s, 128), jnp.float32)
+    acc0 = jnp.zeros((b, h, s, d), jnp.float32)
+    _, l, acc = flash_chunk_update(
+        (m0, l0, acc0), qt, kt, vt, 0, 0, causal=True, block_q=16, block_k=16
+    )
+    out = jnp.moveaxis(
+        (acc / jnp.maximum(l[..., :1], 1e-30)).astype(q.dtype), 1, 2
+    )
+    ref = flash_attention(q, k, v, True, 16, 16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)

@@ -574,3 +574,22 @@ def test_fedopt_variants_converge(name, server_lr, parts16):
     )
     res = sim.run(rounds=4, epochs=1, warmup=False, rounds_per_call=4)
     assert res.test_acc[-1] > 0.5, (name, res.test_acc)
+
+
+@pytest.mark.slow
+def test_fedopt_composes_with_robust_aggregation():
+    """Server momentum over a robust aggregate: geomedian filters the
+    10x-scaled-delta attackers, fedavgm's server momentum then smooths the
+    filtered update — the federation learns under attack."""
+    data = synthetic_mnist(n_train=1600, n_test=256)
+    parts = data.generate_partitions(16, RandomIIDPartitionStrategy)
+    mask = np.zeros(16, np.float32)
+    mask[[3, 11]] = 1.0
+    sim = MeshSimulation(
+        mlp_model(seed=0), parts, train_set_size=4, batch_size=32, seed=4,
+        byzantine_mask=mask, byzantine_attack="scaled",
+        aggregate_fn=agg_ops.geometric_median,
+        server_optimizer="fedavgm", server_lr=1.0,
+    )
+    res = sim.run(rounds=4, epochs=1, warmup=False, rounds_per_call=2)
+    assert res.test_acc[-1] > 0.5, res.test_acc

@@ -77,6 +77,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--lr", type=float, default=None, help="default: 0.05 scaffold, 1e-3 else")
     p.add_argument(
+        "--clip-update-norm", type=float, default=0.0,
+        help="norm-bounding defense: clip member deltas to this L2 norm "
+        "before aggregation (0 = off; composes with any aggregator)",
+    )
+    p.add_argument(
         "--seed",
         type=int,
         default=None,
@@ -106,6 +111,11 @@ def run(args: argparse.Namespace) -> dict:
         raise SystemExit(f"--rounds-per-call must be >= 1, got {args.rounds_per_call}")
     if args.eval_every < 1:
         raise SystemExit(f"--eval-every must be >= 1, got {args.eval_every}")
+    if args.aggregator == "scaffold" and args.clip_update_norm > 0:
+        raise SystemExit(
+            "--clip-update-norm composes with fedavg-style aggregators; "
+            "scaffold's control variates assume unclipped deltas"
+        )
     if args.aggregator == "scaffold" and args.attack != "labelflip" and args.poison_frac > 0:
         raise SystemExit(
             "model-poisoning attacks (--attack signflip/scaled) need a robust "
@@ -184,6 +194,7 @@ def run(args: argparse.Namespace) -> dict:
         lr=lr,
         byzantine_mask=byzantine_mask,
         byzantine_attack=args.attack,
+        clip_update_norm=args.clip_update_norm,
     ) as sim:
         res = sim.run(
             rounds=args.rounds, epochs=args.epochs, warmup=True,

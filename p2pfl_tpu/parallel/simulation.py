@@ -157,6 +157,7 @@ class MeshSimulation:
         byzantine_attack: str = "signflip",
         server_optimizer: "Optional[optax.GradientTransformation | str]" = None,
         server_lr: float = 1.0,
+        clip_update_norm: float = 0.0,
     ) -> None:
         if task not in ("classification", "lm"):
             raise ValueError(f"unknown task {task!r}")
@@ -222,6 +223,17 @@ class MeshSimulation:
                     "'fedavgm' | 'fedadam' | 'fedyogi' or an optax transformation"
                 ) from None
         self.server_tx = server_optimizer
+        # Norm-bounding defense (clip member deltas pre-aggregation).
+        # Scaffold is rejected: its control-variate update assumes the raw
+        # local delta, and clipping would silently bias the variates.
+        if clip_update_norm < 0.0:
+            raise ValueError("clip_update_norm must be >= 0")
+        if clip_update_norm > 0.0 and algorithm == "scaffold":
+            raise ValueError(
+                "clip_update_norm composes with fedavg-style aggregation; "
+                "scaffold's control variates assume unclipped deltas"
+            )
+        self.clip_update_norm = float(clip_update_norm)
         self.task = task
         self.algorithm = algorithm
         self.scaffold_global_lr = float(scaffold_global_lr)
@@ -562,6 +574,35 @@ class MeshSimulation:
                 return jnp.where(sel, attacked, new.astype(jnp.float32)).astype(new.dtype)
 
             p_k_new = jax.tree.map(corrupt, p_k_new, p_k)
+
+        if self.clip_update_norm > 0.0:
+            # Norm-bounding defense: clip each member's round DELTA to a
+            # max global L2 norm before aggregation. Placed AFTER the
+            # byzantine corruption on purpose — a 10x-scaled-delta attack
+            # is exactly what this neutralizes, even under plain FedAvg.
+            # (Norm bounding, e.g. Sun et al. 2019 "Can You Really Backdoor
+            # Federated Learning?"; composes with any aggregate_fn.)
+            sq_sums = jax.tree.map(
+                lambda new, old: jnp.sum(
+                    (new.astype(jnp.float32) - old.astype(jnp.float32)) ** 2,
+                    axis=tuple(range(1, new.ndim)),
+                ),
+                p_k_new,
+                p_k,
+            )
+            norms = jnp.sqrt(
+                sum(jax.tree.leaves(sq_sums)) + 1e-12
+            )  # [K] per-member delta norm
+            scale = jnp.minimum(1.0, self.clip_update_norm / norms)
+            p_k_new = jax.tree.map(
+                lambda new, old: (
+                    old.astype(jnp.float32)
+                    + (new.astype(jnp.float32) - old.astype(jnp.float32))
+                    * scale.reshape((-1,) + (1,) * (new.ndim - 1))
+                ).astype(new.dtype),
+                p_k_new,
+                p_k,
+            )
 
         if self.algorithm == "scaffold":
             # Server step (same jitted kernel as the host-mode Scaffold

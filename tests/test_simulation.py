@@ -593,3 +593,45 @@ def test_fedopt_composes_with_robust_aggregation():
     )
     res = sim.run(rounds=4, epochs=1, warmup=False, rounds_per_call=2)
     assert res.test_acc[-1] > 0.5, res.test_acc
+
+
+@pytest.mark.slow
+def test_clip_update_norm_bounds_deltas_and_learns_under_attack():
+    """Norm bounding: plain FedAvg with clip_update_norm still learns under
+    the 10x-scaled-delta attack, and the clip provably binds — a clip far
+    below the honest delta norm visibly throttles training. (At this MLP/
+    MNIST scale undefended FedAvg eventually recovers too, so the defense
+    contrast lives in the CIFAR bench; here we pin the mechanism.)"""
+    data = synthetic_mnist(n_train=1600, n_test=256)
+    parts = data.generate_partitions(16, RandomIIDPartitionStrategy)
+    mask = np.zeros(16, np.float32)
+    mask[[2, 9]] = 1.0
+    kw = dict(
+        train_set_size=4, batch_size=32, seed=6,
+        byzantine_mask=mask, byzantine_attack="scaled",
+    )
+    clipped = MeshSimulation(
+        mlp_model(seed=0), parts, clip_update_norm=5.0, **kw
+    )
+    r_ok = clipped.run(rounds=2, epochs=1, warmup=False, rounds_per_call=2)
+    assert r_ok.test_acc[-1] > 0.5, r_ok.test_acc
+    throttled = MeshSimulation(
+        mlp_model(seed=0), parts, clip_update_norm=0.01, **kw
+    )
+    r_slow = throttled.run(rounds=2, epochs=1, warmup=False, rounds_per_call=2)
+    # A clip two orders below the honest delta norm must visibly slow
+    # training — proves the clip actually binds inside the jitted round.
+    assert r_slow.test_acc[-1] < r_ok.test_acc[-1] - 0.2, (
+        r_slow.test_acc, r_ok.test_acc,
+    )
+
+
+def test_clip_update_norm_validations():
+    data = synthetic_mnist(n_train=256, n_test=64)
+    parts = data.generate_partitions(4, RandomIIDPartitionStrategy)
+    with pytest.raises(ValueError, match="clip_update_norm"):
+        MeshSimulation(mlp_model(seed=0), parts, clip_update_norm=-1.0)
+    with pytest.raises(ValueError, match="scaffold"):
+        MeshSimulation(
+            mlp_model(seed=0), parts, algorithm="scaffold", clip_update_norm=1.0
+        )

@@ -1,0 +1,94 @@
+"""Topology construction (parity with reference utils/topologies.py:30-93
+plus the GRID / ERDOS_RENYI extensions): adjacency-matrix invariants per
+type, and real in-memory nodes wired per the matrix."""
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.utils.topologies import TopologyFactory, TopologyType
+
+
+def _connected(adj: np.ndarray) -> bool:
+    n = adj.shape[0]
+    seen = {0}
+    frontier = [0]
+    while frontier:
+        i = frontier.pop()
+        for j in TopologyFactory.neighbors_of(adj, i):
+            if j not in seen:
+                seen.add(j)
+                frontier.append(j)
+    return len(seen) == n
+
+
+@pytest.mark.parametrize("topology", list(TopologyType))
+@pytest.mark.parametrize("n", [1, 2, 5, 9])
+def test_matrix_invariants(topology, n):
+    """Every topology yields a symmetric, hollow, connected 0/1 matrix."""
+    adj = TopologyFactory.generate_matrix(topology, n, seed=3)
+    assert adj.shape == (n, n)
+    assert set(np.unique(adj)) <= {0, 1}
+    np.testing.assert_array_equal(adj, adj.T)
+    assert np.diagonal(adj).sum() == 0
+    if n > 1:
+        assert _connected(adj), topology
+
+
+def test_exact_structures():
+    star = TopologyFactory.generate_matrix(TopologyType.STAR, 5)
+    assert star[0].sum() == 4 and all(star[i].sum() == 1 for i in range(1, 5))
+    line = TopologyFactory.generate_matrix(TopologyType.LINE, 5)
+    assert line.sum() == 2 * 4  # n-1 undirected edges
+    assert line[0].sum() == 1 and line[2].sum() == 2
+    ring = TopologyFactory.generate_matrix(TopologyType.RING, 5)
+    assert (ring.sum(axis=0) == 2).all()
+    full = TopologyFactory.generate_matrix(TopologyType.FULL, 5)
+    assert (full.sum(axis=0) == 4).all()
+
+
+def test_grid_degrees():
+    """3x3 grid: corners degree 2, edges 3, center 4."""
+    adj = TopologyFactory.generate_matrix(TopologyType.GRID, 9)
+    degrees = sorted(adj.sum(axis=0).tolist())
+    assert degrees == [2, 2, 2, 2, 3, 3, 3, 3, 4]
+
+
+def test_erdos_renyi_seeded_and_connected():
+    a = TopologyFactory.generate_matrix(TopologyType.ERDOS_RENYI, 12, p=0.2, seed=7)
+    b = TopologyFactory.generate_matrix(TopologyType.ERDOS_RENYI, 12, p=0.2, seed=7)
+    np.testing.assert_array_equal(a, b)  # deterministic under a seed
+    c = TopologyFactory.generate_matrix(TopologyType.ERDOS_RENYI, 12, p=0.2, seed=8)
+    assert not np.array_equal(a, c)  # and varies with it
+    # Even at p=0 the ring backbone guarantees connectivity.
+    z = TopologyFactory.generate_matrix(TopologyType.ERDOS_RENYI, 12, p=0.0, seed=1)
+    assert _connected(z)
+
+
+def test_connect_nodes_wires_real_federation():
+    """connect_nodes on in-memory nodes: direct-neighbor sets match the
+    matrix (STAR: the hub sees all spokes, spokes see the hub)."""
+    from p2pfl_tpu.config import Settings
+    from p2pfl_tpu.learning.dataset import (
+        RandomIIDPartitionStrategy,
+        synthetic_mnist,
+    )
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.node import Node
+
+    Settings.RESOURCE_MONITOR_PERIOD = 0
+    parts = synthetic_mnist(n_train=128, n_test=32).generate_partitions(
+        4, RandomIIDPartitionStrategy
+    )
+    nodes = [Node(mlp_model(seed=i), parts[i]) for i in range(4)]
+    for node in nodes:
+        node.start()
+    try:
+        adj = TopologyFactory.generate_matrix(TopologyType.STAR, 4)
+        TopologyFactory.connect_nodes(adj, nodes)
+        hub_direct = set(nodes[0].get_neighbors(only_direct=True))
+        assert hub_direct == {nodes[i].addr for i in (1, 2, 3)}
+        for i in (1, 2, 3):
+            assert set(nodes[i].get_neighbors(only_direct=True)) == {nodes[0].addr}
+    finally:
+        for node in nodes:
+            node.stop()

@@ -75,20 +75,22 @@ def test_connect_nodes_wires_real_federation():
     from p2pfl_tpu.models import mlp_model
     from p2pfl_tpu.node import Node
 
-    Settings.RESOURCE_MONITOR_PERIOD = 0
     parts = synthetic_mnist(n_train=128, n_test=32).generate_partitions(
         4, RandomIIDPartitionStrategy
     )
-    nodes = [Node(mlp_model(seed=i), parts[i]) for i in range(4)]
-    for node in nodes:
-        node.start()
-    try:
-        adj = TopologyFactory.generate_matrix(TopologyType.STAR, 4)
-        TopologyFactory.connect_nodes(adj, nodes)
-        hub_direct = set(nodes[0].get_neighbors(only_direct=True))
-        assert hub_direct == {nodes[i].addr for i in (1, 2, 3)}
-        for i in (1, 2, 3):
-            assert set(nodes[i].get_neighbors(only_direct=True)) == {nodes[0].addr}
-    finally:
+    with Settings.overridden(RESOURCE_MONITOR_PERIOD=0):
+        nodes = [Node(mlp_model(seed=i), parts[i]) for i in range(4)]
         for node in nodes:
-            node.stop()
+            node.start()
+        try:
+            adj = TopologyFactory.generate_matrix(TopologyType.STAR, 4)
+            TopologyFactory.connect_nodes(adj, nodes)
+            hub_direct = set(nodes[0].get_neighbors(only_direct=True))
+            assert hub_direct == {nodes[i].addr for i in (1, 2, 3)}
+            for i in (1, 2, 3):
+                assert set(nodes[i].get_neighbors(only_direct=True)) == {
+                    nodes[0].addr
+                }
+        finally:
+            for node in nodes:
+                node.stop()

@@ -1,0 +1,108 @@
+"""REST telemetry client + resource monitor (parity with reference
+management/p2pfl_web_services.py:58-268 and node_monitor.py:31-86):
+payload shapes against a real local HTTP server, the fail-safe breaker,
+and the monitor's periodic system-metric reporting."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.management.node_monitor import NodeMonitor
+from p2pfl_tpu.management.web_services import WebServices
+
+
+@pytest.fixture()
+def web_server():
+    """A real localhost HTTP sink recording (path, headers, body) tuples."""
+    received = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):  # noqa: N802 (stdlib naming)
+            body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            received.append(
+                (self.path, self.headers.get("x-api-key"), json.loads(body))
+            )
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):  # keep pytest output clean
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield f"http://127.0.0.1:{srv.server_port}", received
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_payload_shapes_and_api_key(web_server):
+    url, received = web_server
+    ws = WebServices(url, key="sekrit")
+    ws.register_node("node-a")
+    ws.send_log("node-a", "INFO", "hello")
+    ws.send_local_metric("node-a", "exp1", "loss", 0.5, round=2, step=7)
+    ws.send_global_metric("node-a", "exp1", "test_acc", 0.9, round=2)
+    ws.send_system_metric("node-a", "cpu_percent", 12.5)
+    ws.unregister_node("node-a")
+    paths = [p for p, _, _ in received]
+    assert paths == [
+        "/node", "/node-log", "/node-metric/local", "/node-metric/global",
+        "/node-metric/system", "/node-remove",
+    ]
+    assert all(key == "sekrit" for _, key, _ in received)
+    local = received[2][2]
+    assert local == {
+        "address": "node-a", "experiment": "exp1", "metric": "loss",
+        "value": 0.5, "round": 2, "step": 7,
+    }
+
+
+def test_breaker_opens_on_unreachable_sink():
+    """Telemetry failures must never take a node down: the first failed
+    POST trips the breaker, later calls return instantly without IO."""
+    ws = WebServices("http://127.0.0.1:1", key="k", timeout=0.5)
+    ws.register_node("node-a")  # fails, trips the breaker, swallowed
+    assert ws._broken
+    t0 = time.monotonic()
+    for _ in range(50):
+        ws.send_log("node-a", "INFO", "dropped")
+    assert time.monotonic() - t0 < 0.2  # no network attempts after the trip
+
+
+def test_node_monitor_reports_system_metrics():
+    psutil = pytest.importorskip("psutil")  # noqa: F841 — monitor needs it
+    reported = []
+    with Settings.overridden(RESOURCE_MONITOR_PERIOD=0.05):
+        mon = NodeMonitor("node-a", lambda n, m, v: reported.append((n, m, v)))
+        mon.start()
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline and len(reported) < 4:
+            time.sleep(0.05)
+        mon.stop()
+    metrics = {m for _, m, _ in reported}
+    assert {"cpu_percent", "ram_percent", "net_in_mbps", "net_out_mbps"} <= metrics
+    assert all(n == "node-a" for n, _, _ in reported)
+    n_before = len(reported)
+    time.sleep(0.2)  # stop() must actually stop the thread
+    assert len(reported) == n_before
+
+
+def test_logger_connect_web_routes_registration(web_server):
+    url, received = web_server
+    from p2pfl_tpu.management.logger import logger
+
+    logger.connect_web(url, "k2")
+    try:
+        logger.register_node("node-w")
+        logger.unregister_node("node-w")
+    finally:
+        logger._web_services = None  # detach so other tests stay offline
+    paths = [p for p, _, _ in received]
+    assert "/node" in paths

@@ -1407,6 +1407,47 @@ def bench_torch_cpu_fallback() -> dict:
     }
 
 
+#: Auxiliary on-chip captures appended to a successful TPU-path run when
+#: budget remains: the VERDICT r4 evidence items (CIFAR robust trio,
+#: attention microbench, transformer-LM MFU) that rounds 3-5 could not
+#: land because the tunnel was down whenever a builder session looked.
+#: Each runs as a hard-capped subprocess; failures/skips are recorded,
+#: never fatal to the main metric line.
+AUX_CAPTURES = [
+    ("cifar_resnet_trio", "--cifar", 1500.0),
+    ("attention_microbench", "--attn", 1500.0),
+    ("lm_mfu", "--lm-mfu", 900.0),
+]
+
+
+def _run_aux_captures(
+    t_start: float, soft_budget: float, env: dict, specs=None, into: dict = None
+) -> dict:
+    """Run the aux capture queue with whatever budget remains (90s margin
+    per leg); returns {name: result | {"error"/"skipped": ...}}. Results
+    are written into ``into`` AS EACH LEG COMPLETES — the caller attaches
+    that dict to the output line first, so a SIGTERM mid-queue still
+    prints every leg already measured (the invariant run_cifar_bench
+    states for its own legs)."""
+    aux: dict = {} if into is None else into
+    for name, flag, cap in (specs if specs is not None else AUX_CAPTURES):
+        remaining = soft_budget - (time.monotonic() - t_start)
+        cap = min(cap, remaining - 90.0)
+        if cap < 240.0:
+            aux[name] = {"skipped": "soft budget exhausted"}
+            continue
+        _phase(f"aux capture {name} (cap {cap:.0f}s)")
+        try:
+            aux[name] = _json_subprocess([flag], cap, env)
+            _phase(f"aux capture {name} done")
+        except Exception as e:  # noqa: BLE001 — aux must never kill the metric
+            traceback.print_exc(file=sys.stderr)
+            # Keep the TAIL: _json_subprocess appends the child's stderr
+            # tail there, which is the diagnosable part.
+            aux[name] = {"error": f"{type(e).__name__}: {str(e)[-800:]}"}
+    return aux
+
+
 def _assemble(out: dict, tpu: dict, base: dict, kind: str, mfu: dict) -> None:
     """Fill the output line from a measurement + baseline pair. ONE
     assembler for the TPU and degraded paths so their JSON shapes can
@@ -1574,6 +1615,14 @@ def main() -> None:
             base = bench_torch_cpu_fallback()
         _phase("baseline done")
         _assemble(out, tm["tpu"], base, tm["kind"], tm["mfu"])
+        # From here the REAL metric line exists: a SIGTERM during the aux
+        # captures below must print it, not the degraded fallback — and
+        # the aux dict is attached BEFORE the legs run so completed legs
+        # survive a mid-queue TERM.
+        best = out
+        aux: dict = {}
+        out["extra"]["aux_captures"] = aux
+        _run_aux_captures(t_start, soft_budget, tpu_env, into=aux)
     except Exception as e:  # noqa: BLE001
         traceback.print_exc(file=sys.stderr)
         if not best:

@@ -78,3 +78,55 @@ def test_mh_cfg_env_overrides(monkeypatch):
     assert cfg["nodes"] == 32
     assert cfg["rpc"] == 3
     assert cfg["procs"] == bench.MH_PROCS  # untouched knobs keep defaults
+
+
+def test_aux_captures_success_order_and_error_isolation(monkeypatch):
+    """Aux legs run in order with per-leg caps; a failing leg records its
+    error and later legs still run (evidence capture must never be
+    all-or-nothing)."""
+    calls = []
+
+    def fake_subprocess(args, timeout, env):
+        calls.append((args[0], timeout))
+        if args[0] == "--attn":
+            raise RuntimeError("tunnel wedged mid-leg")
+        return {"metric": args[0], "value": 1}
+
+    monkeypatch.setattr(bench, "_json_subprocess", fake_subprocess)
+    aux = bench._run_aux_captures(time.monotonic(), 10_000.0, {})
+    assert [c[0] for c in calls] == ["--cifar", "--attn", "--lm-mfu"]
+    assert aux["cifar_resnet_trio"] == {"metric": "--cifar", "value": 1}
+    assert "tunnel wedged" in aux["attention_microbench"]["error"]
+    assert aux["lm_mfu"]["metric"] == "--lm-mfu"
+
+
+def test_aux_captures_skip_on_exhausted_budget(monkeypatch):
+    """With the budget spent, every leg is skipped without any subprocess."""
+    monkeypatch.setattr(
+        bench, "_json_subprocess",
+        lambda *a, **k: (_ for _ in ()).throw(AssertionError("must not run")),
+    )
+    aux = bench._run_aux_captures(time.monotonic() - 5_000.0, 5_000.0, {})
+    assert all(v == {"skipped": "soft budget exhausted"} for v in aux.values())
+
+
+def test_aux_captures_partial_budget(monkeypatch):
+    """A budget that only funds the first leg skips the rest (caps shrink
+    with elapsed time)."""
+    specs = [("a", "--a", 1500.0), ("b", "--b", 1500.0)]
+    t0 = time.monotonic()
+    # 400s of budget: leg a gets min(1500, 400-90)=310 >= 240 and runs; a
+    # consuming fake then advances the clock so leg b sees the shrink.
+    consumed = []
+
+    def consuming(args, timeout, env):
+        consumed.append(timeout)
+        monkeypatch.setattr(
+            bench.time, "monotonic", lambda: t0 + 200.0
+        )  # leg took 200s
+        return {"ok": args[0]}
+
+    monkeypatch.setattr(bench, "_json_subprocess", consuming)
+    aux = bench._run_aux_captures(t0, 400.0, {}, specs=specs)
+    assert "ok" in aux["a"]
+    assert aux["b"] == {"skipped": "soft budget exhausted"}

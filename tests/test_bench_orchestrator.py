@@ -130,3 +130,22 @@ def test_aux_captures_partial_budget(monkeypatch):
     aux = bench._run_aux_captures(t0, 400.0, {}, specs=specs)
     assert "ok" in aux["a"]
     assert aux["b"] == {"skipped": "soft budget exhausted"}
+
+
+def test_aux_captures_mutate_attached_dict_in_place(monkeypatch):
+    """The caller attaches `into` to the output line BEFORE the legs run;
+    each completed leg must be visible in that same dict (the SIGTERM
+    mid-queue survival property)."""
+    seen_at_leg2 = {}
+
+    def fake_subprocess(args, timeout, env):
+        if args[0] == "--attn":
+            seen_at_leg2.update(attached)  # snapshot mid-queue
+        return {"metric": args[0]}
+
+    monkeypatch.setattr(bench, "_json_subprocess", fake_subprocess)
+    attached = {}
+    out = bench._run_aux_captures(time.monotonic(), 10_000.0, {}, into=attached)
+    assert out is attached
+    # By the time leg 2 ran, leg 1's completed result was already attached.
+    assert seen_at_leg2.get("cifar_resnet_trio") == {"metric": "--cifar"}

@@ -1,0 +1,53 @@
+"""Utility helpers (parity with reference utils/utils.py:39-145): the e2e
+assertions lean on these, so their FAILURE paths matter — a
+check_equal_models that cannot fail would make every model-equality e2e
+assertion vacuous."""
+
+import types
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.utils.utils import check_equal_models, wait_convergence
+
+
+def _fake_node(params):
+    """Duck-typed node.learner.get_model().get_parameters() chain."""
+    model = types.SimpleNamespace(get_parameters=lambda: params)
+    learner = types.SimpleNamespace(get_model=lambda: model)
+    return types.SimpleNamespace(learner=learner)
+
+
+def test_check_equal_models_accepts_close_models():
+    a = [np.ones((3, 3), np.float32), np.zeros((2,), np.float32)]
+    b = [p + 0.05 for p in a]  # inside the reference's atol=1e-1
+    check_equal_models([_fake_node(a), _fake_node(b)])
+
+
+def test_check_equal_models_detects_divergence():
+    a = [np.ones((3, 3), np.float32)]
+    b = [np.ones((3, 3), np.float32) + 1.0]  # far outside atol
+    with pytest.raises(AssertionError):
+        check_equal_models([_fake_node(a), _fake_node(b)])
+
+
+def test_check_equal_models_detects_shape_mismatch():
+    a = [np.ones((3, 3), np.float32)]
+    b = [np.ones((3, 2), np.float32)]
+    with pytest.raises(AssertionError, match="shape mismatch"):
+        check_equal_models([_fake_node(a), _fake_node(b)])
+
+
+def test_check_equal_models_detects_layer_count_mismatch():
+    a = [np.ones((3,), np.float32)]
+    b = [np.ones((3,), np.float32), np.ones((2,), np.float32)]
+    with pytest.raises(AssertionError, match="layer count"):
+        check_equal_models([_fake_node(a), _fake_node(b)])
+
+
+def test_wait_convergence_times_out():
+    node = types.SimpleNamespace(
+        addr="fake-0", get_neighbors=lambda only_direct=False: []
+    )
+    with pytest.raises(TimeoutError):
+        wait_convergence([node], 1, wait=0.2)

@@ -647,10 +647,18 @@ def run_multihost() -> None:
     # (jax warnings + _phase lines) inside a collective would deadlock the
     # whole deployment if we drained sequentially.
     outs: list[str] = [""] * len(procs)
+    # Worker cap derives from the soft budget like every other subprocess
+    # cap (measure_reference_baseline, main's metric_cap) instead of a
+    # hard-coded 1800 s: most of the budget, minus a reporting reserve.
+    try:
+        soft_budget = float(os.environ.get("P2PFL_TPU_BENCH_BUDGET", "3000"))
+    except ValueError:
+        soft_budget = 3000.0
+    worker_cap = max(120.0, soft_budget - 120.0)
 
     def _drain(i: int, p) -> None:
         try:
-            outs[i], _ = p.communicate(timeout=1800)
+            outs[i], _ = p.communicate(timeout=worker_cap)
         except subprocess.TimeoutExpired:
             p.kill()
             outs[i], _ = p.communicate()
@@ -1221,6 +1229,125 @@ def run_cifar_bench() -> None:
     os._exit(1 if "error" in out else 0)
 
 
+def run_wire_bench() -> None:
+    """Subprocess-style mode ``--wire``: sparse delta gossip wire-bytes
+    benchmark. Runs the same in-memory MNIST FedAvg federation twice — dense
+    frames (``WIRE_COMPRESSION="none"``) vs the sparse delta path
+    (``"topk"``, error-feedback top-k at ``WIRE_TOPK_RATIO``) — over the
+    real Node/gossip/aggregator stack, and reports the bytes-per-round
+    counter (model-plane TX, counted at the gossip send point) next to
+    final accuracy. Prints ONE JSON line.
+
+    Shape overrides: P2PFL_TPU_WIRE_NODES (default 8), P2PFL_TPU_WIRE_ROUNDS
+    (default 3), P2PFL_TPU_WIRE_TOPK_RATIO (default 0.1).
+    """
+    out: dict = {}
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"  # protocol-stack bench: CPU is the venue
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+        from p2pfl_tpu.config import Settings
+        from p2pfl_tpu.learning.dataset import (
+            RandomIIDPartitionStrategy,
+            synthetic_mnist,
+        )
+        from p2pfl_tpu.models import mlp_model
+        from p2pfl_tpu.node import Node
+        from p2pfl_tpu.utils.utils import set_test_settings, wait_convergence
+
+        n_nodes = int(os.environ.get("P2PFL_TPU_WIRE_NODES", "8"))
+        rounds = int(os.environ.get("P2PFL_TPU_WIRE_ROUNDS", "3"))
+        ratio = float(os.environ.get("P2PFL_TPU_WIRE_TOPK_RATIO", "0.1"))
+        set_test_settings()
+        Settings.RESOURCE_MONITOR_PERIOD = 0
+        Settings.LOG_LEVEL = "WARNING"
+        # full committee: every node trains, so the dominant traffic is the
+        # partial-model gossip the sparse path compresses
+        Settings.TRAIN_SET_SIZE = n_nodes
+        Settings.WIRE_TOPK_RATIO = ratio
+
+        runs: dict = {}
+        for scheme in ("none", "topk"):
+            Settings.WIRE_COMPRESSION = scheme
+            _phase(f"wire bench: {n_nodes}-node federation, scheme={scheme}")
+            data = synthetic_mnist(n_train=256 * n_nodes, n_test=256)
+            parts = data.generate_partitions(n_nodes, RandomIIDPartitionStrategy)
+            nodes = [
+                Node(mlp_model(seed=i), parts[i], batch_size=32)
+                for i in range(n_nodes)
+            ]
+            for nd in nodes:
+                nd.start()
+            try:
+                for i in range(1, n_nodes):
+                    nodes[i].connect(nodes[0].addr)
+                wait_convergence(nodes, n_nodes - 1, wait=30)
+                nodes[0].set_start_learning(rounds=rounds, epochs=1)
+                deadline = time.time() + 900
+                while time.time() < deadline:
+                    if all(
+                        not nd.learning_in_progress()
+                        and nd.learning_workflow is not None
+                        for nd in nodes
+                    ):
+                        break
+                    time.sleep(0.25)
+                else:
+                    raise TimeoutError(f"{scheme} federation did not finish")
+                tx_bytes = sum(
+                    nd.protocol.gossiper.total_tx_bytes() for nd in nodes
+                )
+                tx_frames = sum(
+                    sum(f for f, _ in nd.protocol.gossiper.wire_stats().values())
+                    for nd in nodes
+                )
+                accs = [nd.learner.evaluate().get("test_acc", 0.0) for nd in nodes]
+                runs[scheme] = {
+                    "model_tx_bytes_total": int(tx_bytes),
+                    "model_tx_frames": int(tx_frames),
+                    "bytes_per_round": round(tx_bytes / rounds, 1),
+                    "final_test_acc_mean": round(sum(accs) / len(accs), 4),
+                    "final_test_acc_min": round(min(accs), 4),
+                }
+                _phase(f"wire bench {scheme}: {json.dumps(runs[scheme])}")
+            finally:
+                for nd in nodes:
+                    nd.stop()
+                InMemoryRegistry.reset()
+        ratio_measured = runs["none"]["bytes_per_round"] / max(
+            runs["topk"]["bytes_per_round"], 1.0
+        )
+        out = {
+            "metric": "wire_bytes_per_round_8node_mnist_fedavg",
+            "value": runs["topk"]["bytes_per_round"],
+            "unit": "bytes/round",
+            "vs_baseline": round(ratio_measured, 2),
+            "extra": {
+                "nodes": n_nodes,
+                "rounds": rounds,
+                "topk_ratio": ratio,
+                "runs": runs,
+                "acc_delta_pp": round(
+                    100.0
+                    * (
+                        runs["none"]["final_test_acc_mean"]
+                        - runs["topk"]["final_test_acc_mean"]
+                    ),
+                    2,
+                ),
+                "note": "vs_baseline = dense bytes/round over sparse "
+                "bytes/round (error-feedback top-k delta gossip)",
+            },
+        }
+    except Exception as e:  # noqa: BLE001
+        traceback.print_exc(file=sys.stderr)
+        out["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out), flush=True)
+    os._exit(1 if "error" in out else 0)
+
+
 def measure_reference_baseline(
     remaining: float = float("inf"), ladder=None
 ) -> dict:
@@ -1666,6 +1793,8 @@ if __name__ == "__main__":
         run_scale_500()
     elif "--cifar" in sys.argv:
         run_cifar_bench()
+    elif "--wire" in sys.argv:
+        run_wire_bench()
     elif "--attn" in sys.argv:
         run_attn_bench()
     elif "--lm-mfu" in sys.argv:

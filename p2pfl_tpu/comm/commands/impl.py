@@ -16,6 +16,7 @@ import logging
 from typing import TYPE_CHECKING, Any, List
 
 from p2pfl_tpu.comm.commands.command import Command
+from p2pfl_tpu.exceptions import DeltaAnchorError
 
 if TYPE_CHECKING:  # pragma: no cover
     from p2pfl_tpu.node import Node
@@ -193,8 +194,17 @@ class PartialModelCommand(Command):
         weights: bytes = kwargs["weights"]
         contributors: List[str] = list(kwargs.get("contributors", []))
         num_samples: int = int(kwargs.get("num_samples", 1))
+        try:
+            # Frames decode through the node's delta codec: dense frames pass
+            # straight through; sparse top-k deltas reconstruct against this
+            # round's anchor (jitted scatter-add — no host loop).
+            arrays, _ = state.wire.decode_frame(weights)
+        except DeltaAnchorError as exc:
+            # Out of phase, not corrupt: drop it, the gossip loop re-ships.
+            log.debug("partial model from %s dropped: %s", source, exc)
+            return
         model = node.learner.get_model().build_copy(
-            params=weights, contributors=contributors, num_samples=num_samples
+            params=arrays, contributors=contributors, num_samples=num_samples
         )
         agg = node.aggregator.add_model(model)
         if agg:
@@ -225,7 +235,15 @@ class FullModelCommand(Command):
             return
         weights: bytes = kwargs["weights"]
         try:
-            node.learner.get_model().set_parameters(weights)
+            try:
+                arrays, meta = state.wire.decode_frame(weights)
+            except DeltaAnchorError as exc:
+                # Sparse frame for a round we hold no anchor for (we lag or
+                # lead the sender) — drop; the sender's gossip loop retries
+                # and falls back to a dense frame for out-of-round peers.
+                log.debug("full model from %s dropped: %s", source, exc)
+                return
+            node.learner.get_model().apply_frame(arrays, meta)
             state.last_full_model_round = max(state.last_full_model_round, round)
             state.aggregated_model_event.set()
         except Exception:

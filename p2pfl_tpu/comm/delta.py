@@ -1,0 +1,311 @@
+"""Sparse delta wire path: round-anchored deltas + error-feedback top-k.
+
+The dense gossip path re-ships every float32 weight on every sync tick. With
+``Settings.WIRE_COMPRESSION = "topk"`` the model plane switches to this
+codec, which changes *what* is gossiped:
+
+* **delta encoding** — senders transmit ``params - round_anchor`` instead of
+  raw weights, where the round anchor is the model every node holds when the
+  round opens (the previous round's aggregated model; snapshotted by the
+  stage machine). The receiver reconstructs against ITS anchor through the
+  jitted scatter-add (:func:`p2pfl_tpu.ops.aggregation.sparse_delta_apply`).
+* **top-k + error feedback** — only the ``WIRE_TOPK_RATIO`` largest-magnitude
+  elements of each delta tensor ship (gap-packed indices + bf16 values,
+  :mod:`p2pfl_tpu.ops.serialization`); the untransmitted remainder (and the
+  value quantization error) accumulates in a per-node residual that is added
+  back before the next selection (DGC, Lin et al. 2018; EF-SGD, Karimireddy
+  et al. 2019). Selection/scatter are jitted kernels
+  (:mod:`p2pfl_tpu.ops.compression`) — no host loop walks elements.
+
+Frames stay self-describing: the sparse layout rides the standard
+``__codec__`` spec and a ``__delta__`` marker carries the anchor round +
+anchor fingerprint, so receivers need no configuration. Anchor matching is
+BY ROUND, not by fingerprint: FedAvg aggregation order and sparsification
+itself leave nodes with fp-level (and tail-level) differences in their
+round-start models, so byte-identical anchors don't exist in a live
+federation. Applying a delta against an anchor that drifted by epsilon
+perturbs the model by the same epsilon — the next aggregation contracts it,
+and the error-feedback residual keeps the transmitted mass conserved. A
+fingerprint mismatch is therefore logged (observability for genuinely
+diverged peers, e.g. an aggregation-timeout node) but does not reject the
+frame; a ROUND mismatch does reject (:class:`DeltaAnchorError`), because an
+anchor from another round is a different model generation entirely.
+
+Fallback ladder: no anchor yet / non-float leaves / shape mismatch → the
+caller ships a dense frame (``encode_model`` returns ``None``). Dense frames
+decode transparently through :func:`decode_frame` too, so mixed sparse/dense
+federations interoperate.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.exceptions import DecodingParamsError, DeltaAnchorError
+from p2pfl_tpu.ops.compression import (
+    CODEC_META_KEY,
+    decompress_arrays,
+    ef_topk_encode,
+    topk_count,
+)
+from p2pfl_tpu.ops.serialization import (
+    decode_sparse_indices,
+    deserialize_arrays,
+    encode_sparse_indices,
+    serialize_arrays,
+)
+
+log = logging.getLogger("p2pfl_tpu")
+
+#: Reserved metadata key marking a frame as a round-anchored sparse delta.
+DELTA_META_KEY = "__delta__"
+
+
+def _leaf_crc(leaves: Sequence[np.ndarray]) -> int:
+    """Fingerprint of a float32 leaf list (observability, not an acceptance
+    gate — see module docstring)."""
+    crc = 0
+    for a in leaves:
+        crc = zlib.crc32(np.ascontiguousarray(a, dtype=np.float32).tobytes(), crc)
+    return crc
+
+
+class DeltaWireCodec:
+    """Per-node sparse-delta encode/decode state.
+
+    Owns the round anchor (set by the stage machine at every round boundary)
+    and the error-feedback residuals (persistent across rounds — that is the
+    point of error feedback). Thread-safe: encode runs on the stage thread,
+    decode on transport threads.
+    """
+
+    def __init__(self, self_addr: str = "unknown-node") -> None:
+        self._addr = self_addr
+        self._lock = threading.RLock()
+        self._anchor: Optional[List[np.ndarray]] = None  # float32 flat leaves
+        self._shapes: Optional[List[tuple]] = None
+        self._anchor_round: int = -1
+        self._anchor_crc: int = 0
+        self._residual: Optional[List[Any]] = None  # float32 flat, jax arrays
+        # wire accounting (encode side): frames/bytes by (sparse|dense)
+        self.sparse_frames = 0
+        self.dense_fallback_frames = 0
+
+    # --- anchor bookkeeping (driven by the stage machine) -------------------
+
+    def set_anchor(self, leaves: Sequence[np.ndarray], round: int) -> None:
+        """Snapshot the round-start model (float32). Residuals persist across
+        rounds unless the model structure changed."""
+        flat = [np.ascontiguousarray(a, dtype=np.float32).reshape(-1) for a in leaves]
+        shapes = [tuple(np.asarray(a).shape) for a in leaves]
+        with self._lock:
+            if self._residual is not None and (
+                self._shapes is None
+                or [f.size for f in flat] != [int(np.prod(s, dtype=np.int64)) for s in self._shapes]
+            ):
+                self._residual = None
+            self._anchor = flat
+            self._shapes = shapes
+            self._anchor_round = int(round)
+            self._anchor_crc = _leaf_crc(flat)
+
+    @property
+    def anchor_round(self) -> int:
+        with self._lock:
+            return self._anchor_round
+
+    def reset(self) -> None:
+        with self._lock:
+            self._anchor = None
+            self._shapes = None
+            self._anchor_round = -1
+            self._anchor_crc = 0
+            self._residual = None
+
+    # --- encode -------------------------------------------------------------
+
+    def encode_model(self, model: Any, round: int) -> Optional[bytes]:
+        """Sparse delta frame for ``model`` against the round anchor, or
+        ``None`` when the dense path must be used (wrong scheme, no anchor
+        for ``round``, structure mismatch). ``model`` is a
+        :class:`~p2pfl_tpu.models.model_handle.ModelHandle`.
+        """
+        if Settings.WIRE_COMPRESSION != "topk":
+            return None
+        with self._lock:
+            if self._anchor is None or self._anchor_round != int(round):
+                self.dense_fallback_frames += 1
+                return None
+            leaves = model.get_parameters()
+            if len(leaves) != len(self._anchor) or any(
+                tuple(l.shape) != s for l, s in zip(leaves, self._shapes)
+            ):
+                self.dense_fallback_frames += 1
+                return None
+            if self._residual is None:
+                self._residual = [np.zeros((a.size,), np.float32) for a in self._anchor]
+
+            ratio = Settings.WIRE_TOPK_RATIO
+            value_dtype = Settings.WIRE_TOPK_VALUES
+            parts: List[np.ndarray] = []
+            spec: List[Dict[str, Any]] = []
+            for i, (leaf, anchor_flat) in enumerate(zip(leaves, self._anchor)):
+                leaf = np.asarray(leaf)
+                if not np.issubdtype(leaf.dtype, np.floating) or leaf.size == 0:
+                    parts.append(leaf)
+                    spec.append({"codec": "raw"})
+                    continue
+                delta = (
+                    np.ascontiguousarray(leaf, dtype=np.float32).reshape(-1)
+                    - anchor_flat
+                )
+                if not np.isfinite(delta).all():
+                    # diverged tensor: ship it raw (dense) like int8 does —
+                    # sparsifying NaNs would launder the divergence. Raw here
+                    # means the FULL leaf, so the receiver's reconstruction
+                    # ignores its anchor for this tensor.
+                    parts.append(leaf)
+                    spec.append({"codec": "raw"})
+                    continue
+                k = topk_count(delta.size, ratio)
+                idx, wire_vals, new_resid = ef_topk_encode(
+                    delta, self._residual[i], k, value_dtype
+                )
+                self._residual[i] = new_resid
+                packed, index_codec = encode_sparse_indices(np.asarray(idx))
+                parts.append(packed)
+                parts.append(np.asarray(wire_vals))
+                spec.append(
+                    {
+                        "codec": "topk",
+                        "dtype": leaf.dtype.str,
+                        "shape": list(leaf.shape),
+                        "index_codec": index_codec,
+                        "parts": 2,
+                    }
+                )
+            meta: Dict[str, Any] = {
+                "contributors": list(model.contributors),
+                "num_samples": int(model.num_samples),
+                "additional_info": model.additional_info,
+                CODEC_META_KEY: spec,
+                DELTA_META_KEY: {
+                    "round": int(round),
+                    "anchor_crc": self._anchor_crc,
+                },
+            }
+            self.sparse_frames += 1
+            return serialize_arrays(parts, meta)
+
+    # --- decode -------------------------------------------------------------
+
+    def decode_frame(self, blob: bytes) -> Tuple[List[np.ndarray], Dict[str, Any]]:
+        """Decode any model-plane frame: dense frames pass through the
+        standard codec inversion; sparse delta frames are reconstructed
+        against the round anchor via the jitted scatter-add.
+
+        Raises:
+            DeltaAnchorError: sparse frame for a round we hold no anchor for.
+            DecodingParamsError: malformed frame (any kind).
+        """
+        arrays, meta = deserialize_arrays(bytes(blob))
+        delta_meta = meta.get(DELTA_META_KEY)
+        if delta_meta is None:
+            arrays = list(arrays)
+            if CODEC_META_KEY in meta:
+                try:
+                    arrays = decompress_arrays(arrays, meta[CODEC_META_KEY])
+                except DecodingParamsError:
+                    raise
+                except Exception as exc:
+                    raise DecodingParamsError(
+                        f"malformed wire codec spec: {exc}"
+                    ) from exc
+            return arrays, meta
+
+        try:
+            frame_round = int(delta_meta["round"])
+            frame_crc = int(delta_meta.get("anchor_crc", 0))
+            spec = meta[CODEC_META_KEY]
+        except Exception as exc:
+            raise DecodingParamsError(f"malformed delta frame metadata: {exc}") from exc
+
+        with self._lock:
+            if self._anchor is None or self._anchor_round != frame_round:
+                raise DeltaAnchorError(
+                    f"no anchor for round {frame_round} "
+                    f"(local anchor round: {self._anchor_round})"
+                )
+            if frame_crc and frame_crc != self._anchor_crc:
+                # Expected at fp-noise level in live federations (module
+                # docstring); loud only for observability of true divergence.
+                log.debug(
+                    "(%s) delta frame anchor fingerprint differs "
+                    "(round %s, theirs %08x vs ours %08x) — applying anyway",
+                    self._addr, frame_round, frame_crc & 0xFFFFFFFF,
+                    self._anchor_crc & 0xFFFFFFFF,
+                )
+            try:
+                return self._reconstruct(arrays, spec), meta
+            except DecodingParamsError:
+                raise
+            except Exception as exc:
+                raise DecodingParamsError(
+                    f"malformed sparse delta frame: {exc}"
+                ) from exc
+
+    def _reconstruct(
+        self, arrays: Sequence[np.ndarray], spec: Sequence[Dict[str, Any]]
+    ) -> List[np.ndarray]:
+        """anchor + scatter(delta) per leaf (caller holds the lock)."""
+        import jax.numpy as jnp
+
+        from p2pfl_tpu.ops.aggregation import sparse_delta_apply
+
+        if len(spec) != len(self._anchor):
+            raise DecodingParamsError(
+                f"delta frame has {len(spec)} tensors, model has {len(self._anchor)}"
+            )
+        expected = sum(int(s.get("parts", 1)) for s in spec)
+        if expected != len(arrays):
+            raise DecodingParamsError("delta frame part count mismatch")
+        out: List[np.ndarray] = []
+        pos = 0
+        for i, s in enumerate(spec):
+            codec = s.get("codec", "raw")
+            if codec == "raw":
+                out.append(np.asarray(arrays[pos]))
+                pos += 1
+                continue
+            if codec != "topk":
+                raise DecodingParamsError(
+                    f"unexpected tensor codec {codec!r} in delta frame"
+                )
+            packed, vals = arrays[pos], arrays[pos + 1]
+            pos += 2
+            shape = tuple(s["shape"])
+            if shape != self._shapes[i]:
+                raise DecodingParamsError(
+                    f"delta tensor {i} shape {shape} != model {self._shapes[i]}"
+                )
+            idx = decode_sparse_indices(np.asarray(packed), s["index_codec"])
+            size = self._anchor[i].size
+            if idx.size != np.asarray(vals).size:
+                raise DecodingParamsError("sparse index/values length mismatch")
+            if idx.size and (int(idx[-1]) >= size or int(idx[0]) < 0):
+                raise DecodingParamsError("sparse index out of tensor bounds")
+            dense = sparse_delta_apply(
+                jnp.asarray(self._anchor[i]),
+                jnp.asarray(idx, jnp.int32),
+                jnp.asarray(np.asarray(vals).astype(np.float32)),
+            )
+            out.append(
+                np.asarray(dense).reshape(shape).astype(np.dtype(s["dtype"]))
+            )
+        return out

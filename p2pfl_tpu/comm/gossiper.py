@@ -18,7 +18,7 @@ import logging
 import random
 import threading
 from collections import OrderedDict, deque
-from typing import Any, Callable, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from p2pfl_tpu.comm.envelope import Envelope
 from p2pfl_tpu.config import Settings
@@ -46,6 +46,11 @@ class Gossiper:
         self._processed_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Model-plane TX accounting: (cmd, round) -> [frames, payload bytes].
+        # The sparse delta wire path's bytes-per-round metric reads this
+        # (surfaced per round by RoundFinishedStage and by bench.py --wire).
+        self._tx_lock = threading.Lock()
+        self._tx: Dict[Tuple[str, int], List[int]] = {}
 
     # --- lifecycle ----------------------------------------------------------
 
@@ -61,6 +66,30 @@ class Gossiper:
         if self._thread is not None:
             self._thread.join(timeout=2.0)
             self._thread = None
+
+    # --- wire accounting ----------------------------------------------------
+
+    def _record_tx(self, env: Envelope) -> None:
+        if env.payload is None:
+            return
+        with self._tx_lock:
+            row = self._tx.setdefault((env.cmd, env.round), [0, 0])
+            row[0] += 1
+            row[1] += len(env.payload)
+
+    def wire_stats(self) -> Dict[Tuple[str, int], Tuple[int, int]]:
+        """Copy of the model-plane TX table: (cmd, round) -> (frames, bytes)."""
+        with self._tx_lock:
+            return {k: (v[0], v[1]) for k, v in self._tx.items()}
+
+    def bytes_for_round(self, round: int) -> int:
+        """Total model-plane payload bytes sent for ``round``."""
+        with self._tx_lock:
+            return sum(v[1] for (_, r), v in self._tx.items() if r == round)
+
+    def total_tx_bytes(self) -> int:
+        with self._tx_lock:
+            return sum(v[1] for v in self._tx.values())
 
     # --- dedup (reference gossiper.py:101-122) ------------------------------
 
@@ -157,6 +186,7 @@ class Gossiper:
                     continue
                 try:
                     self._send(nei, env)
+                    self._record_tx(env)
                 except ProtocolNotStartedError:
                     return  # protocol stopping under us — normal shutdown
                 except Exception:

@@ -60,15 +60,35 @@ class Settings:
     AMOUNT_LAST_MESSAGES_SAVED: int = _env_override("AMOUNT_LAST_MESSAGES_SAVED", 100)
 
     # --- wire compression ---------------------------------------------------
-    # Lossy-but-bounded codec for gossiped weights ("none" | "bf16" | "int8",
-    # ops/compression.py). Sender-local: the codec spec rides in the frame,
-    # so mixed settings across a federation interoperate. Validated at load
-    # so a typo'd env value fails here, not mid-round in a gossip thread.
+    # Lossy-but-bounded codec for gossiped weights ("none" | "bf16" | "int8"
+    # | "topk", ops/compression.py). Sender-local: the codec spec rides in
+    # the frame, so mixed settings across a federation interoperate.
+    # Validated at load so a typo'd env value fails here, not mid-round in a
+    # gossip thread. "topk" switches the model gossip to the sparse delta
+    # wire path (comm/delta.py): round-anchored deltas, error-feedback top-k
+    # sparsification, index+values PFLT tensors.
     WIRE_COMPRESSION: str = _env_override("WIRE_COMPRESSION", "none")
-    if WIRE_COMPRESSION not in ("none", "bf16", "int8"):
+    if WIRE_COMPRESSION not in ("none", "bf16", "int8", "topk"):
         raise ValueError(
             f"P2PFL_TPU_WIRE_COMPRESSION={WIRE_COMPRESSION!r} is not one of "
-            "('none', 'bf16', 'int8')"
+            "('none', 'bf16', 'int8', 'topk')"
+        )
+    # Fraction of each delta tensor's elements shipped under "topk"
+    # (largest-|value| first). 0.1 => ~10x fewer wire bytes with bf16 values
+    # + gap-packed u16 indices (ops/serialization.py sparse layout).
+    WIRE_TOPK_RATIO: float = _env_override("WIRE_TOPK_RATIO", 0.1)
+    if not 0.0 < WIRE_TOPK_RATIO <= 1.0:
+        raise ValueError(
+            f"P2PFL_TPU_WIRE_TOPK_RATIO={WIRE_TOPK_RATIO!r} must be in (0, 1]"
+        )
+    # Wire dtype of the transmitted top-k values: "bf16" (default, 2 bytes,
+    # quantization error is absorbed by the error-feedback residual) or
+    # "float32" (exact values, bigger frames).
+    WIRE_TOPK_VALUES: str = _env_override("WIRE_TOPK_VALUES", "bf16")
+    if WIRE_TOPK_VALUES not in ("bf16", "float32"):
+        raise ValueError(
+            f"P2PFL_TPU_WIRE_TOPK_VALUES={WIRE_TOPK_VALUES!r} is not one of "
+            "('bf16', 'float32')"
         )
 
     # --- learning round -----------------------------------------------------

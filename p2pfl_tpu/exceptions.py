@@ -38,5 +38,12 @@ class DecodingParamsError(P2pflTpuError):
     """Received a weights payload that could not be decoded."""
 
 
+class DeltaAnchorError(P2pflTpuError):
+    """A sparse delta frame could not be applied: the receiver holds no round
+    anchor for the frame's round (yet). NOT a corruption error — the frame is
+    valid, the receiver is just out of phase; the caller drops it and the
+    gossip loop re-ships on a later tick (comm/delta.py)."""
+
+
 class ModelNotMatchingError(P2pflTpuError):
     """Received parameters do not match the local model's structure."""

@@ -39,10 +39,20 @@ class TrimmedMean(Aggregator):
 
 
 class GeometricMedian(Aggregator):
-    """Weighted geometric median via Weiszfeld iterations (RFA, Pillutla et
-    al. 2019): rotation-invariant robust aggregation tolerating up to half
-    the total weight being adversarial — no discrete-subset commitment like
-    Krum, no per-coordinate independence assumption like trimmed mean."""
+    """Geometric median via Weiszfeld iterations (RFA, Pillutla et al.
+    2019): rotation-invariant robust aggregation tolerating up to half the
+    total weight being adversarial — no discrete-subset commitment like
+    Krum, no per-coordinate independence assumption like trimmed mean.
+
+    Contributions are weighted UNIFORMLY, not by self-reported
+    ``get_num_samples()``: the breakdown point of the weighted geometric
+    median is in terms of total *weight*, and sample counts arrive over the
+    wire unauthenticated — a single Byzantine peer claiming ``10**9``
+    samples would hold >50% of the weight and drag the median anywhere,
+    voiding the robustness guarantee the rule exists for. Honest sample
+    counts still flow through contributor metadata for FedAvg-style rules;
+    this rule deliberately ignores them (one contributor, one vote).
+    """
 
     partial_aggregation = False
 
@@ -56,7 +66,7 @@ class GeometricMedian(Aggregator):
         if not models:
             raise ValueError("nothing to aggregate")
         stacked = agg_ops.tree_stack([m.params for m in models])
-        weights = jnp.asarray([m.get_num_samples() for m in models], jnp.float32)
+        weights = jnp.ones((len(models),), jnp.float32)
         out = agg_ops.geometric_median(stacked, weights, iters=self.iters)
         contributors, total = self._merge_metadata(models)
         return models[0].build_copy(params=out, contributors=contributors, num_samples=total)

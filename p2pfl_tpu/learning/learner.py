@@ -477,6 +477,18 @@ class JaxLearner(Learner):
         model.params = params
         model.set_contribution([self._self_addr], self.get_data().get_num_samples(True))
 
+        # L2 norm of this fit's update (params - round-start anchor): the
+        # exact quantity the sparse delta wire path transmits, so operators
+        # can relate top-k compression error to real update magnitude.
+        upd_sq = jax.tree.map(
+            lambda p, a: jnp.sum(
+                (p.astype(jnp.float32) - a.astype(jnp.float32)) ** 2
+            ),
+            params,
+            anchor,
+        )
+        self.report("update_norm", float(jnp.sqrt(sum(jax.tree.leaves(upd_sq)))))
+
         if self.dp_clip_norm <= 0.0:
             self._nonprivate_steps += total_steps
         else:

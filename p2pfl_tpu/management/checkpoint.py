@@ -101,6 +101,22 @@ class FLCheckpointer:
         state = jax.tree.map(replace, template, restored["state"])
         return state, dict(restored["meta"] or {})
 
+    def restore_meta(self, step: Optional[int] = None) -> dict:
+        """Restore ONLY the JSON meta record at ``step`` (default: latest).
+
+        Lets callers validate configuration pins (optimizer rule, DP
+        parameters) BEFORE committing to the heavy structural restore — a
+        mismatched template would otherwise surface as an opaque pytree
+        structure error instead of the pin's explanatory ValueError.
+        """
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        restored = self._mngr.restore(
+            step, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
+        )
+        return dict(restored["meta"] or {})
+
     # --- ModelHandle convenience --------------------------------------------
 
     def save_model(self, step: int, model) -> bool:

@@ -45,6 +45,13 @@ def encode_wire_frame(
     Shared by the JAX handle and the interop backends' canonical wire."""
     if compression is None:
         compression = Settings.WIRE_COMPRESSION
+    if compression == "topk":
+        # "topk" is the sparse DELTA wire path: sparsifying raw weights here
+        # would zero most of the model. The delta encoder (comm/delta.py,
+        # driven by the stage machine) owns anchors and residuals; every
+        # anchor-less path — init-model frames, interop canonical wire,
+        # direct encode_parameters() calls — ships dense instead.
+        compression = "none"
     meta: Dict[str, Any] = {
         "contributors": contributors,
         "num_samples": num_samples,
@@ -66,6 +73,14 @@ def decode_wire_frame(blob: bytes) -> tuple[List[np.ndarray], Dict[str, Any]]:
     """
     arrays, meta = deserialize_arrays(bytes(blob))
     arrays = list(arrays)
+    if "__delta__" in meta:
+        # Sparse delta frames (comm/delta.py) are relative to a round anchor
+        # this stateless decoder does not hold — decoding one here would
+        # silently produce anchor-less (mostly-zero) weights.
+        raise DecodingParamsError(
+            "sparse delta frame requires the node's DeltaWireCodec "
+            "(round anchor) to decode"
+        )
     if CODEC_META_KEY in meta:
         try:
             arrays = decompress_arrays(arrays, meta[CODEC_META_KEY])
@@ -129,9 +144,7 @@ class ModelHandle:
         """
         if isinstance(params, (bytes, bytearray, memoryview)):
             flat, meta = decode_wire_frame(params)
-            self.contributors = list(meta.get("contributors", self.contributors))
-            self.num_samples = int(meta.get("num_samples", self.num_samples))
-            self.additional_info.update(meta.get("additional_info", {}))
+            self._apply_meta(meta)
         elif isinstance(params, (list, tuple)):
             flat = list(params)
         else:  # pytree
@@ -148,6 +161,22 @@ class ModelHandle:
             for a, dt in zip(flat, self._dtypes)
         ]
         self.params = jax.tree.unflatten(self._treedef, cast)
+
+    def _apply_meta(self, meta: Dict[str, Any]) -> None:
+        self.contributors = list(meta.get("contributors", self.contributors))
+        self.num_samples = int(meta.get("num_samples", self.num_samples))
+        self.additional_info.update(meta.get("additional_info", {}))
+
+    def apply_frame(self, arrays: Sequence[np.ndarray], meta: Dict[str, Any]) -> None:
+        """Adopt an already-decoded wire frame: federation metadata + arrays.
+
+        The sparse delta wire path decodes frames through the node's
+        :class:`~p2pfl_tpu.comm.delta.DeltaWireCodec` (it owns the round
+        anchor) and hands the reconstructed arrays here — same metadata
+        semantics as :meth:`set_parameters` with raw frame bytes.
+        """
+        self._apply_meta(meta)
+        self.set_parameters(list(arrays))
 
     def encode_parameters(self, compression: Optional[str] = None) -> bytes:
         """Serialize params + metadata for the wire (reference encodes with

@@ -35,13 +35,20 @@ class NodeState:
         train_set: Committee (trainset) elected for the current round.
         train_set_votes: addr -> {candidate: weight} votes received.
         learner: The node's learner (set by Node).
+        wire: Sparse-delta wire codec (round anchor + error-feedback
+            residuals, :class:`~p2pfl_tpu.comm.delta.DeltaWireCodec`).
+            Anchors are snapshotted by the stage machine at every round
+            boundary; active only under ``Settings.WIRE_COMPRESSION="topk"``.
     """
 
     def __init__(self, addr: str) -> None:
+        from p2pfl_tpu.comm.delta import DeltaWireCodec
+
         self.addr = addr
         self.status = "Idle"
         self.experiment: Optional[Experiment] = None
         self.simulation = False
+        self.wire = DeltaWireCodec(addr)
 
         # Learning info (populated by commands / stages).
         self.models_aggregated: Dict[str, List[str]] = {}

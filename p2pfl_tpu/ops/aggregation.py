@@ -186,6 +186,17 @@ def geometric_median(
 
 
 @jax.jit
+def sparse_delta_apply(anchor_flat: jax.Array, idx: jax.Array, vals: jax.Array) -> jax.Array:
+    """Merge a received sparse delta into a dense float32 base on-device:
+    ``anchor_flat.at[idx].add(vals)`` — one fused XLA scatter-add per leaf,
+    never a host loop over indices. This is the accumulation primitive of
+    the sparse delta wire path (comm/delta.py): a gossiped top-k delta is
+    reconstructed against the receiver's round anchor and lands directly in
+    the float32 domain the aggregators already operate in."""
+    return anchor_flat.at[idx].add(vals.astype(jnp.float32))
+
+
+@jax.jit
 def scaffold_update(
     global_params: Pytree,
     global_c: Pytree,

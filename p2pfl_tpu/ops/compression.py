@@ -13,6 +13,14 @@ stay float32; only the bytes that ride the gossip protocol shrink:
 * ``int8`` — symmetric per-tensor linear quantization (4x smaller):
   ``q = round(a / scale)`` with ``scale = absmax / 127``; worst-case
   per-element error is ``scale / 2``.
+* ``topk`` — magnitude top-k sparsification (~``4 / (ratio * (2 + 2))`` x
+  smaller at bf16 values + gap-packed u16 indices, i.e. ~10x at ratio=0.1):
+  only the k largest-|value| elements per tensor ship as an index+values
+  pair; the rest decode to ZERO. Meant for round-anchored deltas with
+  error feedback (Deep Gradient Compression, Lin et al. 2018; EF-SGD,
+  Karimireddy et al. 2019) — see :mod:`p2pfl_tpu.comm.delta` for the
+  stateful wire path that owns anchors and residuals. Selection runs
+  on-device through a jitted ``jax.lax.top_k`` kernel.
 
 Integer/bool leaves and empty tensors pass through unchanged. The codec
 spec (per-tensor scheme + original dtype + scale) rides in the PFLT frame
@@ -23,11 +31,12 @@ compression setting (``Settings.WIRE_COMPRESSION`` is sender-local).
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Sequence, Tuple
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-SCHEMES = ("none", "bf16", "int8")
+SCHEMES = ("none", "bf16", "int8", "topk")
 
 #: Reserved metadata key carrying the per-tensor codec spec in a PFLT frame.
 CODEC_META_KEY = "__codec__"
@@ -39,15 +48,158 @@ def _bf16_dtype() -> np.dtype:
     return np.dtype(ml_dtypes.bfloat16)
 
 
+# --- jitted top-k sparsification kernels --------------------------------------
+#
+# Selection runs on-device: ``jax.lax.top_k`` over |x| picks the k
+# largest-magnitude elements of a flattened tensor, indices are sorted
+# ascending (the wire layout gap-packs them, ops/serialization.py), and the
+# gather/scatter pair stays one fused XLA program per (size, k) shape — no
+# host loop ever walks elements. jax is imported lazily so the numpy-only
+# codecs stay importable in jax-free tooling contexts.
+
+
+def topk_select(flat: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Top-k by magnitude over a flat float32 array.
+
+    Returns ``(indices, values)`` with indices sorted ascending (int32) and
+    values gathered in that index order (float32). Jitted per (size, k).
+    """
+    import jax
+
+    idx, vals = _topk_select_kernel(jax.numpy.asarray(flat, jax.numpy.float32), k=k)
+    return np.asarray(idx), np.asarray(vals)
+
+
+def _topk_select_impl(flat, *, k: int):
+    import jax
+    import jax.numpy as jnp
+
+    _, idx = jax.lax.top_k(jnp.abs(flat), k)
+    idx = jnp.sort(idx)
+    return idx, flat[idx]
+
+
+_topk_kernel_cache: Dict[str, Any] = {}
+
+
+def _topk_select_kernel(flat, *, k: int):
+    import jax
+
+    fn = _topk_kernel_cache.get("select")
+    if fn is None:
+        fn = jax.jit(_topk_select_impl, static_argnames=("k",))
+        _topk_kernel_cache["select"] = fn
+    return fn(flat, k=k)
+
+
+def scatter_dense(indices: np.ndarray, values: np.ndarray, size: int) -> np.ndarray:
+    """Jitted inverse of :func:`topk_select`: dense float32 vector with
+    ``values`` at ``indices`` and zeros elsewhere (disjoint indices)."""
+    import jax
+    import jax.numpy as jnp
+
+    fn = _topk_kernel_cache.get("scatter")
+    if fn is None:
+        fn = jax.jit(
+            lambda idx, vals, *, size: jnp.zeros((size,), jnp.float32)
+            .at[idx]
+            .set(vals),
+            static_argnames=("size",),
+        )
+        _topk_kernel_cache["scatter"] = fn
+    return np.asarray(
+        fn(jnp.asarray(indices), jnp.asarray(values, jnp.float32), size=size)
+    )
+
+
+def topk_count(size: int, ratio: float) -> int:
+    """Number of transmitted elements for a tensor of ``size`` at ``ratio``."""
+    return max(1, min(size, math.ceil(size * ratio)))
+
+
+def _ef_encode_impl(delta, residual, *, k: int, quantize_bf16: bool):
+    import jax
+    import jax.numpy as jnp
+
+    acc = delta + residual  # error feedback: add back what was never sent
+    _, idx = jax.lax.top_k(jnp.abs(acc), k)
+    idx = jnp.sort(idx)
+    vals = acc[idx]
+    if quantize_bf16:
+        wire = vals.astype(jnp.bfloat16)
+        dequant = wire.astype(jnp.float32)
+    else:
+        wire = vals
+        dequant = vals
+    # Residual keeps EXACTLY what the receiver will not reconstruct: the
+    # untransmitted tail plus (under bf16) the per-value quantization error.
+    new_residual = acc.at[idx].add(-dequant)
+    return idx, wire, new_residual
+
+
+def ef_topk_encode(
+    delta: "Any", residual: "Any", k: int, value_dtype: str = "bf16"
+) -> Tuple["Any", "Any", "Any"]:
+    """One fused error-feedback top-k selection step (jitted, on-device).
+
+    Args:
+        delta: flat float32 array (jax or numpy) — the new update to ship.
+        residual: flat float32 array — the node's accumulated untransmitted
+            remainder from previous encodes.
+        k: number of elements to transmit.
+        value_dtype: wire dtype of the values ("bf16" or "float32").
+
+    Returns ``(indices, wire_values, new_residual)`` as jax arrays; indices
+    sorted ascending. Conservation invariant (float32 values):
+    ``scatter(indices, wire_values) + new_residual == delta + residual``
+    element-exactly, because transmitted and untransmitted positions are
+    disjoint.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    fn = _topk_kernel_cache.get("ef_encode")
+    if fn is None:
+        fn = jax.jit(_ef_encode_impl, static_argnames=("k", "quantize_bf16"))
+        _topk_kernel_cache["ef_encode"] = fn
+    return fn(
+        jnp.asarray(delta, jnp.float32),
+        jnp.asarray(residual, jnp.float32),
+        k=k,
+        quantize_bf16=(value_dtype == "bf16"),
+    )
+
+
 def compress_arrays(
-    arrays: Sequence[np.ndarray], scheme: str
+    arrays: Sequence[np.ndarray],
+    scheme: str,
+    ratio: Optional[float] = None,
+    value_dtype: Optional[str] = None,
 ) -> Tuple[List[np.ndarray], List[Dict[str, Any]]]:
     """Encode ``arrays`` under ``scheme``; returns (encoded, per-tensor spec).
 
-    The spec list is msgpack-safe and positional (one entry per tensor).
+    The spec list is msgpack-safe and positional (one entry per LOGICAL
+    tensor). A ``topk`` entry covers TWO consecutive encoded arrays (packed
+    indices + values — the sparse layout of ops/serialization.py); every
+    other codec maps 1:1. ``ratio``/``value_dtype`` apply to ``topk`` only
+    and default to ``Settings.WIRE_TOPK_RATIO`` / ``Settings.WIRE_TOPK_VALUES``.
+
+    ``topk`` is a *stateless* sparsifier: it keeps the k largest-magnitude
+    elements per tensor and decodes the rest to ZERO. Callers are expected to
+    feed it deltas (params - anchor, comm/delta.py) — sparsifying raw weights
+    would discard most of the model.
     """
     if scheme not in SCHEMES:
         raise ValueError(f"unknown compression scheme {scheme!r}; known: {SCHEMES}")
+    if scheme == "topk":
+        from p2pfl_tpu.config import Settings
+
+        ratio = Settings.WIRE_TOPK_RATIO if ratio is None else float(ratio)
+        value_dtype = Settings.WIRE_TOPK_VALUES if value_dtype is None else value_dtype
+        if not 0.0 < ratio <= 1.0:
+            raise ValueError(f"topk ratio must be in (0, 1], got {ratio}")
+        if value_dtype not in ("bf16", "float32"):
+            raise ValueError(f"topk value_dtype must be 'bf16' or 'float32', got {value_dtype!r}")
     encoded: List[np.ndarray] = []
     spec: List[Dict[str, Any]] = []
     for a in arrays:
@@ -55,6 +207,32 @@ def compress_arrays(
         if scheme == "none" or not np.issubdtype(a.dtype, np.floating) or a.size == 0:
             encoded.append(a)
             spec.append({"codec": "raw"})
+        elif scheme == "topk":
+            flat = np.ascontiguousarray(a, dtype=np.float32).reshape(-1)
+            if not np.isfinite(flat).all():
+                # like int8: never launder a diverged tensor into a plausible
+                # sparse one — top_k over NaNs is undefined anyway
+                encoded.append(a)
+                spec.append({"codec": "raw"})
+                continue
+            from p2pfl_tpu.ops.serialization import encode_sparse_indices
+
+            k = topk_count(flat.size, ratio)
+            idx, vals = topk_select(flat, k)
+            packed, index_codec = encode_sparse_indices(idx)
+            if value_dtype == "bf16":
+                vals = vals.astype(_bf16_dtype())
+            encoded.append(packed)
+            encoded.append(vals)
+            spec.append(
+                {
+                    "codec": "topk",
+                    "dtype": a.dtype.str,
+                    "shape": list(a.shape),
+                    "index_codec": index_codec,
+                    "parts": 2,
+                }
+            )
         elif scheme == "bf16":
             encoded.append(a.astype(_bf16_dtype()))
             spec.append({"codec": "bf16", "dtype": a.dtype.str})
@@ -84,14 +262,39 @@ def compress_arrays(
 def decompress_arrays(
     arrays: Sequence[np.ndarray], spec: Sequence[Dict[str, Any]]
 ) -> List[np.ndarray]:
-    """Invert :func:`compress_arrays` given the frame's codec spec."""
-    if len(arrays) != len(spec):
+    """Invert :func:`compress_arrays` given the frame's codec spec.
+
+    ``topk`` entries consume two encoded arrays (packed indices + values) and
+    densify through the jitted scatter kernel — untransmitted elements decode
+    to zero (the delta wire path adds the round anchor back, comm/delta.py).
+    """
+    expected = sum(int(s.get("parts", 1)) for s in spec)
+    if len(arrays) != expected:
         raise ValueError(
-            f"codec spec length {len(spec)} does not match tensor count {len(arrays)}"
+            f"codec spec length {len(spec)} ({expected} parts) does not match "
+            f"tensor count {len(arrays)}"
         )
     out: List[np.ndarray] = []
-    for a, s in zip(arrays, spec):
+    pos = 0
+    for s in spec:
         codec = s.get("codec", "raw")
+        if codec == "topk":
+            from p2pfl_tpu.ops.serialization import decode_sparse_indices
+
+            packed, vals = arrays[pos], arrays[pos + 1]
+            pos += 2
+            shape = tuple(s["shape"])
+            size = int(np.prod(shape, dtype=np.int64))
+            idx = decode_sparse_indices(np.asarray(packed), s["index_codec"])
+            if idx.size != np.asarray(vals).size:
+                raise ValueError("sparse index/values length mismatch")
+            if idx.size and (idx[-1] >= size or idx[0] < 0):
+                raise ValueError("sparse index out of tensor bounds")
+            dense = scatter_dense(idx, np.asarray(vals, dtype=np.float32), size)
+            out.append(dense.reshape(shape).astype(np.dtype(s["dtype"])))
+            continue
+        a = arrays[pos]
+        pos += 1
         if codec == "raw":
             out.append(np.asarray(a))
         elif codec == "bf16":

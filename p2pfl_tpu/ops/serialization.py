@@ -100,6 +100,49 @@ def _pad(n: int) -> int:
     return (-n) % _ALIGN
 
 
+# --- sparse tensor layout (index + values per tensor) -------------------------
+#
+# A top-k-sparsified tensor rides the PFLT frame as TWO consecutive entries in
+# the flat tensor list — a packed index array followed by a values array — with
+# one ``__codec__`` spec entry describing both (ops/compression.py). Indices
+# are sorted ascending and packed as either:
+#
+# * ``gap16`` — uint16 deltas between consecutive indices (first entry is the
+#   absolute first index). At ~10% density the mean gap is ~10, so 2 bytes per
+#   index; chosen whenever every gap (and the first index) fits in 16 bits.
+# * ``abs32`` — absolute uint32 indices (4 bytes) as the general fallback.
+#
+# Both layouts are plain ndarrays, so they inherit the frame's 64-byte
+# alignment, zero-copy decode, and CRC32 coverage — a corrupted index or
+# values region fails the frame checksum exactly like dense weights.
+
+SPARSE_INDEX_CODECS = ("gap16", "abs32")
+
+
+def encode_sparse_indices(idx: np.ndarray) -> Tuple[np.ndarray, str]:
+    """Pack sorted ascending flat indices; returns (packed, index_codec)."""
+    idx = np.asarray(idx, dtype=np.int64)
+    if idx.size == 0:
+        return idx.astype(np.uint16), "gap16"
+    gaps = np.diff(idx, prepend=0)
+    if (gaps < 0).any():
+        raise ValueError("sparse indices must be sorted ascending and unique")
+    if int(gaps.max()) <= np.iinfo(np.uint16).max:
+        return gaps.astype(np.uint16), "gap16"
+    if int(idx[-1]) > np.iinfo(np.uint32).max:
+        raise ValueError("sparse index exceeds uint32 range")
+    return idx.astype(np.uint32), "abs32"
+
+
+def decode_sparse_indices(packed: np.ndarray, index_codec: str) -> np.ndarray:
+    """Invert :func:`encode_sparse_indices` back to int64 flat indices."""
+    if index_codec == "gap16":
+        return np.cumsum(np.asarray(packed, dtype=np.int64))
+    if index_codec == "abs32":
+        return np.asarray(packed, dtype=np.int64)
+    raise ValueError(f"unknown sparse index codec {index_codec!r}")
+
+
 def _frame_crc(header_bytes: bytes, np_arrays: Sequence[np.ndarray]) -> int:
     """Chained CRC32 (zlib polynomial) over header bytes + raw tensor bytes."""
     crc = zlib.crc32(header_bytes)

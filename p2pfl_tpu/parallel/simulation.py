@@ -1087,6 +1087,11 @@ class MeshSimulation:
                 "data, which checkpoints do not carry) — construct a new "
                 "MeshSimulation and load_from() that"
             )
+        # Validate configuration pins against the META record FIRST: a rule
+        # or DP mismatch must fail with its explanatory ValueError, not with
+        # whatever pytree-structure error a mismatched template produces
+        # inside the structural restore.
+        self._check_restore_pins(checkpointer.restore_meta(step))
         template = (
             self.state_dict() if self.params_stack is not None else self._abstract_state
         )
@@ -1108,25 +1113,35 @@ class MeshSimulation:
             self._nonprivate_steps_per_node,
             int(meta.get("nonprivate_steps_per_node", 0)),
         )
-        if self.dp_clip_norm > 0.0:
-            if "dp_noise_multiplier" not in meta:
-                # Pre-DP checkpoint: the restored weights embed training of
-                # unknown (non-private) provenance — void the epsilon claim.
-                self._nonprivate_steps_per_node = max(
-                    self._nonprivate_steps_per_node, 1
-                )
-            elif (
+        if self.dp_clip_norm > 0.0 and "dp_noise_multiplier" not in meta:
+            # Pre-DP checkpoint: the restored weights embed training of
+            # unknown (non-private) provenance — void the epsilon claim.
+            self._nonprivate_steps_per_node = max(
+                self._nonprivate_steps_per_node, 1
+            )
+        if "seed" in meta and int(meta["seed"]) != self.seed:
+            self.seed = int(meta["seed"])
+        return self.completed_rounds
+
+    def _check_restore_pins(self, meta: dict) -> None:
+        """Raise ValueError when ``meta`` pins a configuration this
+        simulation does not match (run before the structural restore)."""
+        if (
+            self.dp_clip_norm > 0.0
+            and "dp_noise_multiplier" in meta
+            and (
                 float(meta["dp_noise_multiplier"]) != self.dp_noise_multiplier
                 or float(meta.get("dp_clip_norm", 0.0)) != self.dp_clip_norm
-            ):
-                raise ValueError(
-                    "checkpoint was written with DP parameters "
-                    f"(sigma={meta['dp_noise_multiplier']}, "
-                    f"clip={meta.get('dp_clip_norm')}) that differ from this "
-                    f"simulation's (sigma={self.dp_noise_multiplier}, "
-                    f"clip={self.dp_clip_norm}); resuming would re-price the "
-                    "restored steps and invalidate privacy_spent()"
-                )
+            )
+        ):
+            raise ValueError(
+                "checkpoint was written with DP parameters "
+                f"(sigma={meta['dp_noise_multiplier']}, "
+                f"clip={meta.get('dp_clip_norm')}) that differ from this "
+                f"simulation's (sigma={self.dp_noise_multiplier}, "
+                f"clip={self.dp_clip_norm}); resuming would re-price the "
+                "restored steps and invalidate privacy_spent()"
+            )
         saved_opt = meta.get("server_opt")
         if saved_opt != self._server_opt_name or (
             saved_opt not in (None, "custom")
@@ -1139,9 +1154,6 @@ class MeshSimulation:
                 "would apply the restored server moments through a different "
                 "update rule ('custom' transforms are matched by label only)"
             )
-        if "seed" in meta and int(meta["seed"]) != self.seed:
-            self.seed = int(meta["seed"])
-        return self.completed_rounds
 
 
 def _stack_partitions(

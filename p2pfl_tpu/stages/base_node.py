@@ -78,6 +78,12 @@ class StartLearningStage(Stage):
         # The model doesn't change during this stage — serialize once, not
         # per candidate per gossip tick.
         model = node.learner.get_model()
+        # Round-0 anchor for the sparse delta wire path: every node holds the
+        # initiator's weights at this point (own for the initiator, adopted
+        # via InitModelCommand otherwise), so deltas anchored here reconstruct
+        # on every peer. Init frames themselves always ship dense — their
+        # receivers have no anchor yet by definition.
+        state.wire.set_anchor(model.get_parameters(), state.round or 0)
         payload = model.encode_parameters()
         env = node.protocol.build_weights(
             InitModelCommand.get_name(),
@@ -259,10 +265,17 @@ class TrainStage(Stage):
             )
             if partial is None:
                 return None
+            # Sparse delta wire path (WIRE_COMPRESSION="topk"): trainset
+            # peers share this round's anchor, so partials ship as
+            # error-feedback top-k deltas; encode_model returns None on the
+            # dense-only schemes or when no anchor is set for this round.
+            payload = state.wire.encode_model(partial, state.round or 0)
+            if payload is None:
+                payload = partial.encode_parameters()
             return node.protocol.build_weights(
                 PartialModelCommand.get_name(),
                 state.round or 0,
-                partial.encode_parameters(),
+                payload,
                 partial.get_contributors(),
                 partial.get_num_samples(),
             )
@@ -327,21 +340,46 @@ class GossipModelStage(Stage):
                 if state.nei_status.get(n, -1) < r
             ]
 
-        # Serialize the (stage-constant) full model once for all ticks/peers.
+        # Serialize the (stage-constant) dense full model once for all
+        # ticks/peers; the sparse delta variant is chosen per neighbor.
         model = node.learner.get_model()
-        env = node.protocol.build_weights(
-            FullModelCommand.get_name(),
-            state.round or 0,
-            model.encode_parameters(),
-            model.contributors or [node.addr],
-            model.get_num_samples(),
-        )
+        r = state.round or 0
+        dense_env: List[Optional[Envelope]] = [None]  # lazy: sparse runs may never need it
+
+        def _dense() -> Envelope:
+            if dense_env[0] is None:
+                dense_env[0] = node.protocol.build_weights(
+                    FullModelCommand.get_name(),
+                    r,
+                    model.encode_parameters(),
+                    model.contributors or [node.addr],
+                    model.get_num_samples(),
+                )
+            return dense_env[0]
+
+        def model_fn(nei: str) -> Optional[Envelope]:
+            # Sparse delta only for peers known to be in THIS round (they
+            # reported finishing r-1, or announced an initialized model for
+            # round 0) — a lagging peer holds an older anchor and must get
+            # the dense frame it can always adopt.
+            status = state.nei_status.get(nei)
+            if status == r - 1 or (r == 0 and status == -1):
+                payload = state.wire.encode_model(model, r)
+                if payload is not None:
+                    return node.protocol.build_weights(
+                        FullModelCommand.get_name(),
+                        r,
+                        payload,
+                        model.contributors or [node.addr],
+                        model.get_num_samples(),
+                    )
+            return _dense()
 
         node.protocol.gossip_weights(
             early_stopping_fn=lambda: check_early_stop(node),
             get_candidates_fn=candidates,
             status_fn=lambda: sorted(candidates()),
-            model_fn=lambda nei: env,
+            model_fn=model_fn,
         )
         if check_early_stop(node):
             return None
@@ -359,8 +397,20 @@ class RoundFinishedStage(Stage):
         state = node.state
         if check_early_stop(node):
             return None
+        # Surface the finished round's model-plane wire traffic (bytes-per-
+        # round is the sparse wire path's primary metric; counted at the
+        # gossip send point, comm/gossiper.py).
+        finished = state.round or 0
+        node.log_metric(
+            "wire_tx_bytes", float(node.protocol.gossiper.bytes_for_round(finished))
+        )
         node.aggregator.clear()
         state.increase_round()
+        # New round, new delta anchor: every node enters round r holding the
+        # round-(r-1) aggregate, which is what senders will delta against.
+        state.wire.set_anchor(
+            node.learner.get_model().get_parameters(), state.round or 0
+        )
         node.log_round_finished()
 
         r, total = state.round, state.total_rounds

@@ -156,51 +156,58 @@ def test_malformed_codec_spec_raises_decoding_error():
 
 
 # --- hypothesis fuzz: the wire format faces untrusted peers -------------------
+# Optional dependency: without it only the fuzz cases vanish — a missing
+# hypothesis must not take the whole module's deterministic tests down with
+# a collection error.
 
-from hypothesis import given, settings as hyp_settings, strategies as st
+try:
+    from hypothesis import given, settings as hyp_settings, strategies as st
 
-_DTYPES = [np.float32, np.float16, np.int32, np.int64, np.uint8, np.bool_]
+    _HAVE_HYPOTHESIS = True
+except ModuleNotFoundError:
+    _HAVE_HYPOTHESIS = False
 
+if _HAVE_HYPOTHESIS:
+    _DTYPES = [np.float32, np.float16, np.int32, np.int64, np.uint8, np.bool_]
 
-@st.composite
-def _array(draw):
-    dtype = draw(st.sampled_from(_DTYPES))
-    shape = tuple(draw(st.lists(st.integers(0, 5), min_size=0, max_size=4)))
-    if dtype == np.bool_:
-        return (draw(st.integers(0, 1)) * np.ones(shape)).astype(dtype)
-    return np.full(shape, draw(st.integers(-100, 100)), dtype=dtype)
+    @st.composite
+    def _array(draw):
+        dtype = draw(st.sampled_from(_DTYPES))
+        shape = tuple(draw(st.lists(st.integers(0, 5), min_size=0, max_size=4)))
+        if dtype == np.bool_:
+            return (draw(st.integers(0, 1)) * np.ones(shape)).astype(dtype)
+        return np.full(shape, draw(st.integers(-100, 100)), dtype=dtype)
 
+    @hyp_settings(max_examples=40, deadline=None)
+    @given(st.lists(_array(), min_size=0, max_size=6), st.integers(0, 2**31 - 1))
+    def test_fuzz_roundtrip_any_shapes_dtypes(arrays, sample_count):
+        """Any list of ndarrays (0-d, empty, bool, unsigned...) survives the
+        PFLT frame byte-exactly with its metadata."""
+        meta = {"num_samples": sample_count}
+        out, meta2 = deserialize_arrays(serialize_arrays(arrays, meta))
+        assert meta2 == meta and len(out) == len(arrays)
+        for a, b in zip(arrays, out):
+            assert a.dtype == b.dtype and a.shape == b.shape
+            np.testing.assert_array_equal(a, b)
 
-@hyp_settings(max_examples=40, deadline=None)
-@given(st.lists(_array(), min_size=0, max_size=6), st.integers(0, 2**31 - 1))
-def test_fuzz_roundtrip_any_shapes_dtypes(arrays, sample_count):
-    """Any list of ndarrays (0-d, empty, bool, unsigned...) survives the
-    PFLT frame byte-exactly with its metadata."""
-    meta = {"num_samples": sample_count}
-    out, meta2 = deserialize_arrays(serialize_arrays(arrays, meta))
-    assert meta2 == meta and len(out) == len(arrays)
-    for a, b in zip(arrays, out):
-        assert a.dtype == b.dtype and a.shape == b.shape
-        np.testing.assert_array_equal(a, b)
-
-
-@hyp_settings(max_examples=60, deadline=None)
-@given(st.data())
-def test_fuzz_single_byte_flip_never_crashes(data):
-    """Flipping any single byte of a frame either still decodes (flip landed
-    in tensor payload — CRC32 verification is the checksummed path's job;
-    see test_tensor_corruption_detected) or raises DecodingParamsError.
-    It must NEVER raise anything else — malformed frames from a malicious
-    peer cannot crash the node loop with an unexpected exception type."""
-    buf = bytearray(
-        serialize_arrays(
-            [np.arange(6, dtype=np.float32).reshape(2, 3)], {"contributors": ["n0"]}
+    @hyp_settings(max_examples=60, deadline=None)
+    @given(st.data())
+    def test_fuzz_single_byte_flip_never_crashes(data):
+        """Flipping any single byte of a frame either still decodes (flip
+        landed in tensor payload — CRC32 verification is the checksummed
+        path's job; see test_tensor_corruption_detected) or raises
+        DecodingParamsError. It must NEVER raise anything else — malformed
+        frames from a malicious peer cannot crash the node loop with an
+        unexpected exception type."""
+        buf = bytearray(
+            serialize_arrays(
+                [np.arange(6, dtype=np.float32).reshape(2, 3)], {"contributors": ["n0"]}
+            )
         )
-    )
-    pos = data.draw(st.integers(0, len(buf) - 1))
-    bit = data.draw(st.integers(0, 7))
-    buf[pos] ^= 1 << bit
-    try:
-        deserialize_arrays(bytes(buf))
-    except DecodingParamsError:
-        pass  # the contract: corrupt frames fail loudly with THIS error
+        pos = data.draw(st.integers(0, len(buf) - 1))
+        bit = data.draw(st.integers(0, 7))
+        buf[pos] ^= 1 << bit
+        try:
+            deserialize_arrays(bytes(buf))
+        except DecodingParamsError:
+            pass  # the contract: corrupt frames fail loudly with THIS error

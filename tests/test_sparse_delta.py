@@ -1,0 +1,392 @@
+"""Sparse delta wire path tests: jitted top-k kernels, index+values frame
+layout, error-feedback residual conservation, codec round trips, corruption
+detection, and live federations gossiping sparse deltas end to end."""
+
+import time
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.comm.delta import DELTA_META_KEY, DeltaWireCodec
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.exceptions import DecodingParamsError, DeltaAnchorError
+from p2pfl_tpu.ops.compression import (
+    CODEC_META_KEY,
+    compress_arrays,
+    decompress_arrays,
+    ef_topk_encode,
+    scatter_dense,
+    topk_count,
+    topk_select,
+)
+from p2pfl_tpu.ops.serialization import (
+    decode_sparse_indices,
+    encode_sparse_indices,
+    serialize_arrays,
+)
+
+
+# --- kernels ------------------------------------------------------------------
+
+
+def test_topk_select_scatter_roundtrip():
+    rng = np.random.default_rng(0)
+    flat = rng.normal(size=(4096,)).astype(np.float32)
+    k = 409
+    idx, vals = topk_select(flat, k)
+    assert idx.shape == (k,) and vals.shape == (k,)
+    assert (np.diff(idx) > 0).all()  # sorted ascending, unique
+    # selected values are exactly the k largest magnitudes
+    thresh = np.sort(np.abs(flat))[-k]
+    assert (np.abs(vals) >= thresh - 1e-7).all()
+    dense = scatter_dense(idx, vals, flat.size)
+    np.testing.assert_array_equal(dense[idx], flat[idx])
+    mask = np.ones(flat.size, bool)
+    mask[idx] = False
+    assert (dense[mask] == 0).all()
+
+
+def test_sparse_index_codecs():
+    # dense-ish indices pack as u16 gaps
+    idx = np.array([0, 3, 4, 100, 65535 + 90], np.int64)
+    packed, codec = encode_sparse_indices(idx)
+    assert codec == "gap16" and packed.dtype == np.uint16
+    np.testing.assert_array_equal(decode_sparse_indices(packed, codec), idx)
+    # a >u16 gap falls back to absolute u32
+    idx = np.array([5, 200_000], np.int64)
+    packed, codec = encode_sparse_indices(idx)
+    assert codec == "abs32" and packed.dtype == np.uint32
+    np.testing.assert_array_equal(decode_sparse_indices(packed, codec), idx)
+    # unsorted input is a caller bug, loudly
+    with pytest.raises(ValueError, match="sorted"):
+        encode_sparse_indices(np.array([5, 3], np.int64))
+
+
+def test_topk_count_bounds():
+    assert topk_count(100, 0.1) == 10
+    assert topk_count(3, 0.1) == 1  # never zero
+    assert topk_count(10, 1.0) == 10
+    assert topk_count(7, 0.999) == 7  # never exceeds size
+
+
+# --- stateless codec ----------------------------------------------------------
+
+
+def test_topk_full_ratio_float32_is_exact():
+    """dense == decode(encode) at k=100% with float32 values — the lossless
+    corner pins the layout (selection covers everything, scatter inverts)."""
+    rng = np.random.default_rng(1)
+    arrays = [
+        rng.normal(size=(64, 32)).astype(np.float32),
+        rng.normal(size=(7,)).astype(np.float32),
+        np.arange(5, dtype=np.int32),  # ints pass through raw
+    ]
+    enc, spec = compress_arrays(arrays, "topk", ratio=1.0, value_dtype="float32")
+    assert [s["codec"] for s in spec] == ["topk", "topk", "raw"]
+    assert len(enc) == 5  # 2 parts per sparse tensor + 1 raw
+    dec = decompress_arrays(enc, spec)
+    for a, b in zip(arrays, dec):
+        assert b.dtype == a.dtype and b.shape == a.shape
+        np.testing.assert_array_equal(a, b)
+
+
+def test_topk_partial_ratio_keeps_largest_and_shrinks():
+    rng = np.random.default_rng(2)
+    a = rng.normal(size=(256, 128)).astype(np.float32)
+    enc, spec = compress_arrays([a], "topk", ratio=0.1)
+    wire = sum(e.nbytes for e in enc)
+    assert wire < a.nbytes / 8  # >=8x smaller (u16 gaps + bf16 values -> ~10x)
+    dec = decompress_arrays(enc, spec)[0]
+    k = topk_count(a.size, 0.1)
+    kept = np.flatnonzero(dec.reshape(-1))
+    assert kept.size == k
+    # kept values match the original to bf16 precision; dropped decode to 0
+    np.testing.assert_allclose(
+        dec.reshape(-1)[kept], a.reshape(-1)[kept], rtol=2**-8
+    )
+
+
+def test_topk_nonfinite_ships_raw():
+    bad = np.array([np.nan, 1.0, np.inf], np.float32)
+    enc, spec = compress_arrays([bad], "topk", ratio=0.5)
+    assert spec[0]["codec"] == "raw"
+    dec = decompress_arrays(enc, spec)[0]
+    assert np.isnan(dec[0]) and np.isinf(dec[2])
+
+
+# --- error feedback -----------------------------------------------------------
+
+
+def test_error_feedback_residual_conservation():
+    """scatter(sent) + new_residual == delta + old_residual EXACTLY (float32
+    values): transmitted and untransmitted positions are disjoint, so no
+    floating-point resummation is involved."""
+    rng = np.random.default_rng(3)
+    delta = rng.normal(size=(2048,)).astype(np.float32)
+    residual = rng.normal(scale=0.1, size=(2048,)).astype(np.float32)
+    k = 204
+    idx, vals, new_resid = ef_topk_encode(delta, residual, k, value_dtype="float32")
+    idx, vals, new_resid = np.asarray(idx), np.asarray(vals), np.asarray(new_resid)
+    np.testing.assert_array_equal(
+        scatter_dense(idx, vals, delta.size) + new_resid, delta + residual
+    )
+    # transmitted positions are fully drained from the residual
+    assert (new_resid[idx] == 0).all()
+
+
+def test_error_feedback_recovers_tail_over_rounds():
+    """What top-k drops is not lost: with a CONSTANT per-round delta, the
+    residual grows until every coordinate eventually ships — total
+    transmitted mass approaches rounds * delta."""
+    rng = np.random.default_rng(4)
+    delta = rng.normal(size=(1000,)).astype(np.float32)
+    k = 100
+    residual = np.zeros_like(delta)
+    received = np.zeros_like(delta)
+    for _ in range(30):
+        idx, vals, residual = ef_topk_encode(delta, residual, k, "float32")
+        received += scatter_dense(np.asarray(idx), np.asarray(vals), delta.size)
+        residual = np.asarray(residual)
+    total = 30.0 * delta
+    # conservation: received + residual == total; and the residual is small
+    # relative to total (everything but the last few rounds' tail shipped)
+    np.testing.assert_allclose(received + residual, total, rtol=1e-5, atol=1e-4)
+    assert np.linalg.norm(residual) < 0.2 * np.linalg.norm(total)
+
+
+def test_ef_bf16_quantization_error_lands_in_residual():
+    rng = np.random.default_rng(5)
+    delta = rng.normal(size=(512,)).astype(np.float32)
+    idx, vals, resid = ef_topk_encode(delta, np.zeros_like(delta), 64, "bf16")
+    idx, resid = np.asarray(idx), np.asarray(resid)
+    dequant = np.asarray(vals).astype(np.float32)
+    # residual at transmitted positions == exact quantization error
+    np.testing.assert_array_equal(resid[idx], delta[idx] - dequant)
+
+
+# --- frame integrity ----------------------------------------------------------
+
+
+def test_sparse_frame_corruption_detected():
+    """CRC32 covers the sparse index+values arrays exactly like dense
+    weights: corrupting either region fails loudly."""
+    rng = np.random.default_rng(6)
+    a = rng.normal(size=(128, 64)).astype(np.float32)
+    enc, spec = compress_arrays([a], "topk", ratio=0.1)
+    blob = bytes(serialize_arrays(list(enc), {CODEC_META_KEY: spec}))
+    codec = DeltaWireCodec("t")
+    # pristine frame decodes
+    arrays, meta = codec.decode_frame(blob)
+    assert len(arrays) == 1
+    # flip one byte mid-payload (inside the index/values arrays — the frame
+    # tail is alignment padding, which is legitimately outside the checksum)
+    corrupted = bytearray(blob)
+    corrupted[len(blob) // 2] ^= 0xFF
+    with pytest.raises(DecodingParamsError, match="CRC32"):
+        codec.decode_frame(bytes(corrupted))
+
+
+def test_stateless_decoder_rejects_delta_frames():
+    """ModelHandle.set_parameters(bytes) has no anchor: a sparse delta frame
+    must fail loudly instead of silently adopting anchor-less weights."""
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.models.model_handle import decode_wire_frame
+
+    m = mlp_model(seed=0)
+    codec = DeltaWireCodec("s")
+    codec.set_anchor(m.get_parameters(), 0)
+    with Settings.overridden(WIRE_COMPRESSION="topk"):
+        blob = codec.encode_model(m, 0)
+    assert blob is not None
+    with pytest.raises(DecodingParamsError, match="delta"):
+        decode_wire_frame(bytes(blob))
+
+
+def test_encode_parameters_topk_downgrades_to_dense():
+    """Anchor-less encode paths (init frames, interop wire) ship dense even
+    under WIRE_COMPRESSION='topk' — a config-free receiver must decode."""
+    from p2pfl_tpu.models import mlp_model
+
+    m = mlp_model(seed=0)
+    with Settings.overridden(WIRE_COMPRESSION="topk"):
+        blob = m.encode_parameters()
+    receiver = mlp_model(seed=1)
+    receiver.set_parameters(bytes(blob))  # plain stateless decode
+    for got, want in zip(receiver.get_parameters(), m.get_parameters()):
+        np.testing.assert_array_equal(got, want)
+
+
+# --- codec (anchors + rounds) -------------------------------------------------
+
+
+def _perturbed(model, eps):
+    import jax
+    import jax.numpy as jnp
+
+    model.params = jax.tree.map(lambda x: x + eps * jnp.ones_like(x), model.params)
+    return model
+
+
+def test_delta_codec_roundtrip_and_round_gating():
+    from p2pfl_tpu.models import mlp_model
+
+    sender, receiver = mlp_model(seed=0), mlp_model(seed=0)
+    anchor = sender.get_parameters()
+    cs, cr = DeltaWireCodec("s"), DeltaWireCodec("r")
+    cs.set_anchor(anchor, 1)
+    cr.set_anchor(anchor, 1)
+    _perturbed(sender, 0.01)
+    sender.set_contribution(["s"], 42)
+    with Settings.overridden(WIRE_COMPRESSION="topk", WIRE_TOPK_RATIO=1.0,
+                             WIRE_TOPK_VALUES="float32"):
+        blob = cs.encode_model(sender, 1)
+        assert blob is not None
+        # wrong round -> dense fallback signal, not a bogus frame
+        assert cs.encode_model(sender, 7) is None
+    arrays, meta = cr.decode_frame(blob)
+    assert meta[DELTA_META_KEY]["round"] == 1
+    assert meta["contributors"] == ["s"] and meta["num_samples"] == 42
+    for got, want in zip(arrays, sender.get_parameters()):
+        np.testing.assert_allclose(got, want, atol=1e-6)
+    receiver.apply_frame(arrays, meta)
+    assert receiver.contributors == ["s"] and receiver.num_samples == 42
+
+    # receiver without a matching anchor round drops the frame recoverable-y
+    stale = DeltaWireCodec("x")
+    with pytest.raises(DeltaAnchorError):
+        stale.decode_frame(blob)
+    stale.set_anchor(anchor, 2)
+    with pytest.raises(DeltaAnchorError):
+        stale.decode_frame(blob)
+
+    # dense frames pass through the same decode entry point
+    dense_blob = sender.encode_parameters(compression="none")
+    arrays2, _ = cr.decode_frame(bytes(dense_blob))
+    for got, want in zip(arrays2, sender.get_parameters()):
+        np.testing.assert_array_equal(got, want)
+
+
+def test_delta_codec_requires_topk_scheme():
+    from p2pfl_tpu.models import mlp_model
+
+    m = mlp_model(seed=0)
+    codec = DeltaWireCodec("s")
+    codec.set_anchor(m.get_parameters(), 0)
+    with Settings.overridden(WIRE_COMPRESSION="none"):
+        assert codec.encode_model(m, 0) is None
+
+
+# --- robust aggregation satellite --------------------------------------------
+
+
+def test_geometric_median_ignores_inflated_sample_counts():
+    """A Byzantine peer claiming a huge num_samples must NOT gain weight:
+    GeometricMedian weights contributors uniformly (robust.py)."""
+    from p2pfl_tpu.learning.aggregators import GeometricMedian
+    from p2pfl_tpu.models.model_handle import ModelHandle
+
+    def _model(val, contributors, num_samples):
+        return ModelHandle(
+            {"w": np.full((4, 4), val, np.float32)},
+            contributors=contributors,
+            num_samples=num_samples,
+        )
+
+    honest = [_model(2.0, [f"h{i}"], 10) for i in range(4)]
+    byz = _model(500.0, ["byz"], 10**9)  # claims a billion samples
+    out = GeometricMedian(iters=16).aggregate(honest + [byz])
+    np.testing.assert_allclose(
+        out.get_parameters()[0], np.full((4, 4), 2.0), atol=0.5
+    )
+
+
+# --- live federations ---------------------------------------------------------
+
+
+def _run_federation(n_nodes, rounds, seed_offset=0):
+    """In-memory federation under current Settings; returns (total model-plane
+    TX bytes, mean final accuracy, per-node sparse frame counts)."""
+    from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+    from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.node import Node
+
+    data = synthetic_mnist(n_train=256 * n_nodes, n_test=128)
+    parts = data.generate_partitions(n_nodes, RandomIIDPartitionStrategy)
+    nodes = [
+        Node(mlp_model(seed=seed_offset + i), parts[i], batch_size=32)
+        for i in range(n_nodes)
+    ]
+    for node in nodes:
+        node.start()
+    try:
+        for i in range(1, n_nodes):
+            nodes[i].connect(nodes[0].addr)
+        from p2pfl_tpu.utils.utils import wait_convergence
+
+        wait_convergence(nodes, n_nodes - 1, wait=15)
+        nodes[0].set_start_learning(rounds=rounds, epochs=1)
+        deadline = time.time() + 360
+        while time.time() < deadline:
+            if all(
+                not n.learning_in_progress() and n.learning_workflow is not None
+                for n in nodes
+            ):
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError("federation did not finish")
+        tx_bytes = sum(n.protocol.gossiper.total_tx_bytes() for n in nodes)
+        accs = [n.learner.evaluate()["test_acc"] for n in nodes]
+        sparse_frames = sum(n.state.wire.sparse_frames for n in nodes)
+        return tx_bytes, float(np.mean(accs)), sparse_frames
+    finally:
+        for node in nodes:
+            node.stop()
+        InMemoryRegistry.reset()
+
+
+def test_e2e_topk_two_nodes_converges_and_shrinks_wire():
+    """Fast wire-path e2e: a 2-node federation under topk@10% learns (both
+    nodes clear the reference's 0.5 accuracy bar) while gossiping several
+    times fewer model-plane bytes than the dense run."""
+    Settings.RESOURCE_MONITOR_PERIOD = 0
+    with Settings.overridden(TRAIN_SET_SIZE=2):
+        with Settings.overridden(WIRE_COMPRESSION="none"):
+            dense_bytes, dense_acc, _ = _run_federation(2, 2)
+        with Settings.overridden(
+            WIRE_COMPRESSION="topk", WIRE_TOPK_RATIO=0.1, WIRE_TOPK_VALUES="bf16"
+        ):
+            sparse_bytes, sparse_acc, sparse_frames = _run_federation(2, 2)
+    assert sparse_frames > 0, "sparse delta path never engaged"
+    assert sparse_acc > 0.5, sparse_acc
+    # init frames stay dense in both runs, so demand a conservative 3x here;
+    # the 8-node acceptance run below measures the real >=8x
+    assert dense_bytes > 3 * sparse_bytes, (dense_bytes, sparse_bytes)
+
+
+@pytest.mark.slow
+def test_e2e_topk_eight_nodes_acceptance():
+    """Acceptance run: 8-node MNIST FedAvg, full committee, topk@10% vs
+    dense — >=8x fewer model-plane wire bytes per round, final accuracy
+    within 1 percentage point of the dense run."""
+    Settings.RESOURCE_MONITOR_PERIOD = 0
+    rounds = 3
+    with Settings.overridden(TRAIN_SET_SIZE=8):
+        with Settings.overridden(WIRE_COMPRESSION="none"):
+            dense_bytes, dense_acc, _ = _run_federation(8, rounds)
+        with Settings.overridden(
+            WIRE_COMPRESSION="topk", WIRE_TOPK_RATIO=0.1, WIRE_TOPK_VALUES="bf16"
+        ):
+            sparse_bytes, sparse_acc, sparse_frames = _run_federation(8, rounds)
+    assert sparse_frames > 0
+    dense_per_round = dense_bytes / rounds
+    sparse_per_round = sparse_bytes / rounds
+    assert dense_per_round >= 8 * sparse_per_round, (
+        f"wire reduction only {dense_per_round / sparse_per_round:.2f}x "
+        f"({dense_per_round:.0f} vs {sparse_per_round:.0f} bytes/round)"
+    )
+    assert sparse_acc >= dense_acc - 0.01, (
+        f"topk accuracy {sparse_acc:.4f} fell more than 1pp below "
+        f"dense {dense_acc:.4f}"
+    )

@@ -1,6 +1,6 @@
 # Convenience targets; everything also runs as the plain commands shown.
 
-.PHONY: test test-fast bench dryrun proto-check api-docs
+.PHONY: test test-fast bench dryrun proto-check api-docs telemetry-check
 
 test:            ## full suite on the virtual 8-device CPU mesh (~30 min, 1 core)
 	python -m pytest tests/ -q
@@ -16,6 +16,9 @@ dryrun:          ## 5-phase multichip dryrun on an 8-device virtual CPU mesh
 
 proto-check:     ## fail if node_pb2.py is stale w.r.t. node.proto
 	python -m p2pfl_tpu.comm.grpc.generate_proto --check
+
+telemetry-check: ## 2-node in-memory round; asserts the telemetry snapshot (fast, CPU-only)
+	JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/telemetry_check.py
 
 api-docs:        ## regenerate docs/api.md from the live package
 	PYTHONPATH=. python scripts/gen_api_docs.py

@@ -1348,6 +1348,178 @@ def run_wire_bench() -> None:
     os._exit(1 if "error" in out else 0)
 
 
+def run_telemetry_bench() -> None:
+    """Subprocess-style mode ``--telemetry``: run an 8-node in-memory MNIST
+    federation (sparse delta wire path, so codec metrics engage) with the
+    telemetry plane on, then emit ONE JSON line embedding (a) the metrics
+    registry snapshot (gossip bytes, compression ratio, aggregation wait,
+    per-stage durations, learner timings), (b) a per-round stage breakdown
+    computed from the round trace, and (c) pointers to the Prometheus text
+    snapshot + Perfetto-loadable Chrome trace written under artifacts/.
+
+    Shape overrides: P2PFL_TPU_TELEMETRY_NODES (default 8),
+    P2PFL_TPU_TELEMETRY_ROUNDS (default 2).
+    """
+    out: dict = {}
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"  # protocol-stack bench: CPU venue
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+        from p2pfl_tpu.config import Settings
+        from p2pfl_tpu.learning.dataset import (
+            RandomIIDPartitionStrategy,
+            synthetic_mnist,
+        )
+        from p2pfl_tpu.models import mlp_model
+        from p2pfl_tpu.node import Node
+        from p2pfl_tpu.telemetry import REGISTRY, TRACER
+        from p2pfl_tpu.telemetry.export import render_prometheus, snapshot
+        from p2pfl_tpu.utils.utils import set_test_settings, wait_convergence
+
+        n_nodes = int(os.environ.get("P2PFL_TPU_TELEMETRY_NODES", "8"))
+        rounds = int(os.environ.get("P2PFL_TPU_TELEMETRY_ROUNDS", "2"))
+        set_test_settings()
+        Settings.RESOURCE_MONITOR_PERIOD = 0
+        Settings.LOG_LEVEL = "WARNING"
+        Settings.TRAIN_SET_SIZE = n_nodes
+        Settings.WIRE_COMPRESSION = "topk"  # engage the delta codec metrics
+
+        REGISTRY.reset()
+        TRACER.reset()
+        _phase(f"telemetry bench: {n_nodes}-node federation, {rounds} rounds")
+        data = synthetic_mnist(n_train=256 * n_nodes, n_test=256)
+        parts = data.generate_partitions(n_nodes, RandomIIDPartitionStrategy)
+        nodes = [
+            Node(mlp_model(seed=i), parts[i], batch_size=32) for i in range(n_nodes)
+        ]
+        for nd in nodes:
+            nd.start()
+        try:
+            for i in range(1, n_nodes):
+                nodes[i].connect(nodes[0].addr)
+            wait_convergence(nodes, n_nodes - 1, wait=30)
+            nodes[0].set_start_learning(rounds=rounds, epochs=1)
+            deadline = time.time() + 900
+            while time.time() < deadline:
+                if all(
+                    not nd.learning_in_progress()
+                    and nd.learning_workflow is not None
+                    for nd in nodes
+                ):
+                    break
+                time.sleep(0.25)
+            else:
+                raise TimeoutError("telemetry federation did not finish")
+        finally:
+            for nd in nodes:
+                nd.stop()
+            InMemoryRegistry.reset()
+
+        # --- export surfaces ------------------------------------------------
+        prom_text = render_prometheus(REGISTRY)
+        snap = snapshot(REGISTRY)
+        trace = TRACER.export_chrome_trace()
+        os.makedirs("artifacts", exist_ok=True)
+        prom_path = os.path.join("artifacts", "telemetry_snapshot.prom")
+        trace_path = os.path.join("artifacts", "telemetry_trace.json")
+        with open(prom_path, "w") as f:
+            f.write(prom_text)
+        with open(trace_path, "w") as f:
+            json.dump(trace, f)
+
+        core_families = [
+            "p2pfl_gossip_tx_bytes_total",
+            "p2pfl_gossip_rx_bytes_total",
+            "p2pfl_wire_compression_ratio",
+            "p2pfl_aggregation_wait_seconds",
+            "p2pfl_stage_duration_seconds",
+            "p2pfl_learner_jit_compile_seconds",
+        ]
+        missing = [
+            fam
+            for fam in core_families
+            if fam not in snap or not snap[fam]["samples"]
+        ]
+        if missing:
+            raise AssertionError(f"metric families missing from snapshot: {missing}")
+
+        # --- per-round stage breakdown from the trace -----------------------
+        spans = TRACER.spans()
+        stage_breakdown: dict = {}
+        for s in spans:
+            r = s.args.get("round")
+            if r is None or s.name.startswith("recv:"):
+                continue
+            row = stage_breakdown.setdefault(str(r), {}).setdefault(
+                s.name, {"total_s": 0.0, "count": 0}
+            )
+            row["total_s"] = round(row["total_s"] + s.dur_s, 4)
+            row["count"] += 1
+
+        # --- cross-node trace assertion -------------------------------------
+        exp_traces = {s.trace_id for s in spans if s.name == "experiment"}
+        recv_traces = {s.trace_id for s in spans if s.name.startswith("recv:")}
+        cross_node_ok = len(exp_traces) == 1 and recv_traces <= exp_traces
+        if not cross_node_ok:
+            raise AssertionError(
+                f"cross-node spans do not share one trace id: "
+                f"experiments={exp_traces}, recv={recv_traces}"
+            )
+
+        # --- hot-path overhead (the acceptance sanity number) ---------------
+        child = REGISTRY.counter(
+            "p2pfl_bench_overhead_probe_total", "overhead probe", labels=("node",)
+        ).labels("bench")
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(20000):
+                child.inc()
+            best = min(best, (time.perf_counter() - t0) / 20000)
+
+        def _series(fam: str) -> list:
+            return snap.get(fam, {}).get("samples", [])
+
+        tx_bytes_total = sum(s["value"] for s in _series("p2pfl_gossip_tx_bytes_total"))
+        ratios = [s["value"] for s in _series("p2pfl_wire_compression_ratio")]
+        agg_wait = _series("p2pfl_aggregation_wait_seconds")
+        out = {
+            "metric": "telemetry_plane_8node_mnist_fedavg",
+            "value": round(best * 1e6, 3),
+            "unit": "us/counter_increment",
+            "vs_baseline": None,
+            "extra": {
+                "nodes": n_nodes,
+                "rounds": rounds,
+                "span_count": len(spans),
+                "trace_id": sorted(exp_traces)[0],
+                "cross_node_trace_ok": cross_node_ok,
+                "gossip_tx_bytes_total": int(tx_bytes_total),
+                "compression_ratio_mean": round(sum(ratios) / len(ratios), 2)
+                if ratios
+                else None,
+                "aggregation_wait_total_s": round(
+                    sum(s["sum"] for s in agg_wait), 3
+                ),
+                "stage_breakdown_by_round": stage_breakdown,
+                "prometheus_snapshot": prom_path,
+                "chrome_trace": trace_path,
+                "metric_families": sorted(snap.keys()),
+            },
+        }
+        _phase(
+            f"telemetry bench done: {len(spans)} spans, "
+            f"{len(snap)} metric families, increment {best*1e6:.2f}us"
+        )
+    except Exception as e:  # noqa: BLE001
+        traceback.print_exc(file=sys.stderr)
+        out["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out), flush=True)
+    os._exit(1 if "error" in out else 0)
+
+
 def measure_reference_baseline(
     remaining: float = float("inf"), ladder=None
 ) -> dict:
@@ -1795,6 +1967,8 @@ if __name__ == "__main__":
         run_cifar_bench()
     elif "--wire" in sys.argv:
         run_wire_bench()
+    elif "--telemetry" in sys.argv:
+        run_telemetry_bench()
     elif "--attn" in sys.argv:
         run_attn_bench()
     elif "--lm-mfu" in sys.argv:

@@ -17,6 +17,7 @@ from typing import TYPE_CHECKING, Any, List
 
 from p2pfl_tpu.comm.commands.command import Command
 from p2pfl_tpu.exceptions import DeltaAnchorError
+from p2pfl_tpu.telemetry import TRACER, tracing
 
 if TYPE_CHECKING:  # pragma: no cover
     from p2pfl_tpu.node import Node
@@ -198,21 +199,27 @@ class PartialModelCommand(Command):
             # Frames decode through the node's delta codec: dense frames pass
             # straight through; sparse top-k deltas reconstruct against this
             # round's anchor (jitted scatter-add — no host loop).
-            arrays, _ = state.wire.decode_frame(weights)
+            arrays, meta = state.wire.decode_frame(weights)
         except DeltaAnchorError as exc:
             # Out of phase, not corrupt: drop it, the gossip loop re-ships.
             log.debug("partial model from %s dropped: %s", source, exc)
             return
-        model = node.learner.get_model().build_copy(
-            params=arrays, contributors=contributors, num_samples=num_samples
-        )
-        agg = node.aggregator.add_model(model)
-        if agg:
-            node.protocol.broadcast(
-                node.protocol.build_msg(
-                    ModelsAggregatedCommand.get_name(), args=agg, round=state.round
-                )
+        # Trace context: the envelope slot (in-memory) is already attached by
+        # handle_envelope; the PFLT header slot covers gRPC weights frames.
+        wire_ctx = meta.get(tracing.TRACE_META_KEY, "") or tracing.current_wire()
+        with TRACER.recv_span(
+            "apply:partial_model", node.addr, wire_ctx, source=source, round=round
+        ):
+            model = node.learner.get_model().build_copy(
+                params=arrays, contributors=contributors, num_samples=num_samples
             )
+            agg = node.aggregator.add_model(model)
+            if agg:
+                node.protocol.broadcast(
+                    node.protocol.build_msg(
+                        ModelsAggregatedCommand.get_name(), args=agg, round=state.round
+                    )
+                )
 
 
 class FullModelCommand(Command):
@@ -243,8 +250,12 @@ class FullModelCommand(Command):
                 # and falls back to a dense frame for out-of-round peers.
                 log.debug("full model from %s dropped: %s", source, exc)
                 return
-            node.learner.get_model().apply_frame(arrays, meta)
-            state.last_full_model_round = max(state.last_full_model_round, round)
-            state.aggregated_model_event.set()
+            wire_ctx = meta.get(tracing.TRACE_META_KEY, "") or tracing.current_wire()
+            with TRACER.recv_span(
+                "apply:full_model", node.addr, wire_ctx, source=source, round=round
+            ):
+                node.learner.get_model().apply_frame(arrays, meta)
+                state.last_full_model_round = max(state.last_full_model_round, round)
+                state.aggregated_model_event.set()
         except Exception:
             log.exception("full_model from %s failed", source)

@@ -60,11 +60,33 @@ from p2pfl_tpu.ops.serialization import (
     encode_sparse_indices,
     serialize_arrays,
 )
+from p2pfl_tpu.telemetry import REGISTRY, tracing
 
 log = logging.getLogger("p2pfl_tpu")
 
 #: Reserved metadata key marking a frame as a round-anchored sparse delta.
 DELTA_META_KEY = "__delta__"
+
+_COMPRESSION_RATIO = REGISTRY.gauge(
+    "p2pfl_wire_compression_ratio",
+    "Dense float32 bytes over sparse frame bytes for the last encoded frame",
+    labels=("node",),
+)
+_RESIDUAL_L2 = REGISTRY.gauge(
+    "p2pfl_wire_residual_l2",
+    "L2 norm of the error-feedback residual after the last encode",
+    labels=("node",),
+)
+_SPARSE_FRAMES = REGISTRY.counter(
+    "p2pfl_wire_sparse_frames_total",
+    "Sparse delta frames encoded",
+    labels=("node",),
+)
+_DENSE_FALLBACK = REGISTRY.counter(
+    "p2pfl_wire_dense_fallback_total",
+    "encode_model calls that fell back to the dense path",
+    labels=("node",),
+)
 
 
 def _leaf_crc(leaves: Sequence[np.ndarray]) -> int:
@@ -141,12 +163,14 @@ class DeltaWireCodec:
         with self._lock:
             if self._anchor is None or self._anchor_round != int(round):
                 self.dense_fallback_frames += 1
+                _DENSE_FALLBACK.labels(self._addr).inc()
                 return None
             leaves = model.get_parameters()
             if len(leaves) != len(self._anchor) or any(
                 tuple(l.shape) != s for l, s in zip(leaves, self._shapes)
             ):
                 self.dense_fallback_frames += 1
+                _DENSE_FALLBACK.labels(self._addr).inc()
                 return None
             if self._residual is None:
                 self._residual = [np.zeros((a.size,), np.float32) for a in self._anchor]
@@ -200,8 +224,24 @@ class DeltaWireCodec:
                     "anchor_crc": self._anchor_crc,
                 },
             }
+            # Span context rides the frame header (the gRPC weights oneof
+            # has no args slot for Envelope.trace — tracing module docstring).
+            wire_ctx = tracing.current_wire()
+            if wire_ctx:
+                meta[tracing.TRACE_META_KEY] = wire_ctx
             self.sparse_frames += 1
-            return serialize_arrays(parts, meta)
+            _SPARSE_FRAMES.labels(self._addr).inc()
+            payload = serialize_arrays(parts, meta)
+            dense_bytes = sum(a.size * 4 for a in self._anchor) or 1
+            _COMPRESSION_RATIO.labels(self._addr).set(dense_bytes / max(len(payload), 1))
+            _RESIDUAL_L2.labels(self._addr).set(
+                float(
+                    np.sqrt(
+                        sum(float(np.dot(np.asarray(r), np.asarray(r))) for r in self._residual)
+                    )
+                )
+            )
+            return payload
 
     # --- decode -------------------------------------------------------------
 

@@ -15,6 +15,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from p2pfl_tpu.config import Settings
+from p2pfl_tpu.telemetry import tracing
 
 
 @dataclass
@@ -28,6 +29,12 @@ class Envelope:
     payload: Optional[bytes] = None  # serialized weights (ops.serialization)
     contributors: List[str] = field(default_factory=list)
     num_samples: int = 0
+    # Wire-propagated span context ("<trace_id>:<span_id>", empty when the
+    # frame was built outside any span — e.g. heartbeats). The in-memory
+    # transport carries it as-is; gRPC maps it onto a reserved trailing
+    # control arg (weights frames carry it in the PFLT header instead —
+    # telemetry/tracing.py module docstring).
+    trace: str = ""
 
     @property
     def is_weights(self) -> bool:
@@ -44,6 +51,7 @@ class Envelope:
             args=[str(a) for a in (args or [])],
             ttl=Settings.TTL,
             msg_id=secrets.randbits(63),
+            trace=tracing.current_wire(),
         )
 
     @staticmethod
@@ -68,4 +76,5 @@ class Envelope:
             payload=bytes(payload),
             contributors=list(contributors),
             num_samples=int(num_samples),
+            trace=tracing.current_wire(),
         )

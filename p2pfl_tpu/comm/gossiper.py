@@ -23,8 +23,36 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from p2pfl_tpu.comm.envelope import Envelope
 from p2pfl_tpu.config import Settings
 from p2pfl_tpu.exceptions import ProtocolNotStartedError
+from p2pfl_tpu.telemetry import REGISTRY
 
 log = logging.getLogger("p2pfl_tpu")
+
+# Model-plane TX accounting, exposed through the telemetry registry (the
+# Prometheus/JSON exposition surface every subsystem shares). The gossiper
+# ALSO keeps a per-instance (cmd, round) table: per-round queries
+# (``bytes_for_round``, read by RoundFinishedStage and bench --wire) must be
+# scoped to THIS gossiper's lifetime, and registry series — keyed by node
+# label — would bleed across tests that reuse an address.
+_TX_BYTES = REGISTRY.counter(
+    "p2pfl_gossip_tx_bytes_total",
+    "Model-plane payload bytes sent, by command and round",
+    labels=("node", "cmd", "round"),
+)
+_TX_FRAMES = REGISTRY.counter(
+    "p2pfl_gossip_tx_frames_total",
+    "Model-plane frames sent, by command and round",
+    labels=("node", "cmd", "round"),
+)
+_MSGS_SENT = REGISTRY.counter(
+    "p2pfl_gossip_msgs_sent_total",
+    "Control-plane messages fanned out by the async gossip thread",
+    labels=("node",),
+)
+_QUEUE_DEPTH = REGISTRY.gauge(
+    "p2pfl_gossip_queue_depth",
+    "Pending (envelope, targets) pairs awaiting the next gossip tick",
+    labels=("node",),
+)
 
 
 class Gossiper:
@@ -48,9 +76,13 @@ class Gossiper:
         self._thread: Optional[threading.Thread] = None
         # Model-plane TX accounting: (cmd, round) -> [frames, payload bytes].
         # The sparse delta wire path's bytes-per-round metric reads this
-        # (surfaced per round by RoundFinishedStage and by bench.py --wire).
+        # (surfaced per round by RoundFinishedStage and by bench.py --wire);
+        # the registry mirror (module-level counters above) is the process-
+        # wide exposition surface.
         self._tx_lock = threading.Lock()
         self._tx: Dict[Tuple[str, int], List[int]] = {}
+        self._msgs_sent = _MSGS_SENT.labels(self_addr)
+        self._queue_depth = _QUEUE_DEPTH.labels(self_addr)
 
     # --- lifecycle ----------------------------------------------------------
 
@@ -76,6 +108,8 @@ class Gossiper:
             row = self._tx.setdefault((env.cmd, env.round), [0, 0])
             row[0] += 1
             row[1] += len(env.payload)
+        _TX_FRAMES.labels(self._self_addr, env.cmd, env.round).inc()
+        _TX_BYTES.labels(self._self_addr, env.cmd, env.round).inc(len(env.payload))
 
     def wire_stats(self) -> Dict[Tuple[str, int], Tuple[int, int]]:
         """Copy of the model-plane TX table: (cmd, round) -> (frames, bytes)."""
@@ -116,6 +150,7 @@ class Gossiper:
             return
         with self._pending_lock:
             self._pending.append((env, targets))
+            self._queue_depth.set(len(self._pending))
 
     def _run(self) -> None:
         while not self._stop.wait(Settings.GOSSIP_PERIOD):
@@ -125,6 +160,7 @@ class Gossiper:
                     if not self._pending:
                         break
                     env, targets = self._pending.popleft()
+                    self._queue_depth.set(len(self._pending))
                 for t in targets:
                     try:
                         self._send(t, env)
@@ -135,6 +171,7 @@ class Gossiper:
                         # by protocol.send (raise_error=False); this guard
                         # only keeps the gossip thread alive on local bugs
                         log.exception("gossip send to %s failed unexpectedly", t)
+                self._msgs_sent.inc(len(targets) or 1)
                 budget -= len(targets) or 1
 
     # --- sync model gossip (reference gossiper.py:163-239) ------------------

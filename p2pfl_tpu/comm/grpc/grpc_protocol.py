@@ -29,6 +29,7 @@ from p2pfl_tpu.comm.neighbors import Neighbors
 from p2pfl_tpu.comm.protocol import CommunicationProtocol
 from p2pfl_tpu.config import Settings
 from p2pfl_tpu.exceptions import CommunicationError
+from p2pfl_tpu.telemetry import tracing
 
 log = logging.getLogger("p2pfl_tpu")
 
@@ -38,12 +39,20 @@ _SERVICE = "p2pfl_tpu.NodeService"
 def _env_to_pb(env: Envelope) -> node_pb2.Envelope:
     pb = node_pb2.Envelope(source=env.source, cmd=env.cmd, round=env.round)
     if env.is_weights:
-        # protobuf only accepts bytes; the native codec hands out bytearray
+        # protobuf only accepts bytes; the native codec hands out bytearray.
+        # No trace slot here: traced weights frames carry their span context
+        # in the PFLT header (tracing.TRACE_META_KEY) instead.
         pb.weights.payload = bytes(env.payload)
         pb.weights.contributors.extend(env.contributors)
         pb.weights.num_samples = env.num_samples
     else:
         pb.control.args.extend(env.args)
+        if env.trace:
+            # Reserved trailing arg: the schema predates tracing and protoc
+            # isn't in the image to regenerate it; every receiver strips
+            # this in _pb_to_env before dispatch, and a version-skewed peer
+            # just sees one extra arg (handlers index from the front).
+            pb.control.args.append(tracing.WIRE_ARG_PREFIX + env.trace)
         pb.control.ttl = env.ttl
         pb.control.msg_id = env.msg_id
     return pb
@@ -59,13 +68,18 @@ def _pb_to_env(pb: node_pb2.Envelope) -> Envelope:
             contributors=list(pb.weights.contributors),
             num_samples=int(pb.weights.num_samples),
         )
+    args = list(pb.control.args)
+    trace = ""
+    if args and args[-1].startswith(tracing.WIRE_ARG_PREFIX):
+        trace = args.pop()[len(tracing.WIRE_ARG_PREFIX):]
     return Envelope(
         source=pb.source,
         cmd=pb.cmd,
         round=pb.round,
-        args=list(pb.control.args),
+        args=args,
         ttl=int(pb.control.ttl),
         msg_id=int(pb.control.msg_id),
+        trace=trace,
     )
 
 

@@ -5,19 +5,47 @@ broadcasts a ``beat`` every ``HEARTBEAT_PERIOD``; every second tick it sweeps
 neighbors whose last_seen is older than ``HEARTBEAT_TIMEOUT``. Incoming beats
 call :meth:`beat` -> ``neighbors.refresh_or_add`` — this is how non-direct
 neighbors are discovered.
+
+Telemetry: the sender's ``timestamp`` (previously discarded) now feeds a
+per-peer clock-skew gauge — in-process federations read ~0, a real
+deployment surfaces NTP drift, the thing that silently breaks timeout-based
+failure detection — plus a beat inter-arrival gauge (receive-side jitter),
+a live-peer gauge and a missed-beat counter.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
 
 from p2pfl_tpu.comm.envelope import Envelope
 from p2pfl_tpu.comm.neighbors import Neighbors
 from p2pfl_tpu.config import Settings
+from p2pfl_tpu.telemetry import REGISTRY
 
 HEARTBEAT_CMD = "beat"
+
+_LIVE_PEERS = REGISTRY.gauge(
+    "p2pfl_heartbeat_live_peers",
+    "Neighbors with a fresh heartbeat at the last sweep",
+    labels=("node",),
+)
+_MISSED = REGISTRY.counter(
+    "p2pfl_heartbeat_missed_total",
+    "Neighbors dropped for missing heartbeats past HEARTBEAT_TIMEOUT",
+    labels=("node", "peer"),
+)
+_CLOCK_SKEW = REGISTRY.gauge(
+    "p2pfl_heartbeat_clock_skew_seconds",
+    "Receiver wall-clock minus the sender-stamped beat timestamp",
+    labels=("node", "peer"),
+)
+_INTERARRIVAL = REGISTRY.gauge(
+    "p2pfl_heartbeat_interarrival_seconds",
+    "Seconds between consecutive beats from the same peer",
+    labels=("node", "peer"),
+)
 
 
 class Heartbeater:
@@ -32,6 +60,8 @@ class Heartbeater:
         self._broadcast = broadcast_fn
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._last_beat_at: Dict[str, float] = {}  # peer -> local monotonic
+        self._live_peers = _LIVE_PEERS.labels(self_addr)
 
     def start(self) -> None:
         self._stop.clear()
@@ -50,6 +80,15 @@ class Heartbeater:
         """Incoming heartbeat (reference heartbeater.py:66-80)."""
         if source == self._self_addr:
             return
+        if timestamp > 0.0:
+            # Skew folds in one-way latency; for drift detection that noise
+            # floor (ms) is far below the drift that matters (seconds).
+            _CLOCK_SKEW.labels(self._self_addr, source).set(time.time() - timestamp)
+        now = time.monotonic()
+        prev = self._last_beat_at.get(source)
+        self._last_beat_at[source] = now
+        if prev is not None:
+            _INTERARRIVAL.labels(self._self_addr, source).set(now - prev)
         self._neighbors.refresh_or_add(source)
 
     def _run(self) -> None:
@@ -65,8 +104,14 @@ class Heartbeater:
             tick += 1
             if tick % 2 == 0:  # sweep stale neighbors (reference :85-105)
                 now = time.time()
-                for addr, seen in self._neighbors.last_seen().items():
+                last_seen = self._neighbors.last_seen()
+                for addr, seen in last_seen.items():
                     if now - seen > Settings.HEARTBEAT_TIMEOUT:
+                        _MISSED.labels(self._self_addr, addr).inc()
+                        self._last_beat_at.pop(addr, None)
                         self._neighbors.remove(addr, notify=False)
+                self._live_peers.set(
+                    sum(1 for s in last_seen.values() if now - s <= Settings.HEARTBEAT_TIMEOUT)
+                )
             if self._stop.wait(Settings.HEARTBEAT_PERIOD):
                 return

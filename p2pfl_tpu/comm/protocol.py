@@ -27,8 +27,21 @@ from p2pfl_tpu.exceptions import (
     NeighborNotConnectedError,
     ProtocolNotStartedError,
 )
+from p2pfl_tpu.telemetry import REGISTRY, TRACER
 
 log = logging.getLogger("p2pfl_tpu")
+
+# Inbound wire accounting (the TX mirror lives in comm/gossiper.py).
+_RX_BYTES = REGISTRY.counter(
+    "p2pfl_gossip_rx_bytes_total",
+    "Model-plane payload bytes received, by command",
+    labels=("node", "cmd"),
+)
+_RX_FRAMES = REGISTRY.counter(
+    "p2pfl_gossip_rx_frames_total",
+    "Inbound envelopes dispatched (control + weights), by command",
+    labels=("node", "cmd"),
+)
 
 
 def running(fn: Callable) -> Callable:
@@ -232,18 +245,33 @@ class CommunicationProtocol:
 
     def handle_envelope(self, env: Envelope) -> None:
         """Inbound dispatch with dedup + TTL re-gossip
-        (reference grpc_server.py:161-212)."""
+        (reference grpc_server.py:161-212).
+
+        Traced frames (``env.trace`` set) dispatch inside a receiver span
+        parented onto the SENDER's span, so cross-node latency — model
+        diffusion, vote RTT — is attributable in the exported trace.
+        """
+        _RX_FRAMES.labels(self._addr, env.cmd).inc()
         if env.is_weights:
-            self._dispatch_contained(
-                env,
-                weights=env.payload,
-                contributors=env.contributors,
-                num_samples=env.num_samples,
-            )
+            _RX_BYTES.labels(self._addr, env.cmd).inc(len(env.payload))
+            with TRACER.recv_span(
+                f"recv:{env.cmd}", self._addr, env.trace,
+                source=env.source, round=env.round, bytes=len(env.payload),
+            ):
+                self._dispatch_contained(
+                    env,
+                    weights=env.payload,
+                    contributors=env.contributors,
+                    num_samples=env.num_samples,
+                )
             return
         if not self.gossiper.check_and_set_processed(env.msg_id):
             return
-        self._dispatch_contained(env)
+        with TRACER.recv_span(
+            f"recv:{env.cmd}", self._addr, env.trace,
+            source=env.source, round=env.round,
+        ):
+            self._dispatch_contained(env)
         if env.ttl > 1:
             fwd = Envelope(
                 source=env.source,
@@ -252,6 +280,7 @@ class CommunicationProtocol:
                 args=env.args,
                 ttl=env.ttl - 1,
                 msg_id=env.msg_id,
+                trace=env.trace,  # re-gossip stays in the sender's trace
             )
             self.gossiper.add_message(fwd)
 

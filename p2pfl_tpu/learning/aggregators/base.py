@@ -22,10 +22,28 @@ bug.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Sequence
 
 from p2pfl_tpu.config import Settings
 from p2pfl_tpu.models.model_handle import ModelHandle
+from p2pfl_tpu.telemetry import REGISTRY
+
+_AGG_WAIT = REGISTRY.histogram(
+    "p2pfl_aggregation_wait_seconds",
+    "Time blocked in wait_and_get_aggregation before aggregating",
+    labels=("node",),
+)
+_AGG_CONTRIBUTORS = REGISTRY.gauge(
+    "p2pfl_aggregation_contributors",
+    "Contributors merged into the last aggregation",
+    labels=("node",),
+)
+_AGG_MISSING = REGISTRY.counter(
+    "p2pfl_aggregation_timeout_partials_total",
+    "Aggregations that proceeded with trainset members missing (timeout)",
+    labels=("node",),
+)
 
 
 class Aggregator:
@@ -119,7 +137,9 @@ class Aggregator:
         """Block until the round completes (or timeout) then aggregate
         whatever arrived (reference :177-207)."""
         timeout = Settings.AGGREGATION_TIMEOUT if timeout is None else timeout
+        t0 = time.perf_counter()
         self._finish_event.wait(timeout)
+        _AGG_WAIT.labels(self.node_addr).observe(time.perf_counter() - t0)
         with self._lock:
             if not self._models:
                 raise RuntimeError("no models to aggregate")
@@ -127,7 +147,10 @@ class Aggregator:
             if missing:
                 # Timeout path: proceed with partial participation (matches
                 # reference behavior of aggregating what it has).
-                pass
+                _AGG_MISSING.labels(self.node_addr).inc()
+            _AGG_CONTRIBUTORS.labels(self.node_addr).set(
+                len(self.get_aggregated_models())
+            )
             return self.aggregate(list(self._models))
 
     def get_partial_model(self, except_nodes: Sequence[str]) -> Optional[ModelHandle]:

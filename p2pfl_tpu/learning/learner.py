@@ -36,8 +36,26 @@ import optax
 
 from p2pfl_tpu.learning.dataset.dataset import FederatedDataset
 from p2pfl_tpu.models.model_handle import ModelHandle
+from p2pfl_tpu.telemetry import REGISTRY
 
 Pytree = Any
+
+_JIT_COMPILE_S = REGISTRY.gauge(
+    "p2pfl_learner_jit_compile_seconds",
+    "Wall-clock of the learner's FIRST jitted epoch call (XLA compile "
+    "included) — compare against steady-state step time",
+    labels=("node",),
+)
+_STEP_S = REGISTRY.gauge(
+    "p2pfl_learner_step_seconds",
+    "Steady-state seconds per training step (post-compile calls only)",
+    labels=("node",),
+)
+_STEPS_PER_S = REGISTRY.gauge(
+    "p2pfl_learner_steps_per_second",
+    "Steady-state training steps per second",
+    labels=("node",),
+)
 
 
 class Learner(abc.ABC):
@@ -281,6 +299,7 @@ class JaxLearner(Learner):
             [cb for cb in self.callbacks if cb not in self.SUPPORTED_CALLBACKS],
         )
         self._interrupt = threading.Event()
+        self._jit_timed = False  # first jitted call (compile) already gauged
         self._fit_count = 0
         self._dp_total_steps = 0  # cumulative DP-SGD steps across fit() calls
         self._nonprivate_steps = 0  # steps taken WITHOUT the DP mechanism
@@ -425,6 +444,8 @@ class JaxLearner(Learner):
                 )
 
         total_steps = 0
+        steady_time = 0.0
+        steady_steps = 0
         last_loss = float("nan")
         for epoch in range(self.epochs):
             if self._interrupt.is_set():
@@ -449,6 +470,7 @@ class JaxLearner(Learner):
                 if start > 0 and self._interrupt.is_set():
                     break
                 stop = min(start + seg, steps)
+                t_seg = time.perf_counter()
                 params, opt_state, loss = self._train_epoch(
                     params,
                     opt_state,
@@ -467,11 +489,25 @@ class JaxLearner(Learner):
                     dp_noise_multiplier=self.dp_noise_multiplier,
                 )
                 total_steps += stop - start
-                seg_losses.append((stop - start, float(loss)))
+                loss_f = float(loss)  # blocks on the async dispatch
+                seg_dur = time.perf_counter() - t_seg
+                if not self._jit_timed:
+                    # First jitted call = XLA compile + the segment's steps;
+                    # later calls hit the compile cache and time pure compute.
+                    self._jit_timed = True
+                    _JIT_COMPILE_S.labels(self._self_addr).set(seg_dur)
+                else:
+                    steady_time += seg_dur
+                    steady_steps += stop - start
+                seg_losses.append((stop - start, loss_f))
             last_loss = sum(n * l for n, l in seg_losses) / max(
                 sum(n for n, _ in seg_losses), 1
             )
             self.report("train_loss", last_loss, step=epoch)
+
+        if steady_steps > 0 and steady_time > 0:
+            _STEP_S.labels(self._self_addr).set(steady_time / steady_steps)
+            _STEPS_PER_S.labels(self._self_addr).set(steady_steps / steady_time)
 
         self._opt_state = opt_state
         model.params = params

@@ -1,8 +1,13 @@
 """Resource monitor thread (reference management/node_monitor.py:31-86):
-psutil cpu%, ram%, net MBps reported each RESOURCE_MONITOR_PERIOD."""
+psutil cpu%, ram%, net MBps reported each RESOURCE_MONITOR_PERIOD.
+
+Without psutil the monitor is inert: ``available`` is False so callers and
+tests can tell monitoring is off, and the first ``start()`` logs a one-time
+warning instead of silently doing nothing."""
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Callable, Optional
@@ -14,8 +19,15 @@ except ImportError:  # pragma: no cover
 
 from p2pfl_tpu.config import Settings
 
+log = logging.getLogger("p2pfl_tpu")
+
 
 class NodeMonitor:
+    #: False when psutil is missing — no system metrics will be reported.
+    available: bool = psutil is not None
+
+    _warned_unavailable = False  # process-wide: warn once, not per node
+
     def __init__(self, node_addr: str, report_fn: Callable[[str, str, float], None]) -> None:
         self._node = node_addr
         self._report = report_fn
@@ -24,6 +36,12 @@ class NodeMonitor:
 
     def start(self) -> None:
         if psutil is None:
+            if not NodeMonitor._warned_unavailable:
+                NodeMonitor._warned_unavailable = True
+                log.warning(
+                    "psutil is not installed — system resource monitoring "
+                    "(cpu/ram/net gauges) is disabled for this process"
+                )
             return
         self._stop.clear()
         self._thread = threading.Thread(

@@ -2,28 +2,72 @@
 
 Parity with reference management/p2pfl_web_services.py:58-268 (POST /node,
 /node-log, /node-metric/local, /node-metric/global, /node-metric/system).
-Uses stdlib urllib (no extra deps); failures are swallowed after marking the
-sink broken, so telemetry can never take a node down.
+Uses stdlib urllib (no extra deps); failures are swallowed — telemetry can
+never take a node down.
+
+Failure handling: a *recoverable* breaker, not the old permanently-sticky
+``_broken`` flag (one transient POST failure used to disable web telemetry
+for the process lifetime). After ``fail_threshold`` consecutive failures the
+breaker opens for an exponentially growing window (``backoff_base`` up to
+``backoff_max``); once the window expires the next call re-probes, and a
+single re-probe failure re-opens the window doubled. Every suppressed or
+failed POST is counted in the telemetry registry
+(``p2pfl_web_telemetry_drops_total``), so operators can see how much web
+telemetry was lost and why.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import threading
+import time
 import urllib.request
 from typing import Any, Dict
 
+from p2pfl_tpu.telemetry import REGISTRY
+
+log = logging.getLogger("p2pfl_tpu")
+
+_DROPS = REGISTRY.counter(
+    "p2pfl_web_telemetry_drops_total",
+    "Web telemetry POSTs lost, by reason (post_failed | breaker_open)",
+    labels=("reason",),
+)
+
 
 class WebServices:
-    def __init__(self, url: str, key: str, timeout: float = 5.0) -> None:
+    def __init__(
+        self,
+        url: str,
+        key: str,
+        timeout: float = 5.0,
+        fail_threshold: int = 3,
+        backoff_base: float = 1.0,
+        backoff_max: float = 300.0,
+    ) -> None:
         self._url = url.rstrip("/")
         self._key = key
         self._timeout = timeout
-        self._broken = False
+        self._fail_threshold = max(1, int(fail_threshold))
+        self._backoff_base = float(backoff_base)
+        self._backoff_max = float(backoff_max)
+        self._lock = threading.Lock()
+        self._consecutive_failures = 0
+        self._breaker_trips = 0  # consecutive open->reprobe->fail cycles
+        self._blocked_until = 0.0  # monotonic deadline of the open window
+
+    @property
+    def broken(self) -> bool:
+        """True while the breaker window is open (calls are dropped)."""
+        with self._lock:
+            return time.monotonic() < self._blocked_until
 
     def _post(self, path: str, body: Dict[str, Any]) -> None:
-        if self._broken:
-            return
+        with self._lock:
+            if time.monotonic() < self._blocked_until:
+                _DROPS.labels("breaker_open").inc()
+                return
         try:
             req = urllib.request.Request(
                 self._url + path,
@@ -34,8 +78,31 @@ class WebServices:
             with urllib.request.urlopen(req, timeout=self._timeout):
                 pass
         except Exception as exc:
-            self._broken = True
-            logging.getLogger("p2pfl_tpu").warning("web telemetry disabled: %s", exc)
+            self._record_failure(exc)
+        else:
+            with self._lock:
+                self._consecutive_failures = 0
+                self._breaker_trips = 0
+
+    def _record_failure(self, exc: Exception) -> None:
+        _DROPS.labels("post_failed").inc()
+        with self._lock:
+            self._consecutive_failures += 1
+            # After the first trip a single failed re-probe re-opens the
+            # window — re-probing is one attempt, not a fresh threshold.
+            threshold = 1 if self._breaker_trips else self._fail_threshold
+            if self._consecutive_failures < threshold:
+                return
+            self._breaker_trips += 1
+            self._consecutive_failures = 0
+            backoff = min(
+                self._backoff_base * (2 ** (self._breaker_trips - 1)),
+                self._backoff_max,
+            )
+            self._blocked_until = time.monotonic() + backoff
+        log.warning(
+            "web telemetry paused for %.1fs after failure: %s", backoff, exc
+        )
 
     def register_node(self, node: str) -> None:
         self._post("/node", {"address": node})

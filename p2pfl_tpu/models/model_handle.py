@@ -28,6 +28,7 @@ from p2pfl_tpu.ops.compression import (
     decompress_arrays,
 )
 from p2pfl_tpu.ops.serialization import deserialize_arrays, serialize_arrays
+from p2pfl_tpu.telemetry import tracing
 
 Pytree = Any
 
@@ -57,6 +58,11 @@ def encode_wire_frame(
         "num_samples": num_samples,
         "additional_info": additional_info,
     }
+    # Span context rides the frame header so traced weights frames stay
+    # attributable on transports whose envelope has no trace slot (gRPC).
+    wire_ctx = tracing.current_wire()
+    if wire_ctx:
+        meta[tracing.TRACE_META_KEY] = wire_ctx
     if compression != "none":
         arrays, spec = compress_arrays(arrays, compression)
         meta[CODEC_META_KEY] = spec

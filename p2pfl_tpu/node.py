@@ -41,6 +41,7 @@ from p2pfl_tpu.management.logger import logger
 from p2pfl_tpu.models.model_handle import ModelHandle
 from p2pfl_tpu.node_state import NodeState
 from p2pfl_tpu.stages.workflow import LearningWorkflow
+from p2pfl_tpu.telemetry import TRACER, tracing
 
 
 class Node:
@@ -170,22 +171,29 @@ class Node:
             raise ZeroRoundsException("rounds must be >= 1")
         if self.learning_in_progress():
             raise LearningRunningException("learning already in progress")
-        # Kick off peers first, then ourselves (reference node.py:359-370).
-        self.protocol.broadcast(
-            self.protocol.build_msg(
-                StartLearningCommand.get_name(), args=[str(rounds), str(epochs)]
+        # Mint the federation-wide trace id: the kickoff broadcasts run
+        # inside this span, so the start_learning frames carry its context
+        # and every peer's experiment adopts the same trace
+        # (start_learning_thread captures it from the ambient span).
+        with TRACER.span(
+            "set_start_learning", node=self.addr, trace_id=TRACER.new_trace_id()
+        ):
+            # Kick off peers first, then ourselves (reference node.py:359-370).
+            self.protocol.broadcast(
+                self.protocol.build_msg(
+                    StartLearningCommand.get_name(), args=[str(rounds), str(epochs)]
+                )
             )
-        )
-        # The initiator's weights seed the federation: mark our model
-        # initialized and announce it; every other node adopts these weights
-        # via InitModelCommand before round 0 (reference node.py:366-368 +
-        # init_model_command.py:31-97) — a common round-0 starting point is
-        # what SCAFFOLD's control-variate math assumes.
-        self.state.model_initialized_event.set()
-        self.protocol.broadcast(
-            self.protocol.build_msg(ModelInitializedCommand.get_name())
-        )
-        self.start_learning_thread(rounds, epochs)
+            # The initiator's weights seed the federation: mark our model
+            # initialized and announce it; every other node adopts these weights
+            # via InitModelCommand before round 0 (reference node.py:366-368 +
+            # init_model_command.py:31-97) — a common round-0 starting point is
+            # what SCAFFOLD's control-variate math assumes.
+            self.state.model_initialized_event.set()
+            self.protocol.broadcast(
+                self.protocol.build_msg(ModelInitializedCommand.get_name())
+            )
+            self.start_learning_thread(rounds, epochs)
 
     def set_stop_learning(self) -> None:
         self.protocol.broadcast(self.protocol.build_msg(StopLearningCommand.get_name()))
@@ -197,6 +205,11 @@ class Node:
         with self.state.start_thread_lock:
             if self.learning_in_progress():
                 return
+            # Adopt the federation trace: on the initiator this is the
+            # set_start_learning span's trace; on peers it is the sender's
+            # context attached around start_learning dispatch. Outside any
+            # span (direct API use) it stays None -> fresh local trace.
+            self.state.trace_id = tracing.current_trace_id()
             self.state.set_experiment(f"experiment-{self.addr}", rounds)
             logger.experiment_started(self.addr, self.state.experiment)
             self.learner.set_epochs(epochs)

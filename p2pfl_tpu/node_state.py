@@ -49,6 +49,11 @@ class NodeState:
         self.experiment: Optional[Experiment] = None
         self.simulation = False
         self.wire = DeltaWireCodec(addr)
+        # Federation-wide trace id of the running experiment: minted by the
+        # initiator, adopted by peers from the start_learning frame's span
+        # context (telemetry/tracing.py). None -> the workflow opens a
+        # fresh local trace.
+        self.trace_id: Optional[str] = None
 
         # Learning info (populated by commands / stages).
         self.models_aggregated: Dict[str, List[str]] = {}

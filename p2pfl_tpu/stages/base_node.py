@@ -26,6 +26,7 @@ from p2pfl_tpu.comm.commands.impl import (
 from p2pfl_tpu.comm.envelope import Envelope
 from p2pfl_tpu.config import Settings
 from p2pfl_tpu.stages.stage import Stage, check_early_stop
+from p2pfl_tpu.telemetry import TRACER
 
 if TYPE_CHECKING:  # pragma: no cover
     from p2pfl_tpu.node import Node
@@ -93,12 +94,13 @@ class StartLearningStage(Stage):
             model.get_num_samples(),
         )
 
-        node.protocol.gossip_weights(
-            early_stopping_fn=lambda: check_early_stop(node),
-            get_candidates_fn=candidates,
-            status_fn=lambda: sorted(candidates()),
-            model_fn=lambda nei: env,
-        )
+        with TRACER.span("diffuse:init_model", node=node.addr, round=state.round):
+            node.protocol.gossip_weights(
+                early_stopping_fn=lambda: check_early_stop(node),
+                get_candidates_fn=candidates,
+                status_fn=lambda: sorted(candidates()),
+                model_fn=lambda nei: env,
+            )
         if check_early_stop(node):
             return None
         return VoteTrainSetStage
@@ -117,37 +119,40 @@ class VoteTrainSetStage(Stage):
             return None
 
         # --- cast votes (reference :80-106) ---------------------------------
-        candidates = list(node.protocol.get_neighbors(only_direct=False)) + [node.addr]
-        num_votes = min(Settings.TRAIN_SET_SIZE, len(candidates))
-        chosen = random.sample(candidates, num_votes)
-        weights = [int((random.randint(0, 1000) / (i + 1))) for i in range(num_votes)]
-        my_votes = dict(zip(chosen, weights))
-        with state.train_set_votes_lock:
-            state.train_set_votes[node.addr] = my_votes
-        flat: List[str] = []
-        for cand, w in my_votes.items():
-            flat.extend([cand, str(w)])
-        node.protocol.broadcast(
-            node.protocol.build_msg(
-                VoteTrainSetCommand.get_name(), args=flat, round=state.round or 0
-            )
-        )
-
-        # --- aggregate votes (reference :108-168) ---------------------------
-        deadline = time.time() + Settings.VOTE_TIMEOUT
-        while True:
-            if check_early_stop(node):
-                return None
-            expected = set(node.protocol.get_neighbors(only_direct=False)) | {node.addr}
+        # One span covers cast -> all ballots in: its duration IS the vote
+        # RTT, and peers' recv:vote_train_set spans share its trace id.
+        with TRACER.span("vote_rtt", node=node.addr, round=state.round):
+            candidates = list(node.protocol.get_neighbors(only_direct=False)) + [node.addr]
+            num_votes = min(Settings.TRAIN_SET_SIZE, len(candidates))
+            chosen = random.sample(candidates, num_votes)
+            weights = [int((random.randint(0, 1000) / (i + 1))) for i in range(num_votes)]
+            my_votes = dict(zip(chosen, weights))
             with state.train_set_votes_lock:
-                have = set(state.train_set_votes)
-            if expected <= have:
-                break
-            if time.time() >= deadline:
-                log.info("%s: vote timeout — missing %s", node.addr, expected - have)
-                break
-            state.votes_ready_event.wait(timeout=2.0)
-            state.votes_ready_event.clear()
+                state.train_set_votes[node.addr] = my_votes
+            flat: List[str] = []
+            for cand, w in my_votes.items():
+                flat.extend([cand, str(w)])
+            node.protocol.broadcast(
+                node.protocol.build_msg(
+                    VoteTrainSetCommand.get_name(), args=flat, round=state.round or 0
+                )
+            )
+
+            # --- aggregate votes (reference :108-168) -----------------------
+            deadline = time.time() + Settings.VOTE_TIMEOUT
+            while True:
+                if check_early_stop(node):
+                    return None
+                expected = set(node.protocol.get_neighbors(only_direct=False)) | {node.addr}
+                with state.train_set_votes_lock:
+                    have = set(state.train_set_votes)
+                if expected <= have:
+                    break
+                if time.time() >= deadline:
+                    log.info("%s: vote timeout — missing %s", node.addr, expected - have)
+                    break
+                state.votes_ready_event.wait(timeout=2.0)
+                state.votes_ready_event.clear()
 
         with state.train_set_votes_lock:
             all_votes = {n: dict(v) for n, v in state.train_set_votes.items()}
@@ -186,7 +191,8 @@ class TrainStage(Stage):
         if check_early_stop(node):
             return None
 
-        node.learner.fit()
+        with TRACER.span("fit", node=node.addr, round=state.round):
+            node.learner.fit()
         if check_early_stop(node):
             return None
 
@@ -202,11 +208,13 @@ class TrainStage(Stage):
         if check_early_stop(node):
             return None
 
-        # Adopt the aggregated model (reference :90-96).
+        # Adopt the aggregated model (reference :90-96). The span exposes
+        # aggregation stalls (the wait dominates when peers lag).
         try:
-            aggregated = node.aggregator.wait_and_get_aggregation(
-                Settings.AGGREGATION_TIMEOUT
-            )
+            with TRACER.span("aggregation_wait", node=node.addr, round=state.round):
+                aggregated = node.aggregator.wait_and_get_aggregation(
+                    Settings.AGGREGATION_TIMEOUT
+                )
         except RuntimeError:
             log.warning("%s: aggregation produced nothing this round", node.addr)
             aggregated = own
@@ -280,12 +288,13 @@ class TrainStage(Stage):
                 partial.get_num_samples(),
             )
 
-        node.protocol.gossip_weights(
-            early_stopping_fn=early_stop,
-            get_candidates_fn=candidates,
-            status_fn=status,
-            model_fn=model_fn,
-        )
+        with TRACER.span("diffuse:partial_model", node=node.addr, round=state.round):
+            node.protocol.gossip_weights(
+                early_stopping_fn=early_stop,
+                get_candidates_fn=candidates,
+                status_fn=status,
+                model_fn=model_fn,
+            )
 
 
 class WaitAggregatedModelsStage(Stage):
@@ -307,9 +316,10 @@ class WaitAggregatedModelsStage(Stage):
             if state.last_full_model_round >= r:  # re-check after clear
                 got_it = True
             else:
-                got_it = state.aggregated_model_event.wait(
-                    timeout=Settings.AGGREGATION_TIMEOUT
-                )
+                with TRACER.span("full_model_wait", node=node.addr, round=r):
+                    got_it = state.aggregated_model_event.wait(
+                        timeout=Settings.AGGREGATION_TIMEOUT
+                    )
         if not got_it:
             log.warning("%s: no aggregated model arrived within timeout", node.addr)
         if check_early_stop(node):
@@ -375,12 +385,13 @@ class GossipModelStage(Stage):
                     )
             return _dense()
 
-        node.protocol.gossip_weights(
-            early_stopping_fn=lambda: check_early_stop(node),
-            get_candidates_fn=candidates,
-            status_fn=lambda: sorted(candidates()),
-            model_fn=model_fn,
-        )
+        with TRACER.span("diffuse:full_model", node=node.addr, round=r):
+            node.protocol.gossip_weights(
+                early_stopping_fn=lambda: check_early_stop(node),
+                get_candidates_fn=candidates,
+                status_fn=lambda: sorted(candidates()),
+                model_fn=model_fn,
+            )
         if check_early_stop(node):
             return None
         return RoundFinishedStage

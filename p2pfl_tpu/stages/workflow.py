@@ -1,18 +1,34 @@
 """The while-loop stage machine (reference p2pfl/stages/workflows.py:28-58):
 run stage -> next stage class -> repeat until None; record history for
-test assertions (reference test/node_test.py:114-120)."""
+test assertions (reference test/node_test.py:114-120).
+
+Telemetry: the whole run executes inside an ``experiment`` root span whose
+trace id is shared federation-wide (the initiator mints it; peers adopt it
+from the start_learning frame — see ``Node.set_start_learning``), and every
+stage executes inside a child span tagged with the round. Stage wall-clock
+also feeds the ``p2pfl_stage_duration_seconds`` histogram, the per-stage
+breakdown every perf PR reports through.
+"""
 
 from __future__ import annotations
 
 import logging
+import time
 from typing import TYPE_CHECKING, List, Optional, Type
 
 from p2pfl_tpu.stages.stage import Stage
+from p2pfl_tpu.telemetry import REGISTRY, TRACER
 
 if TYPE_CHECKING:  # pragma: no cover
     from p2pfl_tpu.node import Node
 
 log = logging.getLogger("p2pfl_tpu")
+
+_STAGE_DURATION = REGISTRY.histogram(
+    "p2pfl_stage_duration_seconds",
+    "Wall-clock per stage execution",
+    labels=("node", "stage"),
+)
 
 
 class LearningWorkflow:
@@ -28,11 +44,24 @@ class LearningWorkflow:
         from p2pfl_tpu.exceptions import ProtocolNotStartedError
 
         stage: Optional[Type[Stage]] = self.start_stage
+        exp = node.state.experiment
         try:
-            while stage is not None:
-                self.history.append(stage.name)
-                log.debug("%s: stage %s", node.addr, stage.name)
-                stage = stage.execute(node)
+            with TRACER.span(
+                "experiment",
+                node=node.addr,
+                trace_id=node.state.trace_id,  # None -> fresh trace
+                experiment=exp.exp_name if exp is not None else None,
+            ):
+                while stage is not None:
+                    self.history.append(stage.name)
+                    log.debug("%s: stage %s", node.addr, stage.name)
+                    name = stage.name
+                    t0 = time.perf_counter()
+                    with TRACER.span(name, node=node.addr, round=node.state.round):
+                        stage = stage.execute(node)
+                    _STAGE_DURATION.labels(node.addr, name).observe(
+                        time.perf_counter() - t0
+                    )
         except StopIteration:
             log.info("%s: learning stopped early", node.addr)
         except ProtocolNotStartedError:

@@ -1,0 +1,37 @@
+"""Federation telemetry plane: metrics registry + distributed round tracing.
+
+Two halves, both dependency-free (stdlib only) so every layer of the
+framework can import them without cycles:
+
+* :mod:`p2pfl_tpu.telemetry.metrics` — process-wide registry of labeled
+  counters / gauges / histograms with lock-cheap hot-path increments
+  (a child increment is one small-lock add, well under 2µs).
+* :mod:`p2pfl_tpu.telemetry.tracing` — span context managers whose
+  trace/span IDs ride the gossip wire (``Envelope.trace`` + the PFLT
+  ``__trace__`` header slot), so one round's wall-clock is attributable
+  across nodes; per-round timelines export as Chrome trace-event JSON
+  (Perfetto-viewable, same viewer story as ``management/profiler.py``'s
+  XLA traces).
+
+Export surfaces live in :mod:`p2pfl_tpu.telemetry.export`: Prometheus text
+exposition and a JSON snapshot of the registry.
+"""
+
+from p2pfl_tpu.telemetry.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    REGISTRY,
+)
+from p2pfl_tpu.telemetry.tracing import TRACER, Tracer  # noqa: F401
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "REGISTRY",
+    "TRACER",
+    "Tracer",
+]

@@ -1,0 +1,102 @@
+"""Export surfaces for the metrics registry.
+
+* :func:`render_prometheus` — Prometheus text exposition format 0.0.4
+  (``# HELP`` / ``# TYPE`` headers, escaped labels, cumulative histogram
+  buckets with ``le`` plus ``_sum``/``_count``), scrapeable as-is.
+* :func:`snapshot` — JSON-able dict of every family and series, the shape
+  ``bench.py --telemetry`` embeds into its BENCH json and the
+  ``make telemetry-check`` gate asserts against.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+from p2pfl_tpu.telemetry.metrics import Histogram, MetricsRegistry, REGISTRY
+
+
+def _escape_label(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(value: str) -> str:
+    return value.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt_labels(labels: Dict[str, str], extra: Optional[Dict[str, str]] = None) -> str:
+    merged = dict(labels)
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in merged.items())
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def render_prometheus(registry: MetricsRegistry = REGISTRY) -> str:
+    """Render every family in ``registry`` as Prometheus exposition text."""
+    out = []
+    for fam in registry.collect():
+        if fam.help:
+            out.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
+        out.append(f"# TYPE {fam.name} {fam.kind}")
+        if isinstance(fam, Histogram):
+            for labels, child in fam.samples():
+                bounds, counts, total, count = child.snapshot()
+                cum = 0
+                for b, c in zip(bounds, counts):
+                    cum += c
+                    le = _fmt_labels(labels, {"le": _fmt_value(b)})
+                    out.append(f"{fam.name}_bucket{le} {cum}")
+                cum += counts[-1]
+                le = _fmt_labels(labels, {"le": "+Inf"})
+                out.append(f"{fam.name}_bucket{le} {cum}")
+                out.append(f"{fam.name}_sum{_fmt_labels(labels)} {_fmt_value(total)}")
+                out.append(f"{fam.name}_count{_fmt_labels(labels)} {count}")
+        else:
+            for labels, child in fam.samples():
+                out.append(f"{fam.name}{_fmt_labels(labels)} {_fmt_value(child.value)}")
+    return "\n".join(out) + "\n"
+
+
+def snapshot(registry: MetricsRegistry = REGISTRY) -> Dict[str, Any]:
+    """JSON-able snapshot: family name -> {type, help, samples: [...]}.
+
+    Counter/gauge samples are ``{"labels": {...}, "value": v}``; histogram
+    samples carry ``buckets`` (upper-bound -> non-cumulative count), ``sum``
+    and ``count``.
+    """
+    snap: Dict[str, Any] = {}
+    for fam in registry.collect():
+        samples = []
+        if isinstance(fam, Histogram):
+            for labels, child in fam.samples():
+                bounds, counts, total, count = child.snapshot()
+                samples.append(
+                    {
+                        "labels": labels,
+                        "buckets": {
+                            **{_fmt_value(b): c for b, c in zip(bounds, counts)},
+                            "+Inf": counts[-1],
+                        },
+                        "sum": total,
+                        "count": count,
+                    }
+                )
+        else:
+            for labels, child in fam.samples():
+                samples.append({"labels": labels, "value": child.value})
+        snap[fam.name] = {"type": fam.kind, "help": fam.help, "samples": samples}
+    return snap
+
+
+__all__ = ["render_prometheus", "snapshot"]

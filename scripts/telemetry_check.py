@@ -1,0 +1,106 @@
+"""CI gate: run a 2-node in-memory federated round and assert the exported
+telemetry snapshot contains the core metric families and a shared-trace
+round timeline. Fast, CPU-only, tier-1-safe — invoked by
+``make telemetry-check``.
+
+Exit 0 when every check passes; nonzero with a reason on stderr otherwise.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import time  # noqa: E402
+
+
+CORE_FAMILIES = (
+    "p2pfl_gossip_tx_bytes_total",
+    "p2pfl_gossip_rx_bytes_total",
+    "p2pfl_gossip_msgs_sent_total",
+    "p2pfl_heartbeat_live_peers",
+    "p2pfl_aggregation_wait_seconds",
+    "p2pfl_stage_duration_seconds",
+    "p2pfl_learner_jit_compile_seconds",
+)
+
+
+def main() -> int:
+    from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+    from p2pfl_tpu.config import Settings
+    from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.node import Node
+    from p2pfl_tpu.telemetry import REGISTRY, TRACER
+    from p2pfl_tpu.telemetry.export import render_prometheus, snapshot
+    from p2pfl_tpu.utils.utils import set_test_settings, wait_convergence
+
+    set_test_settings()
+    Settings.RESOURCE_MONITOR_PERIOD = 0
+    Settings.LOG_LEVEL = "WARNING"
+    Settings.TRAIN_SET_SIZE = 2
+    REGISTRY.reset()
+    TRACER.reset()
+
+    data = synthetic_mnist(n_train=256, n_test=64)
+    parts = data.generate_partitions(2, RandomIIDPartitionStrategy)
+    nodes = [Node(mlp_model(seed=i), parts[i], batch_size=32) for i in range(2)]
+    for nd in nodes:
+        nd.start()
+    try:
+        nodes[1].connect(nodes[0].addr)
+        wait_convergence(nodes, 1, wait=15)
+        nodes[0].set_start_learning(rounds=1, epochs=1)
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if all(
+                not nd.learning_in_progress() and nd.learning_workflow is not None
+                for nd in nodes
+            ):
+                break
+            time.sleep(0.2)
+        else:
+            print("FAIL: 2-node round did not finish in 300s", file=sys.stderr)
+            return 1
+    finally:
+        for nd in nodes:
+            nd.stop()
+        InMemoryRegistry.reset()
+
+    snap = snapshot(REGISTRY)
+    missing = [f for f in CORE_FAMILIES if f not in snap or not snap[f]["samples"]]
+    if missing:
+        print(f"FAIL: metric families missing/empty: {missing}", file=sys.stderr)
+        return 1
+
+    text = render_prometheus(REGISTRY)
+    for fam in CORE_FAMILIES:
+        if f"# TYPE {fam}" not in text:
+            print(f"FAIL: {fam} absent from Prometheus exposition", file=sys.stderr)
+            return 1
+
+    spans = TRACER.spans()
+    exp_traces = {s.trace_id for s in spans if s.name == "experiment"}
+    if len(exp_traces) != 1:
+        print(
+            f"FAIL: expected one shared experiment trace id, got {exp_traces}",
+            file=sys.stderr,
+        )
+        return 1
+    if not any(s.name.startswith("recv:") and s.trace_id in exp_traces for s in spans):
+        print("FAIL: no cross-node recv spans joined the experiment trace", file=sys.stderr)
+        return 1
+
+    print(
+        f"telemetry-check OK: {len(snap)} metric families, {len(spans)} spans, "
+        f"trace {sorted(exp_traces)[0]}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

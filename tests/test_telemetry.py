@@ -1,0 +1,314 @@
+"""Telemetry plane: registry thread-safety, hot-path overhead, Prometheus
+exposition format, JSON snapshot, and trace propagation across the in-memory
+transport (ISSUE 2 acceptance: a counter increment stays under ~2µs; spans
+on both sides of a wire hop share one trace id)."""
+
+import json
+import re
+import threading
+import time
+
+import pytest
+
+from p2pfl_tpu.telemetry import REGISTRY, TRACER
+from p2pfl_tpu.telemetry.export import render_prometheus, snapshot
+from p2pfl_tpu.telemetry.metrics import MetricsRegistry
+from p2pfl_tpu.telemetry import tracing
+
+
+# --- registry ---------------------------------------------------------------
+
+
+def test_counter_thread_safety_under_concurrent_increments():
+    """Gossip + heartbeat threads increment shared children concurrently;
+    no update may be lost."""
+    reg = MetricsRegistry()
+    c = reg.counter("t_bytes_total", "b", labels=("node",))
+    child = c.labels("n1")
+    threads, per_thread = 8, 10_000
+    barrier = threading.Barrier(threads)
+
+    def worker():
+        barrier.wait()
+        for _ in range(per_thread):
+            child.inc()
+
+    ts = [threading.Thread(target=worker) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert child.value == threads * per_thread
+
+
+def test_labels_creation_is_race_free():
+    """Concurrent first-touch of the SAME label set must yield one child."""
+    reg = MetricsRegistry()
+    c = reg.counter("t_race_total", "b", labels=("k",))
+    barrier = threading.Barrier(8)
+
+    def worker():
+        barrier.wait()
+        for i in range(500):
+            c.labels(str(i % 10)).inc()
+
+    ts = [threading.Thread(target=worker) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    total = sum(child.value for _, child in c.samples())
+    assert total == 8 * 500
+
+
+def test_histogram_concurrent_observes_conserve_count():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_wait_seconds", "w", labels=("node",), buckets=(0.1, 1.0))
+    child = h.labels("n1")
+
+    def worker():
+        for i in range(2_000):
+            child.observe(0.05 if i % 2 else 5.0)
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    bounds, counts, total, count = child.snapshot()
+    assert count == 8_000
+    assert sum(counts) == 8_000
+    assert counts[0] == 4_000  # <=0.1 bucket
+    assert counts[-1] == 4_000  # +Inf bucket
+
+
+def test_counter_increment_overhead_under_two_microseconds():
+    """ISSUE 2 acceptance: the hot-path increment must stay cheap enough to
+    live inside gossip ticks. Best-of-5 guards against CI scheduler noise."""
+    reg = MetricsRegistry()
+    child = reg.counter("t_hot_total", "b", labels=("node",)).labels("n1")
+    n = 20_000
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            child.inc()
+        best = min(best, (time.perf_counter() - t0) / n)
+    assert best < 2e-6, f"counter increment costs {best*1e6:.2f}µs"
+
+
+def test_registry_get_or_create_idempotent_and_kind_checked():
+    reg = MetricsRegistry()
+    a = reg.counter("t_same_total", "b", labels=("x",))
+    assert reg.counter("t_same_total", "b", labels=("x",)) is a
+    with pytest.raises(ValueError):
+        reg.gauge("t_same_total", "b", labels=("x",))
+    with pytest.raises(ValueError):
+        reg.counter("t_same_total", "b", labels=("y",))
+
+
+def test_registry_reset_keeps_module_level_handles_live():
+    reg = MetricsRegistry()
+    c = reg.counter("t_keep_total", "b", labels=("node",))
+    child = c.labels("n1")
+    child.inc(5)
+    reg.reset()
+    assert child.value == 0
+    child.inc()  # the pre-reset handle still feeds the registered family
+    assert reg.get("t_keep_total").labels("n1").value == 1
+
+
+def test_counter_rejects_negative_and_gauge_moves_both_ways():
+    reg = MetricsRegistry()
+    c = reg.counter("t_up_total", "b")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("t_depth", "d")
+    g.set(3)
+    g.inc(2)
+    g.dec(4)
+    assert g.value == 1
+
+
+# --- exposition -------------------------------------------------------------
+
+
+def test_prometheus_exposition_format():
+    reg = MetricsRegistry()
+    c = reg.counter("fed_bytes_total", "payload bytes", labels=("node", "cmd"))
+    c.labels("n1", "full_model").inc(1024)
+    g = reg.gauge("fed_depth", "queue depth", labels=("node",))
+    g.labels('we"ird\\n1').set(2)
+    h = reg.histogram("fed_wait_seconds", "wait", labels=("node",), buckets=(0.5, 5.0))
+    h.labels("n1").observe(0.1)
+    h.labels("n1").observe(60.0)
+
+    text = render_prometheus(reg)
+    assert "# HELP fed_bytes_total payload bytes\n# TYPE fed_bytes_total counter" in text
+    assert 'fed_bytes_total{node="n1",cmd="full_model"} 1024' in text
+    # label values escape quotes and backslashes
+    assert 'fed_depth{node="we\\"ird\\\\n1"} 2' in text
+    # histogram: cumulative buckets, +Inf, _sum/_count
+    assert 'fed_wait_seconds_bucket{node="n1",le="0.5"} 1' in text
+    assert 'fed_wait_seconds_bucket{node="n1",le="5"} 1' in text
+    assert 'fed_wait_seconds_bucket{node="n1",le="+Inf"} 2' in text
+    assert 'fed_wait_seconds_count{node="n1"} 2' in text
+    assert re.search(r'fed_wait_seconds_sum\{node="n1"\} 60\.1', text)
+    # every non-comment line is "name{labels} value"
+    for line in text.strip().splitlines():
+        if not line.startswith("#"):
+            assert re.match(r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? \S+$', line), line
+
+
+def test_snapshot_is_json_roundtrippable_and_complete():
+    reg = MetricsRegistry()
+    reg.counter("s_total", "c", labels=("node",)).labels("n1").inc(3)
+    reg.histogram("s_seconds", "h", buckets=(1.0,)).observe(0.5)
+    snap = json.loads(json.dumps(snapshot(reg)))
+    assert snap["s_total"]["type"] == "counter"
+    assert snap["s_total"]["samples"][0] == {"labels": {"node": "n1"}, "value": 3}
+    hist = snap["s_seconds"]["samples"][0]
+    assert hist["count"] == 1 and hist["buckets"]["1"] == 1
+
+
+# --- tracing ----------------------------------------------------------------
+
+
+def test_span_nesting_parents_and_shares_trace():
+    TRACER.reset()
+    with TRACER.span("outer", node="n1") as outer_ctx:
+        with TRACER.span("inner", node="n1"):
+            pass
+    inner, outer = TRACER.spans()[-2:]
+    assert (inner.name, outer.name) == ("inner", "outer")
+    assert inner.trace_id == outer.trace_id == outer_ctx.trace_id
+    assert inner.parent_id == outer.span_id
+    assert inner.dur_s <= outer.dur_s
+
+
+def test_wire_context_roundtrip_and_malformed_tolerance():
+    assert tracing.parse_wire("") is None
+    assert tracing.parse_wire("garbage") is None
+    ctx = tracing.SpanContext("aaaa", "bbbb")
+    assert tracing.parse_wire(ctx.wire()) == ctx
+    with tracing.attach_wire("deadbeef:cafe"):
+        assert tracing.current_trace_id() == "deadbeef"
+    assert tracing.current_context() is None
+
+
+def test_trace_propagates_across_in_memory_transport():
+    """A control message sent inside a span on node A dispatches inside a
+    receiver span on node B with the SAME trace id (the cross-node
+    attribution the round tracer depends on)."""
+    from p2pfl_tpu.comm.commands.command import Command
+    from p2pfl_tpu.comm.memory.memory_protocol import InMemoryCommunicationProtocol
+
+    got = {}
+    done = threading.Event()
+
+    class Probe(Command):
+        @staticmethod
+        def get_name():
+            return "trace_probe"
+
+        def execute(self, source, round, *args, **kwargs):
+            got["trace_id"] = tracing.current_trace_id()
+            done.set()
+
+    a = InMemoryCommunicationProtocol()
+    b = InMemoryCommunicationProtocol()
+    b.add_command(Probe())
+    a.start()
+    b.start()
+    try:
+        a.connect(b.addr)
+        TRACER.reset()
+        with TRACER.span("sender_side", node=a.addr) as ctx:
+            a.send(b.addr, a.build_msg("trace_probe"))
+        assert done.wait(5.0), "probe command never dispatched"
+        assert got["trace_id"] == ctx.trace_id
+        recv = [s for s in TRACER.spans() if s.name == "recv:trace_probe"]
+        assert recv and recv[0].trace_id == ctx.trace_id
+        assert recv[0].node == b.addr
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_untraced_envelopes_record_no_recv_spans():
+    """Heartbeat-style traffic (no ambient span) must not churn the span
+    buffer — recv_span is a no-op for an empty wire context."""
+    from p2pfl_tpu.comm.memory.memory_protocol import InMemoryCommunicationProtocol
+
+    a = InMemoryCommunicationProtocol()
+    b = InMemoryCommunicationProtocol()
+    a.start()
+    b.start()
+    try:
+        a.connect(b.addr)
+        TRACER.reset()
+        a.send(b.addr, a.build_msg("beat", args=["123.0"]))
+        time.sleep(0.3)
+        assert [s for s in TRACER.spans() if s.name.startswith("recv:")] == []
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_trace_rides_grpc_control_args_and_pflt_header():
+    """The gRPC schema has no trace field: control frames carry the context
+    as a reserved trailing arg (stripped before dispatch), weights frames in
+    the PFLT header's __trace__ slot — both must round-trip."""
+    pytest.importorskip("grpc")
+    import numpy as np
+
+    from p2pfl_tpu.comm.envelope import Envelope
+    from p2pfl_tpu.comm.grpc.grpc_protocol import _env_to_pb, _pb_to_env
+    from p2pfl_tpu.models.model_handle import encode_wire_frame
+    from p2pfl_tpu.ops.serialization import deserialize_arrays
+
+    with TRACER.span("s", node="n") as ctx:
+        env = Envelope.message("127.0.0.1:1", "vote_train_set", args=["a", "5"], round=1)
+        blob = encode_wire_frame([np.ones((3,), np.float32)], ["n"], 1, {})
+    assert env.trace == ctx.wire()
+    back = _pb_to_env(_env_to_pb(env))
+    assert back.trace == env.trace
+    assert back.args == ["a", "5"]  # sentinel stripped before dispatch
+
+    untraced = Envelope.message("127.0.0.1:1", "beat", args=["1.0"])
+    pb = _env_to_pb(untraced)
+    assert list(pb.control.args) == ["1.0"]  # no sentinel when untraced
+    assert _pb_to_env(pb).trace == ""
+
+    _, meta = deserialize_arrays(bytes(blob))
+    assert meta[tracing.TRACE_META_KEY] == ctx.wire()
+
+
+def test_chrome_trace_export_shape():
+    TRACER.reset()
+    with TRACER.span("experiment", node="mem://a", round=0):
+        with TRACER.span("TrainStage", node="mem://a", round=0):
+            pass
+    trace = TRACER.export_chrome_trace()
+    events = trace["traceEvents"]
+    meta = [e for e in events if e["ph"] == "M"]
+    spans = [e for e in events if e["ph"] == "X"]
+    assert meta and meta[0]["args"]["name"] == "mem://a"
+    assert {s["name"] for s in spans} == {"experiment", "TrainStage"}
+    for s in spans:
+        assert s["dur"] >= 0 and "trace_id" in s["args"]
+    json.dumps(trace)  # Perfetto loads plain JSON — must serialize clean
+
+
+def test_gossiper_tx_counters_mirrored_into_registry():
+    """The ad-hoc gossip byte counters now live in the shared registry."""
+    from p2pfl_tpu.comm.envelope import Envelope
+    from p2pfl_tpu.comm.gossiper import Gossiper
+
+    g = Gossiper("mem://tx-test", send_fn=lambda n, e: None, get_direct_neighbors_fn=list)
+    env = Envelope.weights("mem://tx-test", "partial_model", 2, b"x" * 100, ["a"], 1)
+    g._record_tx(env)
+    fam = REGISTRY.get("p2pfl_gossip_tx_bytes_total")
+    assert fam is not None
+    assert fam.labels("mem://tx-test", "partial_model", "2").value == 100
+    assert g.bytes_for_round(2) == 100

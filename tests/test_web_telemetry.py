@@ -65,15 +65,74 @@ def test_payload_shapes_and_api_key(web_server):
 
 
 def test_breaker_opens_on_unreachable_sink():
-    """Telemetry failures must never take a node down: the first failed
-    POST trips the breaker, later calls return instantly without IO."""
-    ws = WebServices("http://127.0.0.1:1", key="k", timeout=0.5)
-    ws.register_node("node-a")  # fails, trips the breaker, swallowed
-    assert ws._broken
+    """Telemetry failures must never take a node down: after the failure
+    threshold the breaker opens, later calls return instantly without IO."""
+    ws = WebServices(
+        "http://127.0.0.1:1", key="k", timeout=0.5, fail_threshold=2,
+        backoff_base=30.0,
+    )
+    ws.register_node("node-a")  # fails (connection refused), swallowed
+    assert not ws.broken  # one transient failure must NOT disable telemetry
+    ws.register_node("node-a")  # second consecutive failure trips it
+    assert ws.broken
     t0 = time.monotonic()
     for _ in range(50):
         ws.send_log("node-a", "INFO", "dropped")
-    assert time.monotonic() - t0 < 0.2  # no network attempts after the trip
+    assert time.monotonic() - t0 < 0.2  # no network attempts while open
+
+
+def test_breaker_reprobes_after_backoff_window():
+    """The breaker is a window, not a latch: once the backoff expires the
+    client re-probes, and a healthy sink closes the breaker for good."""
+    state = {"fail": True}
+    ok_posts = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):  # noqa: N802
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            if state["fail"]:
+                self.send_response(500)
+            else:
+                ok_posts.append(self.path)
+                self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    ws = WebServices(
+        f"http://127.0.0.1:{srv.server_port}", key="k", timeout=2.0,
+        fail_threshold=1, backoff_base=0.1,
+    )
+    try:
+        ws.register_node("node-a")  # 500 -> trips the breaker
+        assert ws.broken
+        state["fail"] = False  # sink recovers
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not ok_posts:
+            ws.send_log("node-a", "INFO", "probe")  # dropped until window expires
+            time.sleep(0.05)
+        assert ok_posts, "breaker never re-probed after the backoff window"
+        assert not ws.broken  # the successful re-probe closed it
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_node_monitor_exposes_availability():
+    """Callers can tell whether system monitoring is actually on."""
+    mon = NodeMonitor("node-a", lambda n, m, v: None)
+    try:
+        import psutil  # noqa: F401
+
+        assert mon.available
+    except ImportError:
+        assert not mon.available
+        mon.start()  # must be a silent-safe no-op (plus a one-time warning)
+        assert mon._thread is None
 
 
 def test_node_monitor_reports_system_metrics():

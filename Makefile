@@ -1,6 +1,6 @@
 # Convenience targets; everything also runs as the plain commands shown.
 
-.PHONY: test test-fast bench dryrun proto-check api-docs telemetry-check
+.PHONY: test test-fast bench dryrun proto-check api-docs telemetry-check chaos-check
 
 test:            ## full suite on the virtual 8-device CPU mesh (~30 min, 1 core)
 	python -m pytest tests/ -q
@@ -19,6 +19,9 @@ proto-check:     ## fail if node_pb2.py is stale w.r.t. node.proto
 
 telemetry-check: ## 2-node in-memory round; asserts the telemetry snapshot (fast, CPU-only)
 	JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/telemetry_check.py
+
+chaos-check:     ## 3-node round with one mid-round kill; survivors must finish fast (CPU-only)
+	JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/chaos_check.py
 
 api-docs:        ## regenerate docs/api.md from the live package
 	PYTHONPATH=. python scripts/gen_api_docs.py

@@ -1348,6 +1348,232 @@ def run_wire_bench() -> None:
     os._exit(1 if "error" in out else 0)
 
 
+def run_chaos_bench() -> None:
+    """Subprocess-style mode ``--chaos``: round-survival acceptance run.
+
+    Runs the same 8-node in-memory MNIST FedAvg federation twice over the
+    real Node/gossip/aggregator stack — a fault-free baseline, then a chaos
+    run with 10% seeded message drop plus ONE trainset member crashed
+    mid-round — and asserts the hardening contract:
+
+    * the survivors complete every round (no stage sleeps out its fixed
+      timeout waiting on the dead peer),
+    * final mean accuracy lands within 2pp of the fault-free run,
+    * no stage wait exceeds its configured deadline (vote_rtt vs
+      VOTE_TIMEOUT, aggregation_wait / full_model_wait vs
+      AGGREGATION_TIMEOUT — measured from the round tracer's spans),
+    * fault injection is deterministic: the same seed replayed through a
+      fresh chaos plane yields identical injected-fault counts.
+
+    Shape overrides: P2PFL_TPU_CHAOS_BENCH_NODES (default 8),
+    P2PFL_TPU_CHAOS_BENCH_ROUNDS (default 3), P2PFL_TPU_CHAOS_BENCH_DROP
+    (default 0.1), P2PFL_TPU_CHAOS_BENCH_SEED (default 42).
+    """
+    out: dict = {}
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"  # protocol-stack bench: CPU venue
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from p2pfl_tpu.chaos import CHAOS, ChaosPlane
+        from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+        from p2pfl_tpu.config import Settings
+        from p2pfl_tpu.learning.dataset import (
+            RandomIIDPartitionStrategy,
+            synthetic_mnist,
+        )
+        from p2pfl_tpu.models import mlp_model
+        from p2pfl_tpu.node import Node
+        from p2pfl_tpu.telemetry import REGISTRY, TRACER
+        from p2pfl_tpu.utils.utils import set_test_settings, wait_convergence
+
+        n_nodes = int(os.environ.get("P2PFL_TPU_CHAOS_BENCH_NODES", "8"))
+        rounds = int(os.environ.get("P2PFL_TPU_CHAOS_BENCH_ROUNDS", "3"))
+        drop = float(os.environ.get("P2PFL_TPU_CHAOS_BENCH_DROP", "0.1"))
+        seed = int(os.environ.get("P2PFL_TPU_CHAOS_BENCH_SEED", "42"))
+        set_test_settings()
+        Settings.RESOURCE_MONITOR_PERIOD = 0
+        Settings.LOG_LEVEL = "WARNING"
+        Settings.TRAIN_SET_SIZE = max(2, n_nodes // 2)  # crash stays survivable
+
+        # Stage-wait deadlines asserted against the tracer's span durations.
+        wait_deadlines = {
+            # vote_rtt spans cast + ballot wait; its wait loop overshoots the
+            # vote deadline by at most one 0.5s slice + tally work.
+            "vote_rtt": Settings.VOTE_TIMEOUT + 3.0,
+            "aggregation_wait": Settings.AGGREGATION_TIMEOUT,
+            "full_model_wait": Settings.AGGREGATION_TIMEOUT,
+        }
+
+        def run_leg(chaotic: bool) -> dict:
+            REGISTRY.reset()
+            TRACER.reset()
+            CHAOS.reset()
+            data = synthetic_mnist(n_train=256 * n_nodes, n_test=256)
+            parts = data.generate_partitions(n_nodes, RandomIIDPartitionStrategy)
+            nodes = [
+                Node(mlp_model(seed=i), parts[i], batch_size=32)
+                for i in range(n_nodes)
+            ]
+            by_addr = {nd.addr: nd for nd in nodes}
+            for nd in nodes:
+                nd.start()
+            victim = None
+            try:
+                import contextlib
+
+                scope = (
+                    CHAOS.overridden(drop_rate=drop, seed=seed)
+                    if chaotic
+                    else contextlib.nullcontext()
+                )
+                with scope:
+                    for i in range(1, n_nodes):
+                        nodes[i].connect(nodes[0].addr)
+                    wait_convergence(nodes, n_nodes - 1, wait=30)
+                    t0 = time.monotonic()
+                    nodes[0].set_start_learning(rounds=rounds, epochs=1)
+                    deadline = time.time() + 900
+                    while time.time() < deadline:
+                        state0 = nodes[0].state
+                        if (
+                            chaotic
+                            and victim is None
+                            and state0.round == 1
+                            and state0.train_set
+                        ):
+                            # Crash one NON-initiator trainset member while
+                            # round 1 is mid-flight.
+                            for addr in state0.train_set:
+                                if addr != nodes[0].addr and addr in by_addr:
+                                    victim = by_addr[addr]
+                                    break
+                            victim = victim or nodes[-1]
+                            _phase(f"chaos: crashing {victim.addr} mid-round 1")
+                            victim.crash()
+                        survivors = [nd for nd in nodes if nd is not victim]
+                        if all(
+                            not nd.learning_in_progress()
+                            and nd.learning_workflow is not None
+                            for nd in survivors
+                        ):
+                            break
+                        time.sleep(0.25)
+                    else:
+                        raise TimeoutError(
+                            f"{'chaos' if chaotic else 'baseline'} federation "
+                            "did not finish"
+                        )
+                    wall_s = time.monotonic() - t0
+                    faults = CHAOS.fault_counts()  # before scope exit resets
+                survivors = [nd for nd in nodes if nd is not victim]
+                incomplete = {
+                    nd.addr: nd.learning_workflow.history.count("RoundFinishedStage")
+                    for nd in survivors
+                    if nd.learning_workflow.history.count("RoundFinishedStage")
+                    != rounds
+                }
+                if incomplete:
+                    raise AssertionError(
+                        f"survivors did not complete all {rounds} rounds: "
+                        f"{incomplete}"
+                    )
+                accs = [
+                    nd.learner.evaluate().get("test_acc", 0.0) for nd in survivors
+                ]
+                wait_max = {name: 0.0 for name in wait_deadlines}
+                for s in TRACER.spans():
+                    if s.name in wait_max:
+                        wait_max[s.name] = max(wait_max[s.name], s.dur_s)
+                over = {
+                    name: (m, wait_deadlines[name])
+                    for name, m in wait_max.items()
+                    if m >= wait_deadlines[name]
+                }
+                if over:
+                    raise AssertionError(
+                        f"stage wait exceeded its deadline: {over}"
+                    )
+                return {
+                    "wall_s": round(wall_s, 2),
+                    "final_test_acc_mean": round(sum(accs) / len(accs), 4),
+                    "final_test_acc_min": round(min(accs), 4),
+                    "survivors": len(survivors),
+                    "crashed": victim.addr if victim is not None else None,
+                    "max_wait_s": {k: round(v, 3) for k, v in wait_max.items()},
+                    "injected_faults": faults if chaotic else {},
+                }
+            finally:
+                for nd in nodes:
+                    nd.stop()
+                InMemoryRegistry.reset()
+
+        _phase(f"chaos bench: {n_nodes}-node baseline (fault-free)")
+        baseline = run_leg(chaotic=False)
+        _phase(f"baseline done: {json.dumps(baseline)}")
+        _phase(
+            f"chaos bench: {n_nodes}-node chaos leg "
+            f"(drop={drop}, 1 mid-round crash, seed={seed})"
+        )
+        chaos = run_leg(chaotic=True)
+        _phase(f"chaos leg done: {json.dumps(chaos)}")
+
+        acc_delta_pp = round(
+            100.0 * (baseline["final_test_acc_mean"] - chaos["final_test_acc_mean"]),
+            2,
+        )
+        if acc_delta_pp > 2.0:
+            raise AssertionError(
+                f"chaos accuracy degraded {acc_delta_pp}pp > 2pp tolerance "
+                f"(baseline {baseline['final_test_acc_mean']}, "
+                f"chaos {chaos['final_test_acc_mean']})"
+            )
+
+        # Determinism: the same seed replayed through fresh planes must give
+        # identical injected-fault counts (per-pair decision streams are pure
+        # functions of (seed, pair, sequence index)).
+        from p2pfl_tpu.config import Settings as S
+
+        replay_pairs = [(f"n{i}", f"n{j}") for i in range(4) for j in range(4) if i != j]
+        counts = []
+        for _ in range(2):
+            plane = ChaosPlane()
+            with S.overridden(
+                CHAOS_ENABLED=True, CHAOS_SEED=seed, CHAOS_DROP_RATE=drop
+            ):
+                for _ in range(500):
+                    for pair in replay_pairs:
+                        plane.intercept(*pair)
+            counts.append(plane.fault_counts())
+        if counts[0] != counts[1]:
+            raise AssertionError(f"fault injection not deterministic: {counts}")
+
+        out = {
+            "metric": "chaos_round_survival_8node_mnist_fedavg",
+            "value": acc_delta_pp,
+            "unit": "pp_acc_delta_vs_fault_free",
+            "vs_baseline": None,
+            "extra": {
+                "nodes": n_nodes,
+                "rounds": rounds,
+                "drop_rate": drop,
+                "seed": seed,
+                "baseline": baseline,
+                "chaos": chaos,
+                "deterministic_replay_counts": counts[0],
+                "wait_deadlines_s": wait_deadlines,
+                "note": "chaos leg: seeded 10% message drop + 1 trainset "
+                "member crashed mid-round; survivors must finish all rounds "
+                "with every stage wait under its deadline",
+            },
+        }
+    except Exception as e:  # noqa: BLE001
+        traceback.print_exc(file=sys.stderr)
+        out["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out), flush=True)
+    os._exit(1 if "error" in out else 0)
+
+
 def run_telemetry_bench() -> None:
     """Subprocess-style mode ``--telemetry``: run an 8-node in-memory MNIST
     federation (sparse delta wire path, so codec metrics engage) with the
@@ -1969,6 +2195,8 @@ if __name__ == "__main__":
         run_wire_bench()
     elif "--telemetry" in sys.argv:
         run_telemetry_bench()
+    elif "--chaos" in sys.argv:
+        run_chaos_bench()
     elif "--attn" in sys.argv:
         run_attn_bench()
     elif "--lm-mfu" in sys.argv:

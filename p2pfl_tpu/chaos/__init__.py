@@ -1,0 +1,7 @@
+"""Chaos/fault-injection plane: deterministic message drop, delay, duplication,
+partitions and crash simulation on the transport send path (see
+:mod:`p2pfl_tpu.chaos.plane`)."""
+
+from p2pfl_tpu.chaos.plane import CHAOS, ChaosPlane, Decision  # noqa: F401
+
+__all__ = ["CHAOS", "ChaosPlane", "Decision"]

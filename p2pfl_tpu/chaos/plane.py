@@ -1,0 +1,221 @@
+"""Deterministic chaos/fault-injection plane.
+
+Real-world FL treats device churn as the common case, not the exception
+(Papaya, arxiv 2111.04877), but nothing in a clean in-process federation can
+*reproduce* churn: every wait point quietly passes. This plane wraps the one
+choke point both transports share — :meth:`CommunicationProtocol.send` — with
+seeded, per-peer-pair fault rules:
+
+* **drop** — the frame silently vanishes (sender believes it was delivered),
+* **delay / jitter** — the sending thread stalls before the transport call
+  (models a slow link; per-node ``set_slow`` models a straggling peer),
+* **duplicate** — the frame is delivered twice (dedup/idempotency probes),
+* **partition** — sends across declared groups fail like a dead link,
+* **crash** — all sends to/from an address fail (an unreachable-but-alive
+  node; for a *real* mid-round process death use :meth:`Node.crash`).
+
+Determinism: every (src, dst) pair owns a ``random.Random`` seeded from
+``(Settings.CHAOS_SEED, src, dst)``, and every probabilistic intercept draws
+the same fixed number of uniforms regardless of which faults are enabled —
+so the i-th send on a pair receives the same decision on every run with the
+same seed and config. Scenario state (partitions/crashes/slow peers) is
+plane-level and scoped by :meth:`reset` / :meth:`overridden`.
+
+Configuration rides :class:`~p2pfl_tpu.config.Settings` (``P2PFL_TPU_CHAOS_*``
+env overrides, validated at config load like ``WIRE_COMPRESSION``), so
+``Settings.overridden(CHAOS_DROP_RATE=...)`` and the plane's own scoped
+:meth:`overridden` compose. Every injected fault is counted both in the
+process-wide telemetry registry (``p2pfl_chaos_faults_total``) and in a
+plane-local table (:meth:`fault_counts`) used for determinism assertions.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import random
+import threading
+from dataclasses import dataclass
+from typing import Dict, Iterator, Optional, Sequence, Set, Tuple
+
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.telemetry import REGISTRY
+
+log = logging.getLogger("p2pfl_tpu")
+
+_FAULTS = REGISTRY.counter(
+    "p2pfl_chaos_faults_total",
+    "Faults injected into the transport send path, by sending node and kind",
+    labels=("node", "fault"),
+)
+
+
+@dataclass(frozen=True)
+class Decision:
+    """What the send path must do with one outbound frame."""
+
+    drop: bool = False
+    #: fault name when the link is blocked ("partition" | "crash"); the send
+    #: path raises a CommunicationError, engaging the normal retry/removal
+    #: failure machinery exactly as a real dead link would.
+    blocked: Optional[str] = None
+    delay_s: float = 0.0
+    #: extra deliveries on top of the real one.
+    duplicates: int = 0
+
+
+_CLEAN = Decision()
+
+
+class ChaosPlane:
+    """Process-wide fault injector (one instance, :data:`CHAOS`, serves every
+    in-process node — per-pair rules keep federations independent)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._rngs: Dict[Tuple[str, str], random.Random] = {}
+        self._counts: Dict[str, int] = {}
+        self._groups: Dict[str, int] = {}  # addr -> partition group id
+        self._crashed: Set[str] = set()
+        self._slow: Dict[str, float] = {}  # addr -> extra delay per send
+
+    # --- activation ---------------------------------------------------------
+
+    @property
+    def active(self) -> bool:
+        """True when any fault rule could fire. The send hot path checks this
+        first, so a chaos-free federation pays two attribute reads."""
+        return bool(
+            Settings.CHAOS_ENABLED or self._groups or self._crashed or self._slow
+        )
+
+    # --- scenario controls (plane-level state, not Settings) ----------------
+
+    def partition(self, *groups: Sequence[str]) -> None:
+        """Block sends between addresses in different ``groups``. Addresses
+        in no group are unaffected."""
+        with self._lock:
+            self._groups = {a: i for i, g in enumerate(groups) for a in g}
+        log.warning("chaos: network partitioned into %d groups", len(groups))
+
+    def heal(self) -> None:
+        with self._lock:
+            self._groups = {}
+
+    def crash(self, addr: str) -> None:
+        """Make ``addr`` unreachable (all sends to/from it fail)."""
+        with self._lock:
+            self._crashed.add(addr)
+        log.warning("chaos: %s marked crashed (unreachable)", addr)
+
+    def restore(self, addr: str) -> None:
+        with self._lock:
+            self._crashed.discard(addr)
+
+    def set_slow(self, addr: str, extra_delay_s: float) -> None:
+        """Straggler: every send involving ``addr`` stalls ``extra_delay_s``."""
+        with self._lock:
+            if extra_delay_s > 0:
+                self._slow[addr] = float(extra_delay_s)
+            else:
+                self._slow.pop(addr, None)
+
+    def reset(self) -> None:
+        """Clear scenario state, per-pair RNG streams and local counts (the
+        registry mirror persists; ``REGISTRY.reset()`` clears it)."""
+        with self._lock:
+            self._rngs.clear()
+            self._counts.clear()
+            self._groups = {}
+            self._crashed.clear()
+            self._slow.clear()
+
+    # --- accounting ---------------------------------------------------------
+
+    def _count(self, src: str, fault: str) -> None:
+        # caller holds the lock
+        self._counts[fault] = self._counts.get(fault, 0) + 1
+        _FAULTS.labels(src, fault).inc()
+
+    def fault_counts(self) -> Dict[str, int]:
+        """Plane-local {fault: count} — the determinism-assertion surface:
+        same seed + same intercept sequence => identical dict."""
+        with self._lock:
+            return dict(self._counts)
+
+    # --- the intercept ------------------------------------------------------
+
+    def intercept(self, src: str, dst: str) -> Decision:
+        """Decide the fate of one outbound frame from ``src`` to ``dst``."""
+        with self._lock:
+            if src in self._crashed or dst in self._crashed:
+                self._count(src, "crash")
+                return Decision(blocked="crash")
+            gs, gd = self._groups.get(src), self._groups.get(dst)
+            if gs is not None and gd is not None and gs != gd:
+                self._count(src, "partition")
+                return Decision(blocked="partition")
+            key = (src, dst)
+            rng = self._rngs.get(key)
+            if rng is None:
+                rng = self._rngs[key] = random.Random(
+                    f"{Settings.CHAOS_SEED}|{src}->{dst}"
+                )
+            # Fixed draw order/count regardless of which faults are enabled,
+            # so per-pair decision streams stay aligned across configs with
+            # the same seed (determinism is per (seed, pair, sequence index)).
+            u_drop, u_dup, u_jit = rng.random(), rng.random(), rng.random()
+            if u_drop < Settings.CHAOS_DROP_RATE:
+                self._count(src, "drop")
+                return Decision(drop=True)
+            delay = (
+                Settings.CHAOS_DELAY_S
+                + Settings.CHAOS_DELAY_JITTER_S * u_jit
+                + self._slow.get(src, 0.0)
+                + self._slow.get(dst, 0.0)
+            )
+            duplicates = 1 if u_dup < Settings.CHAOS_DUPLICATE_RATE else 0
+            if delay <= 0.0 and duplicates == 0:
+                return _CLEAN
+            if delay > 0.0:
+                self._count(src, "delay")
+            if duplicates:
+                self._count(src, "duplicate")
+            return Decision(delay_s=delay, duplicates=duplicates)
+
+    # --- scoped configuration ----------------------------------------------
+
+    @contextlib.contextmanager
+    def overridden(
+        self,
+        *,
+        enabled: bool = True,
+        seed: Optional[int] = None,
+        drop_rate: Optional[float] = None,
+        delay_s: Optional[float] = None,
+        delay_jitter_s: Optional[float] = None,
+        duplicate_rate: Optional[float] = None,
+    ) -> Iterator["ChaosPlane"]:
+        """Scoped chaos config (tests/bench): overrides the CHAOS_* settings
+        for the block and resets RNG streams + scenario state on both entry
+        and exit, so every block starts from a deterministic clean slate."""
+        kw: Dict[str, object] = {"CHAOS_ENABLED": enabled}
+        for name, value in (
+            ("CHAOS_SEED", seed),
+            ("CHAOS_DROP_RATE", drop_rate),
+            ("CHAOS_DELAY_S", delay_s),
+            ("CHAOS_DELAY_JITTER_S", delay_jitter_s),
+            ("CHAOS_DUPLICATE_RATE", duplicate_rate),
+        ):
+            if value is not None:
+                kw[name] = value
+        self.reset()
+        try:
+            with Settings.overridden(**kw):
+                yield self
+        finally:
+            self.reset()
+
+
+#: The process-wide chaos plane the transport send path consults.
+CHAOS = ChaosPlane()

@@ -16,6 +16,7 @@ import logging
 from typing import TYPE_CHECKING, Any, List
 
 from p2pfl_tpu.comm.commands.command import Command
+from p2pfl_tpu.comm.delta import DELTA_META_KEY
 from p2pfl_tpu.exceptions import DeltaAnchorError
 from p2pfl_tpu.telemetry import TRACER, tracing
 
@@ -241,6 +242,7 @@ class FullModelCommand(Command):
         if round < state.round:
             return
         weights: bytes = kwargs["weights"]
+        already_adopted = round <= state.last_full_model_round
         try:
             try:
                 arrays, meta = state.wire.decode_frame(weights)
@@ -256,6 +258,30 @@ class FullModelCommand(Command):
             ):
                 node.learner.get_model().apply_frame(arrays, meta)
                 state.last_full_model_round = max(state.last_full_model_round, round)
+                # Rejoin/round-anchor resync: adopting a DENSE full model for
+                # round r means we now hold the exact model every in-phase
+                # node will anchor round r+1 against — so a crashed-and-
+                # restarted (or partition-healed) node whose anchor lags
+                # fast-forwards here, and subsequent sparse top-k frames for
+                # r+1 decode instead of being dropped forever. Sparse frames
+                # skip this: decoding one already required a current anchor,
+                # and a trainer's error-feedback residuals must survive the
+                # normal round boundary (RoundFinishedStage advances those).
+                if meta.get(DELTA_META_KEY) is None and round + 1 > state.wire.anchor_round:
+                    state.wire.resync(
+                        node.learner.get_model().get_parameters(), round + 1
+                    )
                 state.aggregated_model_event.set()
+            if already_adopted:
+                # Redundant re-delivery: the sender keeps gossiping because it
+                # never saw our round progress — our fire-once models_ready
+                # broadcast was probably lost. Re-announce so the sender's
+                # candidate set shrinks instead of it re-shipping full models
+                # until its stall exit trips (ack repair under message loss).
+                node.protocol.broadcast(
+                    node.protocol.build_msg(
+                        ModelsReadyCommand.get_name(), round=round
+                    )
+                )
         except Exception:
             log.exception("full_model from %s failed", source)

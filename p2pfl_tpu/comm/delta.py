@@ -142,6 +142,17 @@ class DeltaWireCodec:
         with self._lock:
             return self._anchor_round
 
+    def resync(self, leaves: Sequence[np.ndarray], round: int) -> None:
+        """Rejoin path: re-anchor after the node fell out of phase (crash +
+        restart, healed partition). Unlike :meth:`set_anchor` — the normal
+        one-round-boundary advance, where residuals carry over — this DROPS
+        the error-feedback residuals: they accumulated against a model
+        generation the federation has moved past, and replaying them against
+        the resynced anchor would inject stale mass into the next frames."""
+        with self._lock:
+            self._residual = None
+        self.set_anchor(leaves, round)
+
     def reset(self) -> None:
         with self._lock:
             self._anchor = None

@@ -53,6 +53,12 @@ _QUEUE_DEPTH = REGISTRY.gauge(
     "Pending (envelope, targets) pairs awaiting the next gossip tick",
     labels=("node",),
 )
+_ABANDONED = REGISTRY.counter(
+    "p2pfl_gossip_abandoned_total",
+    "Model gossip loops that gave up with candidates still unreached "
+    "(GOSSIP_EXIT_ON_X_EQUAL_ROUNDS stall trips)",
+    labels=("node",),
+)
 
 
 class Gossiper:
@@ -210,6 +216,16 @@ class Gossiper:
             if status == last_status:
                 equal_rounds += 1
                 if equal_rounds >= Settings.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS:
+                    # NOT the normal exit (that is candidates == []): progress
+                    # stalled with peers still unreached — e.g. a dead peer
+                    # that never confirms. Previously silent; a vanished model
+                    # transfer was undiagnosable.
+                    log.warning(
+                        "(%s) model gossip ABANDONED after %d stalled ticks; "
+                        "unreached candidates: %s",
+                        self._self_addr, equal_rounds, candidates,
+                    )
+                    _ABANDONED.labels(self._self_addr).inc()
                     return
             else:
                 equal_rounds = 0

@@ -15,9 +15,12 @@ a live-peer gauge and a missed-beat counter.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Callable, Dict, Optional
+
+log = logging.getLogger("p2pfl_tpu")
 
 from p2pfl_tpu.comm.envelope import Envelope
 from p2pfl_tpu.comm.neighbors import Neighbors
@@ -109,6 +112,15 @@ class Heartbeater:
                     if now - seen > Settings.HEARTBEAT_TIMEOUT:
                         _MISSED.labels(self._self_addr, addr).inc()
                         self._last_beat_at.pop(addr, None)
+                        log.warning(
+                            "(%s) declaring %s dead: no heartbeat for %.1fs "
+                            "(timeout %.1fs)",
+                            self._self_addr, addr, now - seen,
+                            Settings.HEARTBEAT_TIMEOUT,
+                        )
+                        # remove() fires the protocol's death callbacks, so
+                        # vote/aggregation waits re-evaluate immediately
+                        # instead of sleeping out their fixed timeouts.
                         self._neighbors.remove(addr, notify=False)
                 self._live_peers.set(
                     sum(1 for s in last_seen.values() if now - s <= Settings.HEARTBEAT_TIMEOUT)

@@ -11,6 +11,7 @@ synchronous in-proc transport would create).
 from __future__ import annotations
 
 import concurrent.futures
+import time
 from dataclasses import replace
 from typing import Optional
 
@@ -59,10 +60,19 @@ class InMemoryCommunicationProtocol(CommunicationProtocol):
         InMemoryRegistry.register(self.addr, self)
 
     def _server_stop(self) -> None:
-        InMemoryRegistry.unregister(self.addr)
-        if self._executor is not None:
-            self._executor.shutdown(wait=False, cancel_futures=True)
-            self._executor = None
+        # Unregister FIRST (identity-guarded: a restarted node at the same
+        # address must not be torn out by this old instance), so no new
+        # deliver() can reach a dying executor; then shut the executor down
+        # and bound-join its workers so crash-simulating tests don't leak
+        # handler threads or registry entries across cases even when
+        # handlers are in flight at stop() time.
+        InMemoryRegistry.unregister(self.addr, self)
+        executor, self._executor = self._executor, None
+        if executor is not None:
+            executor.shutdown(wait=False, cancel_futures=True)
+            deadline = time.monotonic() + 3.0
+            for t in list(getattr(executor, "_threads", ())):
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
 
     def accept_handshake(self, source_addr: str) -> None:
         """Remote side of connect (reference grpc_server.py:135-143)."""
@@ -75,9 +85,13 @@ class InMemoryCommunicationProtocol(CommunicationProtocol):
 
     def deliver(self, env: Envelope) -> None:
         """Entry point for inbound envelopes (the "RPC")."""
-        if not self._running or self._executor is None:
+        executor = self._executor
+        if not self._running or executor is None:
             raise CommunicationError(f"{self.addr} is not started")
-        self._executor.submit(self._handle_safely, env)
+        try:
+            executor.submit(self._handle_safely, env)
+        except RuntimeError as exc:  # shut down between the check and submit
+            raise CommunicationError(f"{self.addr} is stopping") from exc
 
     def _handle_safely(self, env: Envelope) -> None:
         try:

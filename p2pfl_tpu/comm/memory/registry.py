@@ -28,9 +28,14 @@ class InMemoryRegistry:
             cls._servers[addr] = server
 
     @classmethod
-    def unregister(cls, addr: str) -> None:
+    def unregister(cls, addr: str, server: Optional["InMemoryCommunicationProtocol"] = None) -> None:
+        """Remove ``addr``. When ``server`` is given, remove only if it is
+        still the registered instance — a crashed-and-restarted node at the
+        same address must not be torn out of the registry by the OLD
+        instance's (late) stop."""
         with cls._lock:
-            cls._servers.pop(addr, None)
+            if server is None or cls._servers.get(addr) is server:
+                cls._servers.pop(addr, None)
 
     @classmethod
     def lookup(cls, addr: str) -> Optional["InMemoryCommunicationProtocol"]:

@@ -8,9 +8,12 @@ failure detector.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger("p2pfl_tpu")
 
 
 class Neighbors:
@@ -21,6 +24,12 @@ class Neighbors:
         self.self_addr = self_addr
         self._lock = threading.RLock()
         self._neighbors: Dict[str, Tuple[Any, bool, float]] = {}
+        # Fired (with the removed address) AFTER an entry actually leaves the
+        # table — the death-propagation hook: heartbeat sweeps and send-
+        # failure write-offs both land here, so one callback covers every way
+        # a peer can die mid-round. Listeners run on the removing thread
+        # (heartbeater/transport) outside the table lock and must be cheap.
+        self._removal_listeners: List[Callable[[str], None]] = []
 
     # --- transport hooks ----------------------------------------------------
 
@@ -64,14 +73,24 @@ class Neighbors:
                 return
         self.add(addr, non_direct=True)
 
+    def add_removal_listener(self, fn: Callable[[str], None]) -> None:
+        self._removal_listeners.append(fn)
+
     def remove(self, addr: str, *, notify: bool = False) -> None:
         with self._lock:
             entry = self._neighbors.pop(addr, None)
-        if entry is not None and entry[0] is not None:
+        if entry is None:
+            return
+        if entry[0] is not None:
             try:
                 self.disconnect_from(addr, entry[0], notify=notify)
             except Exception:
                 pass
+        for fn in list(self._removal_listeners):
+            try:
+                fn(addr)
+            except Exception:  # a listener bug must not break membership
+                log.exception("neighbor-removal listener failed for %s", addr)
 
     def exists(self, addr: str, *, only_direct: bool = False) -> bool:
         with self._lock:
@@ -91,6 +110,8 @@ class Neighbors:
         with self._lock:
             return {a: t for a, (_, _, t) in self._neighbors.items()}
 
-    def clear(self) -> None:
+    def clear(self, *, notify: bool = True) -> None:
+        """Drop every neighbor; ``notify=False`` models an abrupt crash (no
+        disconnect RPCs — peers must discover the death via heartbeats)."""
         for addr in self.get_all():
-            self.remove(addr, notify=True)
+            self.remove(addr, notify=notify)

@@ -15,13 +15,16 @@ from __future__ import annotations
 import functools
 import logging
 import threading
+import time
 from typing import Any, Callable, List, Optional
 
+from p2pfl_tpu.chaos import CHAOS
 from p2pfl_tpu.comm.commands.command import Command, CommandDispatcher
 from p2pfl_tpu.comm.envelope import Envelope
 from p2pfl_tpu.comm.gossiper import Gossiper
 from p2pfl_tpu.comm.heartbeater import HEARTBEAT_CMD, Heartbeater
 from p2pfl_tpu.comm.neighbors import Neighbors
+from p2pfl_tpu.config import Settings
 from p2pfl_tpu.exceptions import (
     CommunicationError,
     NeighborNotConnectedError,
@@ -41,6 +44,16 @@ _RX_FRAMES = REGISTRY.counter(
     "p2pfl_gossip_rx_frames_total",
     "Inbound envelopes dispatched (control + weights), by command",
     labels=("node", "cmd"),
+)
+_SEND_RETRIES = REGISTRY.counter(
+    "p2pfl_send_retries_total",
+    "Transport send attempts retried after a failure (bounded backoff)",
+    labels=("node",),
+)
+_PEER_WRITTEN_OFF = REGISTRY.counter(
+    "p2pfl_peer_written_off_total",
+    "Neighbors removed after a send failed all its retry attempts",
+    labels=("node",),
 )
 
 
@@ -138,6 +151,19 @@ class CommunicationProtocol:
         self.neighbors.clear()
         self._server_stop()
 
+    def crash(self) -> None:
+        """Abrupt-death simulation: tear everything down WITHOUT disconnect
+        notifications, as a killed process would. Peers must discover the
+        death through heartbeat timeouts / send failures — which is exactly
+        what chaos tests exercise."""
+        if not self._running:
+            return
+        self._running = False
+        self.heartbeater.stop()
+        self.gossiper.stop()
+        self.neighbors.clear(notify=False)
+        self._server_stop()
+
     # --- membership ---------------------------------------------------------
 
     @running
@@ -154,6 +180,14 @@ class CommunicationProtocol:
     @running
     def get_neighbors(self, only_direct: bool = False) -> List[str]:
         return self.neighbors.get_all(only_direct=only_direct)
+
+    def on_neighbor_removed(self, fn: Callable[[str], None]) -> None:
+        """Register a death callback: fired (with the address) whenever a
+        neighbor leaves the table — heartbeat-timeout sweeps, send-failure
+        write-offs and explicit disconnects all converge here, so round
+        machinery (vote expectations, aggregation finish conditions) can
+        shrink immediately instead of sleeping out its fixed timeout."""
+        self.neighbors.add_removal_listener(fn)
 
     # --- messaging (reference communication_protocol.py:95-160) -------------
 
@@ -180,9 +214,23 @@ class CommunicationProtocol:
         create_connection: bool = False,
         raise_error: bool = True,
         remove_on_error: bool = True,
+        retries: int = 0,
     ) -> None:
         """Unicast with the reference's failure semantics
-        (grpc_client.py:124-192): on send failure the neighbor is dropped."""
+        (grpc_client.py:124-192), hardened two ways:
+
+        * **chaos intercept** — when the fault plane is active, each attempt
+          consults :data:`~p2pfl_tpu.chaos.CHAOS` first: injected drops
+          return silently (the sender believes it delivered), delays stall
+          this thread, duplicates double-deliver, and blocked links
+          (partition / crash) raise into the normal failure path below.
+        * **bounded retry** — a failed attempt is retried up to ``retries``
+          times with exponential backoff before the neighbor is written off
+          and removed (firing the death callbacks registered via
+          :meth:`on_neighbor_removed`). The gossip path passes
+          ``Settings.GOSSIP_SEND_RETRIES``; heartbeats stay at 0 (they ARE
+          the retry loop).
+        """
         if not self.neighbors.exists(nei):
             if create_connection:
                 self.neighbors.add(nei, non_direct=False)
@@ -190,26 +238,63 @@ class CommunicationProtocol:
                 raise NeighborNotConnectedError(f"{nei} is not a neighbor")
             else:
                 return
-        try:
-            self._transport_send(nei, env)
-        except (TypeError, AttributeError):
-            # Local programming error (e.g. bad payload type), not a peer
-            # failure: keep the neighbor and surface it loudly instead of
-            # masking it as a CommunicationError. (ValueError stays on the
-            # transport path: grpc raises it for closed-channel races.)
-            log.exception("send to %s failed with a local error", nei)
-            if raise_error:
-                raise
-        except Exception as exc:
-            if remove_on_error:
-                self.neighbors.remove(nei, notify=False)
-            if raise_error:
-                raise CommunicationError(f"send to {nei} failed: {exc}") from exc
+        attempts = 1 + max(0, int(retries))
+        for attempt in range(attempts):
+            try:
+                if CHAOS.active:
+                    decision = CHAOS.intercept(self._addr, nei)
+                    if decision.blocked:
+                        raise CommunicationError(
+                            f"chaos: link {self._addr} -> {nei} blocked "
+                            f"({decision.blocked})"
+                        )
+                    if decision.drop:
+                        return  # injected loss: the sender never learns
+                    if decision.delay_s > 0.0:
+                        time.sleep(decision.delay_s)
+                    for _ in range(decision.duplicates):
+                        self._transport_send(nei, env)
+                self._transport_send(nei, env)
+                return
+            except (TypeError, AttributeError):
+                # Local programming error (e.g. bad payload type), not a peer
+                # failure: keep the neighbor and surface it loudly instead of
+                # masking it as a CommunicationError. Never retried.
+                # (ValueError stays on the transport path: grpc raises it for
+                # closed-channel races.)
+                log.exception("send to %s failed with a local error", nei)
+                if raise_error:
+                    raise
+                return
+            except Exception as exc:
+                if attempt + 1 < attempts:
+                    _SEND_RETRIES.labels(self._addr).inc()
+                    time.sleep(
+                        min(Settings.GOSSIP_SEND_BACKOFF * (2**attempt), 2.0)
+                    )
+                    continue
+                if remove_on_error:
+                    _PEER_WRITTEN_OFF.labels(self._addr).inc()
+                    if attempts > 1:
+                        log.warning(
+                            "(%s) writing off %s after %d failed send attempts: %s",
+                            self._addr, nei, attempts, exc,
+                        )
+                    self.neighbors.remove(nei, notify=False)
+                if raise_error:
+                    raise CommunicationError(f"send to {nei} failed: {exc}") from exc
+                return
 
     def _safe_send(self, nei: str, env: Envelope) -> None:
         if not self._running:
             return
-        self.send(nei, env, raise_error=False, remove_on_error=True)
+        self.send(
+            nei,
+            env,
+            raise_error=False,
+            remove_on_error=True,
+            retries=Settings.GOSSIP_SEND_RETRIES,
+        )
 
     @running
     def broadcast(self, env: Envelope, node_list: Optional[List[str]] = None) -> None:

@@ -27,6 +27,35 @@ def _env_override(name: str, default: Any) -> Any:
     return raw
 
 
+def _env_int(name: str, default: int, lo: int, hi: int) -> int:
+    """Fail-fast integer env override (same pattern as WIRE_COMPRESSION: a
+    typo'd value fails at import, not mid-round in a gossip thread)."""
+    try:
+        v = int(_env_override(name, default))
+    except ValueError:
+        raise ValueError(
+            f"P2PFL_TPU_{name}={os.environ.get(f'P2PFL_TPU_{name}')!r} "
+            "is not an integer"
+        ) from None
+    if not lo <= v <= hi:
+        raise ValueError(f"P2PFL_TPU_{name}={v} must be in [{lo}, {hi}]")
+    return v
+
+
+def _env_float(name: str, default: float, lo: float, hi: float) -> float:
+    """Fail-fast float env override with a range check."""
+    try:
+        v = float(_env_override(name, default))
+    except ValueError:
+        raise ValueError(
+            f"P2PFL_TPU_{name}={os.environ.get(f'P2PFL_TPU_{name}')!r} "
+            "is not a number"
+        ) from None
+    if not lo <= v <= hi:
+        raise ValueError(f"P2PFL_TPU_{name}={v} must be in [{lo}, {hi}]")
+    return v
+
+
 class Settings:
     """Process-wide tunables.
 
@@ -58,6 +87,26 @@ class Settings:
     GOSSIP_MODELS_PER_ROUND: int = _env_override("GOSSIP_MODELS_PER_ROUND", 2)
     GOSSIP_EXIT_ON_X_EQUAL_ROUNDS: int = _env_override("GOSSIP_EXIT_ON_X_EQUAL_ROUNDS", 10)
     AMOUNT_LAST_MESSAGES_SAVED: int = _env_override("AMOUNT_LAST_MESSAGES_SAVED", 100)
+    # Bounded retry before a gossip send writes a peer off: the gossip path
+    # (protocol._safe_send) retries a failed transport send this many times
+    # with exponential backoff (base GOSSIP_SEND_BACKOFF, doubling per
+    # attempt) before the neighbor is removed and death callbacks fire. A
+    # transient blip no longer dismantles round membership; a real death is
+    # still detected in well under a heartbeat timeout.
+    GOSSIP_SEND_RETRIES: int = _env_int("GOSSIP_SEND_RETRIES", 2, 0, 16)
+    GOSSIP_SEND_BACKOFF: float = _env_float("GOSSIP_SEND_BACKOFF", 0.1, 0.0, 10.0)
+
+    # --- chaos / fault injection --------------------------------------------
+    # Deterministic fault plane on the transport send path (chaos/plane.py).
+    # All values validated at load with the WIRE_COMPRESSION fail-fast
+    # pattern: a typo'd env value raises HERE, not mid-round in a gossip
+    # thread. Rates are per-send probabilities in [0, 1]; delays in seconds.
+    CHAOS_ENABLED: bool = _env_override("CHAOS_ENABLED", False)
+    CHAOS_SEED: int = _env_int("CHAOS_SEED", 0, -(2**63), 2**63 - 1)
+    CHAOS_DROP_RATE: float = _env_float("CHAOS_DROP_RATE", 0.0, 0.0, 1.0)
+    CHAOS_DELAY_S: float = _env_float("CHAOS_DELAY_S", 0.0, 0.0, 10.0)
+    CHAOS_DELAY_JITTER_S: float = _env_float("CHAOS_DELAY_JITTER_S", 0.0, 0.0, 10.0)
+    CHAOS_DUPLICATE_RATE: float = _env_float("CHAOS_DUPLICATE_RATE", 0.0, 0.0, 1.0)
 
     # --- wire compression ---------------------------------------------------
     # Lossy-but-bounded codec for gossiped weights ("none" | "bf16" | "int8"
@@ -95,6 +144,15 @@ class Settings:
     TRAIN_SET_SIZE: int = _env_override("TRAIN_SET_SIZE", 4)
     VOTE_TIMEOUT: float = _env_override("VOTE_TIMEOUT", 60.0)
     AGGREGATION_TIMEOUT: float = _env_override("AGGREGATION_TIMEOUT", 300.0)
+    # Just-in-Time partial aggregation (arxiv 2208.09740): if no new
+    # contribution (or death) has advanced the round for this many seconds
+    # while contributions are still missing, aggregate whatever arrived
+    # instead of sleeping out AGGREGATION_TIMEOUT. Must sit well above
+    # normal fit-time variance (it only fires on a genuine stall — lost
+    # progress announcements, unreachable stragglers). 0 disables.
+    AGGREGATION_STALL_PATIENCE: float = _env_float(
+        "AGGREGATION_STALL_PATIENCE", 60.0, 0.0, 3600.0
+    )
 
     # --- nodes-mode learner executor ----------------------------------------
     # Concurrent fit/eval jobs across all in-process nodes (the reference

@@ -21,6 +21,7 @@ bug.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Dict, List, Optional, Sequence
@@ -28,6 +29,8 @@ from typing import Dict, List, Optional, Sequence
 from p2pfl_tpu.config import Settings
 from p2pfl_tpu.models.model_handle import ModelHandle
 from p2pfl_tpu.telemetry import REGISTRY
+
+log = logging.getLogger("p2pfl_tpu")
 
 _AGG_WAIT = REGISTRY.histogram(
     "p2pfl_aggregation_wait_seconds",
@@ -42,6 +45,17 @@ _AGG_CONTRIBUTORS = REGISTRY.gauge(
 _AGG_MISSING = REGISTRY.counter(
     "p2pfl_aggregation_timeout_partials_total",
     "Aggregations that proceeded with trainset members missing (timeout)",
+    labels=("node",),
+)
+_AGG_DEAD = REGISTRY.counter(
+    "p2pfl_aggregation_dead_contributors_total",
+    "Trainset members dropped from the expected set after being declared dead",
+    labels=("node",),
+)
+_AGG_STALL = REGISTRY.counter(
+    "p2pfl_aggregation_stall_partials_total",
+    "Aggregations cut short by the JIT stall patience (no progress while "
+    "contributions were still missing)",
     labels=("node",),
 )
 
@@ -59,6 +73,9 @@ class Aggregator:
         self._finish_event = threading.Event()
         self._train_set: List[str] = []
         self._models: List[ModelHandle] = []
+        # monotonic timestamp of the last round progress (a stored model, a
+        # death-shrink, or the round opening) — drives the JIT stall patience.
+        self._last_progress = time.monotonic()
 
     # --- learner integration -------------------------------------------------
 
@@ -81,6 +98,7 @@ class Aggregator:
             self._train_set = list(train_set)
             self._models = []
             self._finish_event.clear()
+            self._last_progress = time.monotonic()
 
     def clear(self) -> None:
         with self._lock:
@@ -93,12 +111,40 @@ class Aggregator:
         with self._lock:
             out: List[str] = []
             for m in self._models:
-                out.extend(m.get_contributors())
+                # Attribute access, not get_contributors(): a stored handle
+                # whose contributor list was raced to empty (full-model
+                # adoption mutating a shared handle) must degrade to "no
+                # contributors", not blow up round bookkeeping from a
+                # heartbeat or gossip thread.
+                out.extend(m.contributors)
             return sorted(set(out))
 
     def get_missing_models(self) -> List[str]:
         with self._lock:
             return sorted(set(self._train_set) - set(self.get_aggregated_models()))
+
+    def remove_node(self, addr: str) -> bool:
+        """Death callback: shrink the round's expected-contributor set.
+
+        Called when ``addr`` is declared dead mid-round (heartbeat timeout or
+        send-failure write-off). If its contribution already arrived it is
+        KEPT (the training happened); otherwise the node leaves the expected
+        set, and — the whole point — the finish condition is re-evaluated so
+        ``wait_and_get_aggregation`` wakes immediately instead of sleeping
+        out ``AGGREGATION_TIMEOUT``. Returns True when the expected set
+        actually shrank.
+        """
+        with self._lock:
+            if addr not in self._train_set:
+                return False
+            if addr in self.get_aggregated_models():
+                return False  # its model arrived before it died — keep it
+            self._train_set.remove(addr)
+            _AGG_DEAD.labels(self.node_addr).inc()
+            self._last_progress = time.monotonic()
+            if set(self.get_aggregated_models()) >= set(self._train_set):
+                self._finish_event.set()
+            return True
 
     # --- feeding models ------------------------------------------------------
 
@@ -110,7 +156,9 @@ class Aggregator:
         Duplicate/subset contributions and contributors outside the trainset
         are ignored, matching reference :113-175.
         """
-        contributors = set(model.get_contributors())
+        contributors = set(model.contributors)
+        if not contributors:
+            return []  # anonymous model: nothing to account it against
         with self._lock:
             if not self._train_set:
                 # Round not open yet (e.g. model gossip raced ahead of the
@@ -123,9 +171,10 @@ class Aggregator:
                 return sorted(already)  # nothing new
             # Drop stored models that are now subsets of the incoming one.
             self._models = [
-                m for m in self._models if not set(m.get_contributors()) <= contributors
+                m for m in self._models if not set(m.contributors) <= contributors
             ]
             self._models.append(model)
+            self._last_progress = time.monotonic()
             agg = self.get_aggregated_models()
             if set(agg) >= set(self._train_set):
                 self._finish_event.set()
@@ -138,7 +187,30 @@ class Aggregator:
         whatever arrived (reference :177-207)."""
         timeout = Settings.AGGREGATION_TIMEOUT if timeout is None else timeout
         t0 = time.perf_counter()
-        self._finish_event.wait(timeout)
+        deadline = t0 + timeout
+        patience = Settings.AGGREGATION_STALL_PATIENCE
+        # Sliced wait so the finish condition is RE-EVALUATED on death
+        # callbacks (remove_node sets the event) and the JIT stall patience
+        # can fire: if nothing has advanced the round for ``patience``
+        # seconds while we hold at least one model, aggregate what arrived
+        # (Just-in-Time Aggregation) instead of sleeping out the timeout.
+        while not self._finish_event.wait(timeout=0.25):
+            if time.perf_counter() >= deadline:
+                break
+            if patience > 0:
+                with self._lock:
+                    stalled = (
+                        bool(self._models)
+                        and time.monotonic() - self._last_progress >= patience
+                    )
+                if stalled:
+                    _AGG_STALL.labels(self.node_addr).inc()
+                    log.warning(
+                        "(%s) aggregation stalled for %.1fs with %s still "
+                        "missing — JIT-aggregating what arrived",
+                        self.node_addr, patience, self.get_missing_models(),
+                    )
+                    break
         _AGG_WAIT.labels(self.node_addr).observe(time.perf_counter() - t0)
         with self._lock:
             if not self._models:
@@ -163,7 +235,7 @@ class Aggregator:
         except_set = set(except_nodes)
         with self._lock:
             unseen = [
-                m for m in self._models if not (set(m.get_contributors()) & except_set)
+                m for m in self._models if not (set(m.contributors) & except_set)
             ]
             if not unseen:
                 return None
@@ -184,6 +256,6 @@ class Aggregator:
     def _merge_metadata(models: List[ModelHandle]) -> tuple[List[str], int]:
         contributors: List[str] = []
         for m in models:
-            contributors.extend(m.get_contributors())
+            contributors.extend(m.contributors)
         total = sum(m.get_num_samples() for m in models)
         return sorted(set(contributors)), total

@@ -16,6 +16,7 @@ whole population as one sharded XLA program instead of per-node threads.
 from __future__ import annotations
 
 import threading
+import time
 from typing import List, Optional, Type
 
 from p2pfl_tpu.comm.commands.impl import (
@@ -99,6 +100,13 @@ class Node:
         # checkpoint.attach_node_checkpointing.
         self.round_end_hooks: List = []
 
+        # Round-survival: any neighbor removal (heartbeat-declared death,
+        # send-failure write-off, disconnect) shrinks this round's
+        # expectations immediately — vote waits, the aggregation finish
+        # condition and partial-gossip candidate sets all re-evaluate
+        # instead of sleeping out their fixed timeouts.
+        self.protocol.on_neighbor_removed(self._on_peer_death)
+
         # Register the command handlers (reference node.py:121-134).
         self.protocol.add_command(
             [
@@ -153,6 +161,24 @@ class Node:
             self._running = False
             logger.unregister_node(self.addr)
 
+    def crash(self) -> None:
+        """Simulate abrupt process death mid-round (chaos tests / bench):
+        no stop_learning broadcast, no disconnect notifications, no graceful
+        workflow join — the transport just vanishes, and peers must discover
+        it via heartbeat timeouts or send failures. The in-process pieces
+        are still reclaimed (threads stopped, registry entry released) so
+        crash-simulating tests don't leak across cases."""
+        if not self._running:
+            return
+        self.learner.interrupt_fit()
+        self.aggregator.clear()
+        self.state.experiment = None  # stage machine exits via early-stop
+        self.state.votes_ready_event.set()
+        self.state.aggregated_model_event.set()
+        self.protocol.crash()
+        self._running = False
+        logger.unregister_node(self.addr)
+
     # --- membership ---------------------------------------------------------
 
     def connect(self, addr: str) -> bool:
@@ -194,6 +220,34 @@ class Node:
                 self.protocol.build_msg(ModelInitializedCommand.get_name())
             )
             self.start_learning_thread(rounds, epochs)
+        # The kickoff must survive message loss: start_learning is a single
+        # fire-once control frame, and in a star topology there is no second
+        # path that can re-deliver it — one dropped frame leaves an alive
+        # node that never joins the experiment, wins committee votes and
+        # burns every stage timeout for the whole federation. Re-broadcast a
+        # couple of times (fresh msg_id each, handler idempotent) so a peer
+        # missing the first frame still joins during round 0's vote window.
+        threading.Thread(
+            target=self._rebroadcast_kickoff,
+            args=(rounds, epochs),
+            name=f"kickoff-{self.addr}",
+            daemon=True,
+        ).start()
+
+    def _rebroadcast_kickoff(self, rounds: int, epochs: int) -> None:
+        for _ in range(2):
+            time.sleep(max(0.25, Settings.HEARTBEAT_PERIOD))
+            if self.state.experiment is None or not self._running:
+                return
+            try:
+                self.protocol.broadcast(
+                    self.protocol.build_msg(
+                        StartLearningCommand.get_name(),
+                        args=[str(rounds), str(epochs)],
+                    )
+                )
+            except Exception:  # protocol stopping — nothing to re-deliver to
+                return
 
     def set_stop_learning(self) -> None:
         self.protocol.broadcast(self.protocol.build_msg(StopLearningCommand.get_name()))
@@ -247,6 +301,32 @@ class Node:
     @property
     def learning_workflow(self) -> Optional[LearningWorkflow]:
         return self._workflow
+
+    # --- round survival ------------------------------------------------------
+
+    def _on_peer_death(self, addr: str) -> None:
+        """Death callback (runs on the heartbeater/transport thread that
+        removed the neighbor): shrink every wait this round still has open
+        on ``addr``. A contribution that already arrived is kept — only the
+        EXPECTATION of one dies with the peer."""
+        state = self.state
+        if state.experiment is None:
+            return
+        in_train_set = addr in state.train_set
+        if in_train_set:
+            # Rebind (don't mutate): stages iterate the current binding.
+            state.train_set = [n for n in state.train_set if n != addr]
+        shrunk = self.aggregator.remove_node(addr)
+        state.models_aggregated.pop(addr, None)
+        # Wake the vote wait: it recomputes its expected-voter set from live
+        # membership, which no longer includes the dead peer.
+        state.votes_ready_event.set()
+        if in_train_set or shrunk:
+            logger.warning(
+                self.addr,
+                f"trainset member {addr} died mid-round {state.round}: "
+                f"expectations shrunk (aggregation re-evaluated: {shrunk})",
+            )
 
     # --- hooks used by stages/commands --------------------------------------
 

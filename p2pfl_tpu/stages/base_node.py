@@ -139,6 +139,11 @@ class VoteTrainSetStage(Stage):
             )
 
             # --- aggregate votes (reference :108-168) -----------------------
+            # The expected-voter set is recomputed from LIVE membership every
+            # pass, and the death callback (Node._on_peer_death) sets
+            # votes_ready_event — so a voter dying mid-election shrinks the
+            # expectation and wakes this wait immediately instead of the
+            # stage burning the remainder of VOTE_TIMEOUT.
             deadline = time.time() + Settings.VOTE_TIMEOUT
             while True:
                 if check_early_stop(node):
@@ -151,7 +156,10 @@ class VoteTrainSetStage(Stage):
                 if time.time() >= deadline:
                     log.info("%s: vote timeout — missing %s", node.addr, expected - have)
                     break
-                state.votes_ready_event.wait(timeout=2.0)
+                # Short slices: the deadline overshoot is bounded by one
+                # slice, so the stage ends within ~VOTE_TIMEOUT even when the
+                # last ballots never arrive.
+                state.votes_ready_event.wait(timeout=0.5)
                 state.votes_ready_event.clear()
 
         with state.train_set_votes_lock:
@@ -196,7 +204,17 @@ class TrainStage(Stage):
         if check_early_stop(node):
             return None
 
-        own = node.learner.get_model()
+        # Snapshot COPY, not the live learner handle: a racing full-model
+        # adoption (FullModelCommand.apply_frame) mutates the learner's
+        # model in place — contributors included — and would corrupt the
+        # aggregator's stored entry mid-round (observed under chaos as
+        # contributor lists raced to empty).
+        live = node.learner.get_model()
+        own = live.build_copy(
+            params=live.get_parameters(),
+            contributors=live.contributors or [node.addr],
+            num_samples=live.get_num_samples(),
+        )
         agg_list = node.aggregator.add_model(own)
         node.protocol.broadcast(
             node.protocol.build_msg(
@@ -284,7 +302,7 @@ class TrainStage(Stage):
                 PartialModelCommand.get_name(),
                 state.round or 0,
                 payload,
-                partial.get_contributors(),
+                partial.contributors,
                 partial.get_num_samples(),
             )
 
@@ -316,10 +334,30 @@ class WaitAggregatedModelsStage(Stage):
             if state.last_full_model_round >= r:  # re-check after clear
                 got_it = True
             else:
+                # Sliced wait that re-evaluates liveness: if every trainset
+                # member has been declared dead there is no one left to
+                # produce a full model — give up immediately instead of
+                # burning the whole AGGREGATION_TIMEOUT (the death callbacks
+                # already shrank state.train_set).
                 with TRACER.span("full_model_wait", node=node.addr, round=r):
-                    got_it = state.aggregated_model_event.wait(
-                        timeout=Settings.AGGREGATION_TIMEOUT
-                    )
+                    deadline = time.time() + Settings.AGGREGATION_TIMEOUT
+                    got_it = False
+                    while time.time() < deadline:
+                        if state.aggregated_model_event.wait(timeout=0.5):
+                            got_it = True
+                            break
+                        if check_early_stop(node):
+                            return None
+                        live = set(
+                            node.protocol.get_neighbors(only_direct=False)
+                        ) | {node.addr}
+                        if state.train_set and not (set(state.train_set) & live):
+                            log.warning(
+                                "%s: every trainset member died — abandoning "
+                                "full-model wait for round %s",
+                                node.addr, r,
+                            )
+                            break
         if not got_it:
             log.warning("%s: no aggregated model arrived within timeout", node.addr)
         if check_early_stop(node):

@@ -33,9 +33,14 @@ def set_test_settings() -> None:
     Settings.GOSSIP_MODELS_PERIOD = 0.1
     Settings.GOSSIP_MODELS_PER_ROUND = 4
     Settings.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS = 20
+    Settings.GOSSIP_SEND_RETRIES = 2
+    Settings.GOSSIP_SEND_BACKOFF = 0.05
+    Settings.CHAOS_ENABLED = False  # chaos is opt-in per test/bench scope
     Settings.TRAIN_SET_SIZE = 4
     Settings.VOTE_TIMEOUT = 10.0
     Settings.AGGREGATION_TIMEOUT = 30.0
+    # Well above clean-run fit variance (~1-2s fits), well below the timeout.
+    Settings.AGGREGATION_STALL_PATIENCE = 8.0
     Settings.RESOURCE_MONITOR_PERIOD = 0.5
     Settings.LOG_LEVEL = "DEBUG"
 

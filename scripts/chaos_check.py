@@ -1,0 +1,115 @@
+"""CI gate: 3-node in-memory federation, one node killed mid-round — the
+survivors must finish ALL rounds within a wall-clock budget (i.e. the death
+callbacks unblocked every wait instead of each stage sleeping out its fixed
+timeout). Fast, CPU-only, tier-1-safe — invoked by ``make chaos-check``.
+
+Exit 0 when every check passes; nonzero with a reason on stderr otherwise.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import time  # noqa: E402
+
+ROUNDS = 2
+#: Wall budget for the whole learning run. Generous for a loaded 1-core CI
+#: box, yet far below the worst case of sleeping out the stalled waits
+#: (ROUNDS x (VOTE_TIMEOUT + AGGREGATION_TIMEOUT) = 80s under test settings
+#: plus training time) — a regression to timeout-burning blows through it.
+WALL_BUDGET_S = 75.0
+
+
+def main() -> int:
+    from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+    from p2pfl_tpu.config import Settings
+    from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.node import Node
+    from p2pfl_tpu.telemetry import REGISTRY
+    from p2pfl_tpu.utils.utils import set_test_settings, wait_convergence
+
+    set_test_settings()
+    Settings.RESOURCE_MONITOR_PERIOD = 0
+    Settings.LOG_LEVEL = "WARNING"
+    Settings.TRAIN_SET_SIZE = 3  # full committee: the victim is always a trainer
+    REGISTRY.reset()
+
+    n = 3
+    data = synthetic_mnist(n_train=128 * n, n_test=64)
+    parts = data.generate_partitions(n, RandomIIDPartitionStrategy)
+    nodes = [Node(mlp_model(seed=i), parts[i], batch_size=32) for i in range(n)]
+    for nd in nodes:
+        nd.start()
+    victim, survivors = nodes[2], nodes[:2]
+    try:
+        for i in range(1, n):
+            nodes[i].connect(nodes[0].addr)
+        wait_convergence(nodes, n - 1, wait=15)
+
+        t0 = time.monotonic()
+        nodes[0].set_start_learning(rounds=ROUNDS, epochs=1)
+
+        # Kill the victim mid-round: as soon as round 0 is in flight.
+        deadline = time.time() + 20
+        while time.time() < deadline and nodes[0].state.round is None:
+            time.sleep(0.05)
+        if nodes[0].state.round is None:
+            print("FAIL: learning never started", file=sys.stderr)
+            return 1
+        victim.crash()
+        print(f"killed {victim.addr} mid-round", file=sys.stderr)
+
+        finish_deadline = time.monotonic() + WALL_BUDGET_S
+        while time.monotonic() < finish_deadline:
+            if all(
+                not nd.learning_in_progress() and nd.learning_workflow is not None
+                for nd in survivors
+            ):
+                break
+            time.sleep(0.2)
+        else:
+            print(
+                f"FAIL: survivors did not finish {ROUNDS} rounds within "
+                f"{WALL_BUDGET_S:.0f}s of the kill",
+                file=sys.stderr,
+            )
+            return 1
+        elapsed = time.monotonic() - t0
+
+        for nd in survivors:
+            finished = nd.learning_workflow.history.count("RoundFinishedStage")
+            if finished != ROUNDS:
+                print(
+                    f"FAIL: {nd.addr} finished {finished}/{ROUNDS} rounds",
+                    file=sys.stderr,
+                )
+                return 1
+            if victim.addr in nd.get_neighbors():
+                print(
+                    f"FAIL: {nd.addr} still lists the dead node as a neighbor",
+                    file=sys.stderr,
+                )
+                return 1
+    finally:
+        for nd in nodes:
+            nd.stop()
+        InMemoryRegistry.reset()
+
+    dead = REGISTRY.get("p2pfl_aggregation_dead_contributors_total")
+    dead_total = sum(c.value for _, c in dead.samples()) if dead else 0
+    print(
+        f"chaos-check OK: {len(survivors)} survivors finished {ROUNDS} rounds "
+        f"in {elapsed:.1f}s after 1 mid-round kill "
+        f"(dead-contributor shrinks: {int(dead_total)})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,444 @@
+"""Chaos plane + round-survival hardening tests.
+
+Covers: deterministic fault injection (same seed => same decision stream),
+config fail-fast validation, drop/duplicate/partition behavior through the
+real protocol send path, bounded retry + backoff before write-off,
+death-callback propagation (heartbeat-declared and send-failure), the
+aggregation wait completing via the death callback in well under
+AGGREGATION_TIMEOUT, in-memory transport teardown hygiene, the gossip
+abandon metric, and the dense-frame round-anchor resync for rejoin.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Any
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.chaos import CHAOS, ChaosPlane
+from p2pfl_tpu.comm.commands.command import Command
+from p2pfl_tpu.comm.gossiper import Gossiper
+from p2pfl_tpu.comm.memory.memory_protocol import InMemoryCommunicationProtocol
+from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.exceptions import CommunicationError
+from p2pfl_tpu.learning.aggregators.fedavg import FedAvg
+from p2pfl_tpu.telemetry import REGISTRY
+
+
+class MockCommand(Command):
+    def __init__(self):
+        self.calls = []
+
+    @staticmethod
+    def get_name() -> str:
+        return "mock"
+
+    def execute(self, source: str, round: int, *args: str, **kwargs: Any) -> None:
+        self.calls.append((source, round, args))
+
+
+def _mk(n):
+    protos = [InMemoryCommunicationProtocol() for _ in range(n)]
+    for p in protos:
+        p.start()
+    return protos
+
+
+def _wait(cond, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+# --- the plane itself --------------------------------------------------------
+
+
+def test_chaos_deterministic_same_seed():
+    """Same seed + same intercept sequence => identical decisions AND
+    identical fault counts (the acceptance determinism property)."""
+    p1, p2 = ChaosPlane(), ChaosPlane()
+    pairs = [("a", "b"), ("b", "a"), ("a", "c"), ("c", "a")]
+    with Settings.overridden(
+        CHAOS_ENABLED=True, CHAOS_SEED=7, CHAOS_DROP_RATE=0.25,
+        CHAOS_DUPLICATE_RATE=0.1, CHAOS_DELAY_JITTER_S=0.0,
+    ):
+        d1 = [p1.intercept(s, d) for _ in range(400) for s, d in pairs]
+        d2 = [p2.intercept(s, d) for _ in range(400) for s, d in pairs]
+    assert d1 == d2
+    assert p1.fault_counts() == p2.fault_counts()
+    assert p1.fault_counts().get("drop", 0) > 0  # faults actually fired
+
+
+def test_chaos_different_seed_differs():
+    p1, p2 = ChaosPlane(), ChaosPlane()
+    with Settings.overridden(CHAOS_ENABLED=True, CHAOS_DROP_RATE=0.5):
+        with Settings.overridden(CHAOS_SEED=1):
+            d1 = [p1.intercept("a", "b").drop for _ in range(200)]
+        with Settings.overridden(CHAOS_SEED=2):
+            d2 = [p2.intercept("a", "b").drop for _ in range(200)]
+    assert d1 != d2
+
+
+def test_chaos_inactive_is_clean():
+    p = ChaosPlane()
+    assert not p.active
+    d = p.intercept("a", "b")  # callable even when inactive: clean decision
+    assert not d.drop and d.blocked is None and d.delay_s == 0.0
+
+
+def test_chaos_env_validation_fails_fast():
+    """A typo'd chaos env value must fail at config IMPORT (the
+    WIRE_COMPRESSION pattern), not mid-round in a gossip thread."""
+    for var, bad in (
+        ("P2PFL_TPU_CHAOS_SEED", "not-an-int"),
+        ("P2PFL_TPU_CHAOS_DROP_RATE", "nope"),
+        ("P2PFL_TPU_CHAOS_DROP_RATE", "1.5"),
+        ("P2PFL_TPU_CHAOS_DUPLICATE_RATE", "-0.1"),
+        ("P2PFL_TPU_CHAOS_DELAY_S", "99"),
+    ):
+        env = dict(os.environ)
+        env[var] = bad
+        proc = subprocess.run(
+            [sys.executable, "-c", "import p2pfl_tpu.config"],
+            capture_output=True, text=True, env=env,
+        )
+        assert proc.returncode != 0, (var, bad)
+        assert "ValueError" in proc.stderr and var in proc.stderr, proc.stderr
+
+
+# --- through the real send path ----------------------------------------------
+
+
+def test_drop_injection_loses_message_silently():
+    a, b = _mk(2)
+    cmd = MockCommand()
+    b.add_command(cmd)
+    try:
+        a.connect(b.addr)
+        with CHAOS.overridden(drop_rate=1.0, seed=3):
+            a.send(b.addr, a.build_msg("mock"))  # must NOT raise
+            time.sleep(0.3)
+            assert cmd.calls == []
+            assert CHAOS.fault_counts().get("drop", 0) >= 1
+        # healed: delivery works again
+        a.send(b.addr, a.build_msg("mock", args=["after"]))
+        assert _wait(lambda: cmd.calls)
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_duplicate_injection_is_deduped():
+    """Duplicated control frames must execute exactly once (msg_id dedup)."""
+    a, b = _mk(2)
+    cmd = MockCommand()
+    b.add_command(cmd)
+    try:
+        a.connect(b.addr)
+        with CHAOS.overridden(duplicate_rate=1.0, seed=3):
+            a.send(b.addr, a.build_msg("mock", args=["dup"]))
+            assert _wait(lambda: cmd.calls)
+            time.sleep(0.3)
+            assert len(cmd.calls) == 1
+            assert CHAOS.fault_counts().get("duplicate", 0) >= 1
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_partition_writes_peer_off_and_fires_death_callback():
+    a, b = _mk(2)
+    deaths = []
+    a.on_neighbor_removed(deaths.append)
+    try:
+        a.connect(b.addr)
+        CHAOS.partition([a.addr], [b.addr])
+        try:
+            with pytest.raises(CommunicationError):
+                a.send(b.addr, a.build_msg("mock"), retries=1)
+        finally:
+            CHAOS.reset()
+        assert deaths == [b.addr]
+        assert b.addr not in a.get_neighbors()
+        # heal + reconnect works (the link was never really down)
+        assert a.connect(b.addr)
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_send_retry_succeeds_after_transient_failure():
+    """A transient blip must NOT write the peer off: bounded retry with
+    backoff recovers the send and keeps the neighbor."""
+
+    class Flaky(InMemoryCommunicationProtocol):
+        def __init__(self):
+            self.failures_left = 2
+            super().__init__()
+
+        def _transport_send(self, nei, env):
+            if self.failures_left > 0:
+                self.failures_left -= 1
+                raise CommunicationError("transient blip")
+            super()._transport_send(nei, env)
+
+    a, b = Flaky(), InMemoryCommunicationProtocol()
+    a.start()
+    b.start()
+    cmd = MockCommand()
+    b.add_command(cmd)
+    retries_before = sum(
+        c.value for _, c in REGISTRY.get("p2pfl_send_retries_total").samples()
+    )
+    try:
+        a.connect(b.addr)
+        a.send(b.addr, a.build_msg("mock"), retries=3)
+        assert _wait(lambda: cmd.calls)
+        assert b.addr in a.get_neighbors()  # never written off
+        retries_after = sum(
+            c.value for _, c in REGISTRY.get("p2pfl_send_retries_total").samples()
+        )
+        assert retries_after - retries_before >= 2
+    finally:
+        a.stop()
+        b.stop()
+
+
+# --- round survival ----------------------------------------------------------
+
+
+def test_aggregation_wait_completes_via_death_callback():
+    """ACCEPTANCE (fast, non-slow): with one trainset member dead, the
+    aggregation wait finishes via remove_node in well under the timeout."""
+    from p2pfl_tpu.models import mlp_model
+
+    agg = FedAvg()
+    agg.set_addr("n1")
+    agg.set_nodes_to_aggregate(["n1", "n2", "n3"])
+    m = mlp_model(seed=0, hidden_sizes=(8,))
+    from p2pfl_tpu.models.model_handle import ModelHandle
+
+    agg.add_model(ModelHandle(m.params, m.apply_fn, contributors=["n1"]))
+    agg.add_model(ModelHandle(m.params, m.apply_fn, contributors=["n2"]))
+
+    result = {}
+
+    def waiter():
+        t0 = time.monotonic()
+        result["model"] = agg.wait_and_get_aggregation(timeout=30.0)
+        result["waited"] = time.monotonic() - t0
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    assert t.is_alive()  # still blocked on the missing n3
+    assert agg.remove_node("n3") is True
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert result["waited"] < 5.0, result  # well under the 30s timeout
+    assert sorted(result["model"].get_contributors()) == ["n1", "n2"]
+
+
+def test_aggregator_remove_node_keeps_arrived_contribution():
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.models.model_handle import ModelHandle
+
+    agg = FedAvg()
+    agg.set_nodes_to_aggregate(["n1", "n2"])
+    m = mlp_model(seed=0, hidden_sizes=(8,))
+    agg.add_model(ModelHandle(m.params, m.apply_fn, contributors=["n1"]))
+    # n1 already contributed: its death must not drop the model
+    assert agg.remove_node("n1") is False
+    assert "n1" in agg.get_aggregated_models()
+    # unknown node: no-op
+    assert agg.remove_node("stranger") is False
+
+
+def test_heartbeat_death_during_round_unblocks_survivors():
+    """SATELLITE: heartbeat-declared removal (notify=False) during an active
+    round — a 3-node full-committee federation where one member crashes
+    abruptly after learning starts must still finish all rounds, in well
+    under VOTE_TIMEOUT + AGGREGATION_TIMEOUT."""
+    from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.node import Node
+
+    Settings.RESOURCE_MONITOR_PERIOD = 0
+    n = 3
+    with Settings.overridden(TRAIN_SET_SIZE=3):
+        data = synthetic_mnist(n_train=128 * n, n_test=64)
+        parts = data.generate_partitions(n, RandomIIDPartitionStrategy)
+        nodes = [Node(mlp_model(seed=i), parts[i], batch_size=32) for i in range(n)]
+        for nd in nodes:
+            nd.start()
+        try:
+            for i in range(1, n):
+                nodes[i].connect(nodes[0].addr)
+            from p2pfl_tpu.utils.utils import wait_convergence
+
+            wait_convergence(nodes, n - 1, wait=8)
+            t0 = time.monotonic()
+            nodes[0].set_start_learning(rounds=1, epochs=1)
+            # Crash the victim while round 0 is in flight (vote or train).
+            assert _wait(lambda: nodes[0].state.round == 0, timeout=10.0)
+            victim = nodes[2]
+            victim.crash()
+            survivors = nodes[:2]
+            assert _wait(
+                lambda: all(
+                    not nd.learning_in_progress()
+                    and nd.learning_workflow is not None
+                    for nd in survivors
+                ),
+                timeout=Settings.VOTE_TIMEOUT + Settings.AGGREGATION_TIMEOUT,
+            ), "survivors did not finish the round"
+            elapsed = time.monotonic() - t0
+            # "well under": no stage slept out its full fixed timeout.
+            assert elapsed < Settings.AGGREGATION_TIMEOUT, elapsed
+            for nd in survivors:
+                assert nd.learning_workflow.history.count("RoundFinishedStage") == 1
+            # the victim left the survivors' membership
+            for nd in survivors:
+                assert victim.addr not in nd.get_neighbors()
+        finally:
+            for nd in nodes:
+                nd.stop()
+
+
+# --- in-memory teardown hygiene (satellite) ----------------------------------
+
+
+def test_inmemory_stop_with_handlers_in_flight_leaks_nothing():
+    a, b = _mk(2)
+
+    class Slow(Command):
+        @staticmethod
+        def get_name() -> str:
+            return "slow"
+
+        def execute(self, source, round, *args, **kwargs):
+            time.sleep(0.5)
+
+    b.add_command(Slow())
+    a.connect(b.addr)
+    for _ in range(8):  # more work than the 4 executor workers
+        a.send(b.addr, a.build_msg("slow"))
+    b_addr = b.addr
+    b.stop()  # handlers still in flight
+    a.stop()
+    # registry entry released, address immediately reusable
+    assert InMemoryRegistry.lookup(b_addr) is None
+    fresh = InMemoryCommunicationProtocol(b_addr)
+    fresh.start()
+    fresh.stop()
+    # executor worker threads are gone (bounded join in _server_stop)
+    assert _wait(
+        lambda: not any(
+            t.name.startswith(f"memsrv-{b_addr}") and t.is_alive()
+            for t in threading.enumerate()
+        ),
+        timeout=5.0,
+    ), [t.name for t in threading.enumerate()]
+
+
+def test_inmemory_restart_same_addr_not_unregistered_by_old_instance():
+    """Identity-guarded unregister: the OLD instance's late stop must not
+    tear a restarted node out of the registry."""
+    old = InMemoryCommunicationProtocol()
+    old.start()
+    addr = old.addr
+    old.crash()  # unregisters old
+    fresh = InMemoryCommunicationProtocol(addr)
+    fresh.start()
+    old.stop()  # late stop of the dead instance — must be a no-op
+    try:
+        assert InMemoryRegistry.lookup(addr) is fresh
+    finally:
+        fresh.stop()
+
+
+# --- gossip abandon metric (satellite) ----------------------------------------
+
+
+def test_gossip_abandon_logs_and_counts(caplog):
+    import logging
+
+    sent = []
+    g = Gossiper("mem://abandoner", send_fn=lambda n, e: sent.append(n),
+                 get_direct_neighbors_fn=lambda: [])
+    fam = REGISTRY.get("p2pfl_gossip_abandoned_total")
+    before = sum(c.value for _, c in fam.samples())
+    with Settings.overridden(GOSSIP_EXIT_ON_X_EQUAL_ROUNDS=3):
+        with caplog.at_level(logging.WARNING, logger="p2pfl_tpu"):
+            g.gossip_weights(
+                early_stopping_fn=lambda: False,
+                get_candidates_fn=lambda: ["mem://dead-peer"],
+                status_fn=lambda: "stuck",  # never changes -> stall exit
+                model_fn=lambda nei: None,
+                period=0.01,
+            )
+    after = sum(c.value for _, c in fam.samples())
+    assert after - before == 1
+    assert any("ABANDONED" in r.message for r in caplog.records)
+
+
+# --- rejoin: round-anchor resync ----------------------------------------------
+
+
+def test_dense_full_model_resyncs_round_anchor():
+    """A crashed-and-restarted node that adopts a DENSE full model for round
+    r fast-forwards its delta anchor to r+1, so sparse top-k frames for the
+    next round decode instead of being dropped forever."""
+    from p2pfl_tpu.comm.commands.impl import FullModelCommand
+    from p2pfl_tpu.exceptions import DeltaAnchorError
+    from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.node import Node
+
+    with Settings.overridden(WIRE_COMPRESSION="topk", EXECUTOR_MAX_WORKERS=0):
+        data = synthetic_mnist(n_train=128, n_test=32)
+        parts = data.generate_partitions(1, RandomIIDPartitionStrategy)
+        # The "restarted" node: fresh state, experiment resumed at round 2,
+        # anchor round -1 (it crashed; its codec state is gone).
+        node = Node(mlp_model(seed=0), parts[0], batch_size=32)
+        node.state.set_experiment("rejoin-test", 5)
+        node.state.experiment.round = 2
+        assert node.state.wire.anchor_round == -1
+
+        # An in-phase sender: its anchor for round 3 is the round-2 aggregate.
+        from p2pfl_tpu.comm.delta import DeltaWireCodec
+
+        sender_model = mlp_model(seed=1)
+        sender_model.contributors = ["s"]
+        sender_codec = DeltaWireCodec("sender")
+
+        # 1) restarted node receives the DENSE round-2 full model
+        dense_payload = sender_model.encode_parameters()
+        FullModelCommand(node).execute("sender", 2, weights=dense_payload)
+        assert node.state.last_full_model_round == 2
+        assert node.state.wire.anchor_round == 3  # resynced
+
+        # 2) sender anchors round 3 on the same aggregate and ships sparse
+        sender_codec.set_anchor(sender_model.get_parameters(), 3)
+        perturbed = sender_model.build_copy(
+            params=[np.asarray(p) + 0.01 for p in sender_model.get_parameters()],
+            contributors=["s"], num_samples=1,
+        )
+        sparse = sender_codec.encode_model(perturbed, 3)
+        assert sparse is not None
+        arrays, meta = node.state.wire.decode_frame(sparse)  # must NOT raise
+        assert len(arrays) == len(sender_model.get_parameters())
+
+        # 3) a sparse frame for an UN-anchored round still rejects
+        sender_codec.set_anchor(sender_model.get_parameters(), 7)
+        stale = sender_codec.encode_model(perturbed, 7)
+        with pytest.raises(DeltaAnchorError):
+            node.state.wire.decode_frame(stale)

@@ -1,6 +1,6 @@
 # Convenience targets; everything also runs as the plain commands shown.
 
-.PHONY: test test-fast bench dryrun proto-check api-docs telemetry-check chaos-check
+.PHONY: test test-fast bench dryrun proto-check api-docs telemetry-check chaos-check byzantine-check
 
 test:            ## full suite on the virtual 8-device CPU mesh (~30 min, 1 core)
 	python -m pytest tests/ -q
@@ -22,6 +22,9 @@ telemetry-check: ## 2-node in-memory round; asserts the telemetry snapshot (fast
 
 chaos-check:     ## 3-node round with one mid-round kill; survivors must finish fast (CPU-only)
 	JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/chaos_check.py
+
+byzantine-check: ## 3-node round with one signflip adversary; admission must reject, honest must learn (CPU-only)
+	JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/byzantine_check.py
 
 api-docs:        ## regenerate docs/api.md from the live package
 	PYTHONPATH=. python scripts/gen_api_docs.py

@@ -1574,6 +1574,321 @@ def run_chaos_bench() -> None:
     os._exit(1 if "error" in out else 0)
 
 
+def run_byzantine_bench() -> None:
+    """Subprocess-style mode ``--byzantine``: Byzantine defense acceptance.
+
+    Runs the same in-memory MNIST federation (8 nodes, 2 seeded adversaries
+    by default) over the real Node/gossip/aggregator stack under a
+    model-poisoning attack injected at the chaos plane's send choke point,
+    across six legs:
+
+    * ``clean`` — fault-free FedAvg (the accuracy yardstick),
+    * ``fedavg_attacked`` — FedAvg with wire admission DISABLED: the
+      undefended contrast (must degrade >= 10pp),
+    * ``krum`` / ``trimmed_mean`` / ``geometric_median`` — the same attack
+      against the full defense plane (admission screening + robust rule;
+      must finish every round within the PR 3 stage-wait deadlines and land
+      within 2pp of clean),
+    * ``labelflip_fedavg`` — the DATA-poisoning arm: the same adversary set
+      trains on label-flipped partitions (learning/dataset/poison.py)
+      instead of corrupting frames; reported for the attack-family contrast
+      (low-rate label flip is survivable by plain FedAvg — the reason the
+      wire attack is the headline).
+
+    Also embeds: a per-leg rejection-counter breakdown
+    (``p2pfl_updates_rejected_total`` by reason), a deterministic-replay
+    check (the same seed corrupting the same frame sequence through two
+    fresh chaos planes must produce identical fault counts AND identical
+    corrupted payloads), and an aggregator-only probe (krum_select on a
+    synthetic attacked stack — layer-2 evidence independent of admission).
+
+    Shape overrides: P2PFL_TPU_BYZ_NODES (default 8),
+    P2PFL_TPU_BYZ_ADVERSARIES (2), P2PFL_TPU_BYZ_ROUNDS (3),
+    P2PFL_TPU_BYZ_SEED (42), P2PFL_TPU_BYZ_ATTACK (scaled).
+    """
+    out: dict = {}
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"  # protocol-stack bench: CPU venue
+        import contextlib
+
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+
+        from p2pfl_tpu.chaos import CHAOS, ChaosPlane
+        from p2pfl_tpu.comm.envelope import Envelope
+        from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+        from p2pfl_tpu.config import Settings
+        from p2pfl_tpu.learning.aggregators import (
+            FedAvg,
+            GeometricMedian,
+            MultiKrum,
+            TrimmedMean,
+        )
+        from p2pfl_tpu.learning.dataset import (
+            RandomIIDPartitionStrategy,
+            synthetic_mnist,
+        )
+        from p2pfl_tpu.learning.dataset.poison import poison_partitions, select_poisoned
+        from p2pfl_tpu.models import mlp_model
+        from p2pfl_tpu.node import Node
+        from p2pfl_tpu.telemetry import REGISTRY, TRACER
+        from p2pfl_tpu.utils.utils import set_test_settings, wait_convergence
+
+        n_nodes = int(os.environ.get("P2PFL_TPU_BYZ_NODES", "8"))
+        n_adv = int(os.environ.get("P2PFL_TPU_BYZ_ADVERSARIES", "2"))
+        rounds = int(os.environ.get("P2PFL_TPU_BYZ_ROUNDS", "3"))
+        seed = int(os.environ.get("P2PFL_TPU_BYZ_SEED", "42"))
+        attack = os.environ.get("P2PFL_TPU_BYZ_ATTACK", "scaled")
+        set_test_settings()
+        Settings.RESOURCE_MONITOR_PERIOD = 0
+        Settings.LOG_LEVEL = "WARNING"
+        # Full committee: adversaries are always trainers, so the attack
+        # actually enters every round's aggregation.
+        Settings.TRAIN_SET_SIZE = n_nodes
+
+        adv_idx = set(int(i) for i in select_poisoned(n_nodes, n_adv / n_nodes, seed))
+        assert len(adv_idx) == n_adv, (adv_idx, n_adv)
+
+        wait_deadlines = {
+            "vote_rtt": Settings.VOTE_TIMEOUT + 3.0,
+            "aggregation_wait": Settings.AGGREGATION_TIMEOUT,
+            "full_model_wait": Settings.AGGREGATION_TIMEOUT,
+        }
+
+        def rejected_by_reason() -> dict:
+            fam = REGISTRY.get("p2pfl_updates_rejected_total")
+            agg: dict = {}
+            if fam is not None:
+                for labels, child in fam.samples():
+                    r = labels.get("reason", "?")
+                    agg[r] = agg.get(r, 0) + int(child.value)
+            return agg
+
+        def run_leg(
+            label: str,
+            make_aggregator,
+            *,
+            wire_attack: bool = False,
+            admission: bool = True,
+            labelflip: bool = False,
+        ) -> dict:
+            REGISTRY.reset()
+            TRACER.reset()
+            CHAOS.reset()
+            _phase(f"byzantine leg {label}: attack={attack if wire_attack else ('labelflip' if labelflip else 'none')}, admission={admission}")
+            data = synthetic_mnist(n_train=256 * n_nodes, n_test=256)
+            parts = data.generate_partitions(n_nodes, RandomIIDPartitionStrategy)
+            if labelflip:
+                parts, poisoned = poison_partitions(
+                    parts, n_adv / n_nodes, num_classes=10, seed=seed
+                )
+                assert set(int(i) for i in poisoned) == adv_idx
+            nodes = [
+                Node(mlp_model(seed=i), parts[i], batch_size=32,
+                     aggregator=make_aggregator())
+                for i in range(n_nodes)
+            ]
+            honest = [nd for i, nd in enumerate(nodes) if i not in adv_idx]
+            # The experiment is launched from an HONEST node: the initiator's
+            # init_model weights seed round 0 for the whole federation, and
+            # the protocol must trust the operator who starts the experiment
+            # (a Byzantine initiator's scaled init is screened out by
+            # admission — screen_init — which would correctly leave peers
+            # unseeded rather than poisoned, stalling round 0 by design).
+            initiator = honest[0]
+            scope = (
+                CHAOS.overridden(seed=seed) if wire_attack else contextlib.nullcontext()
+            )
+            faults: dict = {}
+            with Settings.overridden(ADMISSION_ENABLED=admission):
+                with scope:
+                    if wire_attack:
+                        for i in adv_idx:
+                            CHAOS.set_byzantine(nodes[i].addr, attack)
+                    for nd in nodes:
+                        nd.start()
+                    try:
+                        for i in range(1, n_nodes):
+                            nodes[i].connect(nodes[0].addr)
+                        wait_convergence(nodes, n_nodes - 1, wait=30)
+                        t0 = time.monotonic()
+                        initiator.set_start_learning(rounds=rounds, epochs=1)
+                        deadline = time.time() + 900
+                        while time.time() < deadline:
+                            if all(
+                                not nd.learning_in_progress()
+                                and nd.learning_workflow is not None
+                                for nd in nodes
+                            ):
+                                break
+                            time.sleep(0.25)
+                        else:
+                            raise TimeoutError(f"{label} federation did not finish")
+                        wall_s = time.monotonic() - t0
+                        faults = CHAOS.fault_counts()
+                        incomplete = {
+                            nd.addr: nd.learning_workflow.history.count(
+                                "RoundFinishedStage"
+                            )
+                            for nd in honest
+                            if nd.learning_workflow.history.count("RoundFinishedStage")
+                            != rounds
+                        }
+                        if incomplete:
+                            raise AssertionError(
+                                f"{label}: honest nodes did not complete all "
+                                f"{rounds} rounds: {incomplete}"
+                            )
+                        accs = [
+                            nd.learner.evaluate().get("test_acc", 0.0)
+                            for nd in honest
+                        ]
+                        wait_max = {name: 0.0 for name in wait_deadlines}
+                        for s in TRACER.spans():
+                            if s.name in wait_max:
+                                wait_max[s.name] = max(wait_max[s.name], s.dur_s)
+                        over = {
+                            name: (m, wait_deadlines[name])
+                            for name, m in wait_max.items()
+                            if m >= wait_deadlines[name]
+                        }
+                        if over:
+                            raise AssertionError(
+                                f"{label}: stage wait exceeded its deadline: {over}"
+                            )
+                        rej = rejected_by_reason()
+                    finally:
+                        for nd in nodes:
+                            nd.stop()
+                        InMemoryRegistry.reset()
+            leg = {
+                "wall_s": round(wall_s, 2),
+                "final_test_acc_mean": round(sum(accs) / len(accs), 4),
+                "final_test_acc_min": round(min(accs), 4),
+                "rejected_by_reason": rej,
+                "rejected_total": sum(rej.values()),
+                "injected_faults": faults,
+                "max_wait_s": {k: round(v, 3) for k, v in wait_max.items()},
+            }
+            _phase(f"byzantine leg {label} done: {json.dumps(leg)}")
+            return leg
+
+        legs = {
+            "clean": run_leg("clean", FedAvg),
+            "fedavg_attacked": run_leg(
+                "fedavg_attacked", FedAvg, wire_attack=True, admission=False
+            ),
+            "krum": run_leg(
+                "krum", lambda: MultiKrum(num_byzantine=n_adv), wire_attack=True
+            ),
+            "trimmed_mean": run_leg(
+                "trimmed_mean",
+                lambda: TrimmedMean(trim_ratio=n_adv / n_nodes),
+                wire_attack=True,
+            ),
+            "geometric_median": run_leg(
+                "geometric_median", GeometricMedian, wire_attack=True
+            ),
+            "labelflip_fedavg": run_leg("labelflip_fedavg", FedAvg, labelflip=True),
+        }
+
+        clean_acc = legs["clean"]["final_test_acc_mean"]
+        degradation_pp = round(
+            100.0 * (clean_acc - legs["fedavg_attacked"]["final_test_acc_mean"]), 2
+        )
+        if degradation_pp < 10.0:
+            raise AssertionError(
+                f"undefended FedAvg only degraded {degradation_pp}pp under the "
+                f"{attack} attack (need >= 10pp for a meaningful contrast)"
+            )
+        for name in ("krum", "trimmed_mean", "geometric_median"):
+            delta_pp = round(
+                100.0 * (clean_acc - legs[name]["final_test_acc_mean"]), 2
+            )
+            legs[name]["acc_delta_vs_clean_pp"] = delta_pp
+            if delta_pp > 2.0:
+                raise AssertionError(
+                    f"{name} degraded {delta_pp}pp > 2pp under the defended run"
+                )
+            if legs[name]["rejected_total"] == 0:
+                raise AssertionError(
+                    f"{name}: admission rejected nothing — the attack never "
+                    "hit the screen"
+                )
+
+        # Deterministic corruption replay: same seed + same frame sequence
+        # through two fresh planes => identical fault counts AND payloads.
+        frame = mlp_model(seed=0).encode_parameters()
+        replays = []
+        for _ in range(2):
+            plane = ChaosPlane()
+            with Settings.overridden(CHAOS_ENABLED=True, CHAOS_SEED=seed):
+                plane.set_byzantine("adv", attack)
+                payloads = []
+                for k in range(50):
+                    env = Envelope.weights("adv", "partial_model", k, frame, ["adv"], 1)
+                    payloads.append(plane.corrupt_weights("adv", env).payload)
+            replays.append((plane.fault_counts(), payloads))
+        if replays[0] != replays[1]:
+            raise AssertionError("byzantine corruption is not deterministic")
+
+        # Aggregator-only probe: Krum's distance filter must exclude the
+        # attackers even with admission out of the picture.
+        from p2pfl_tpu.ops import aggregation as agg_ops
+
+        probe_model = mlp_model(seed=0, hidden_sizes=(16,))
+        base = probe_model.get_parameters()
+        stack = agg_ops.tree_stack(
+            [[p + 0.01 * i for p in base] for i in range(n_nodes - n_adv)]
+            + [
+                [-10.0 * p if attack in ("signflip", "scaled") else p for p in base]
+                for _ in range(n_adv)
+            ]
+        )
+        sel = agg_ops.krum_select(
+            stack, num_byzantine=n_adv, num_selected=n_nodes - n_adv - 2
+        )
+        attacker_rows = set(range(n_nodes - n_adv, n_nodes))
+        krum_excludes_attackers = not (set(int(i) for i in np.asarray(sel)) & attacker_rows)
+        if not krum_excludes_attackers:
+            raise AssertionError(f"krum_select picked an attacker row: {sel}")
+
+        out = {
+            "metric": f"byzantine_defense_{n_nodes}node_mnist",
+            "value": degradation_pp,
+            "unit": "pp_fedavg_degradation_undefended",
+            "vs_baseline": None,
+            "extra": {
+                "nodes": n_nodes,
+                "adversaries": n_adv,
+                "adversary_indices": sorted(adv_idx),
+                "attack": attack,
+                "rounds": rounds,
+                "seed": seed,
+                "legs": legs,
+                "defended_rules": {
+                    "krum": f"MultiKrum(f={n_adv}, m=n-f-2)",
+                    "trimmed_mean": f"TrimmedMean(trim_ratio={n_adv / n_nodes})",
+                    "geometric_median": "GeometricMedian(iters=8)",
+                },
+                "deterministic_replay_counts": replays[0][0],
+                "krum_select_excludes_attackers": krum_excludes_attackers,
+                "wait_deadlines_s": wait_deadlines,
+                "note": "defended legs run admission screening + robust "
+                "aggregation; fedavg_attacked runs with admission disabled "
+                "(the undefended contrast); labelflip_fedavg is the "
+                "data-poisoning arm (poison.py flip_labels)",
+            },
+        }
+    except Exception as e:  # noqa: BLE001
+        traceback.print_exc(file=sys.stderr)
+        out["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out), flush=True)
+    os._exit(1 if "error" in out else 0)
+
+
 def run_telemetry_bench() -> None:
     """Subprocess-style mode ``--telemetry``: run an 8-node in-memory MNIST
     federation (sparse delta wire path, so codec metrics engage) with the
@@ -2197,6 +2512,8 @@ if __name__ == "__main__":
         run_telemetry_bench()
     elif "--chaos" in sys.argv:
         run_chaos_bench()
+    elif "--byzantine" in sys.argv:
+        run_byzantine_bench()
     elif "--attn" in sys.argv:
         run_attn_bench()
     elif "--lm-mfu" in sys.argv:

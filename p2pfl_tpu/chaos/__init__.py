@@ -1,7 +1,12 @@
 """Chaos/fault-injection plane: deterministic message drop, delay, duplication,
-partitions and crash simulation on the transport send path (see
-:mod:`p2pfl_tpu.chaos.plane`)."""
+partitions, crash simulation and Byzantine peer behaviors on the transport
+send path (see :mod:`p2pfl_tpu.chaos.plane`)."""
 
-from p2pfl_tpu.chaos.plane import CHAOS, ChaosPlane, Decision  # noqa: F401
+from p2pfl_tpu.chaos.plane import (  # noqa: F401
+    BYZANTINE_ATTACKS,
+    CHAOS,
+    ChaosPlane,
+    Decision,
+)
 
-__all__ = ["CHAOS", "ChaosPlane", "Decision"]
+__all__ = ["BYZANTINE_ATTACKS", "CHAOS", "ChaosPlane", "Decision"]

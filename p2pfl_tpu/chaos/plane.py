@@ -12,7 +12,17 @@ seeded, per-peer-pair fault rules:
 * **duplicate** — the frame is delivered twice (dedup/idempotency probes),
 * **partition** — sends across declared groups fail like a dead link,
 * **crash** — all sends to/from an address fail (an unreachable-but-alive
-  node; for a *real* mid-round process death use :meth:`Node.crash`).
+  node; for a *real* mid-round process death use :meth:`Node.crash`),
+* **byzantine** — a peer turns adversarial on the MODEL plane: every
+  weights frame it sends is corrupted at the send choke point
+  (:meth:`set_byzantine`): ``signflip`` negates the float tensors,
+  ``scaled`` multiplies them (default x10), ``nan`` replaces them with NaN
+  garbage, and ``inflate`` blows up the unauthenticated ``num_samples``
+  claim. Control frames (votes, heartbeats) stay honest — the adversary
+  participates in the protocol while poisoning the learning, the standard
+  model-poisoning threat model (Blanchard et al. 2017). Corruption is a
+  pure function of the frame (no RNG draws), so it composes with the
+  deterministic per-pair decision streams without desyncing them.
 
 Determinism: every (src, dst) pair owns a ``random.Random`` seeded from
 ``(Settings.CHAOS_SEED, src, dst)``, and every probabilistic intercept draws
@@ -36,10 +46,14 @@ import logging
 import random
 import threading
 from dataclasses import dataclass
-from typing import Dict, Iterator, Optional, Sequence, Set, Tuple
+from dataclasses import replace as _dc_replace
+from typing import TYPE_CHECKING, Dict, Iterator, Optional, Sequence, Set, Tuple
 
 from p2pfl_tpu.config import Settings
 from p2pfl_tpu.telemetry import REGISTRY
+
+if TYPE_CHECKING:  # pragma: no cover
+    from p2pfl_tpu.comm.envelope import Envelope
 
 log = logging.getLogger("p2pfl_tpu")
 
@@ -66,6 +80,16 @@ class Decision:
 
 _CLEAN = Decision()
 
+#: Supported Byzantine peer behaviors (model-plane frame corruption).
+BYZANTINE_ATTACKS = ("signflip", "scaled", "nan", "inflate")
+
+
+@dataclass(frozen=True)
+class _Byzantine:
+    attack: str
+    scale: float = 10.0
+    inflate_factor: int = 1_000_000_000
+
 
 class ChaosPlane:
     """Process-wide fault injector (one instance, :data:`CHAOS`, serves every
@@ -78,6 +102,7 @@ class ChaosPlane:
         self._groups: Dict[str, int] = {}  # addr -> partition group id
         self._crashed: Set[str] = set()
         self._slow: Dict[str, float] = {}  # addr -> extra delay per send
+        self._byzantine: Dict[str, _Byzantine] = {}  # addr -> attack config
 
     # --- activation ---------------------------------------------------------
 
@@ -86,7 +111,11 @@ class ChaosPlane:
         """True when any fault rule could fire. The send hot path checks this
         first, so a chaos-free federation pays two attribute reads."""
         return bool(
-            Settings.CHAOS_ENABLED or self._groups or self._crashed or self._slow
+            Settings.CHAOS_ENABLED
+            or self._groups
+            or self._crashed
+            or self._slow
+            or self._byzantine
         )
 
     # --- scenario controls (plane-level state, not Settings) ----------------
@@ -112,6 +141,38 @@ class ChaosPlane:
         with self._lock:
             self._crashed.discard(addr)
 
+    def set_byzantine(
+        self,
+        addr: str,
+        attack: str,
+        *,
+        scale: float = 10.0,
+        inflate_factor: int = 1_000_000_000,
+    ) -> None:
+        """Turn ``addr`` into a model-poisoning adversary: every weights
+        frame it sends is corrupted per ``attack`` (one of
+        :data:`BYZANTINE_ATTACKS`). ``scale`` parameterizes the ``scaled``
+        attack; ``inflate_factor`` the ``num_samples`` inflation."""
+        if attack not in BYZANTINE_ATTACKS:
+            raise ValueError(
+                f"attack must be one of {BYZANTINE_ATTACKS}, got {attack!r}"
+            )
+        with self._lock:
+            self._byzantine[addr] = _Byzantine(attack, float(scale), int(inflate_factor))
+        log.warning("chaos: %s turned byzantine (attack=%s)", addr, attack)
+
+    def clear_byzantine(self, addr: Optional[str] = None) -> None:
+        with self._lock:
+            if addr is None:
+                self._byzantine.clear()
+            else:
+                self._byzantine.pop(addr, None)
+
+    def byzantine_peers(self) -> Dict[str, str]:
+        """{addr: attack} view of the current adversary set."""
+        with self._lock:
+            return {a: b.attack for a, b in self._byzantine.items()}
+
     def set_slow(self, addr: str, extra_delay_s: float) -> None:
         """Straggler: every send involving ``addr`` stalls ``extra_delay_s``."""
         with self._lock:
@@ -129,6 +190,7 @@ class ChaosPlane:
             self._groups = {}
             self._crashed.clear()
             self._slow.clear()
+            self._byzantine.clear()
 
     # --- accounting ---------------------------------------------------------
 
@@ -182,6 +244,67 @@ class ChaosPlane:
             if duplicates:
                 self._count(src, "duplicate")
             return Decision(delay_s=delay, duplicates=duplicates)
+
+    # --- byzantine corruption (model plane) ---------------------------------
+
+    def corrupt_weights(self, src: str, env: "Envelope") -> "Envelope":
+        """Apply ``src``'s Byzantine behavior to an outbound weights
+        envelope (identity when ``src`` is honest or the frame is control
+        plane). Called by the shared send choke point
+        (:meth:`CommunicationProtocol.send`); returns a NEW envelope, so
+        broadcast fan-out reusing the original is unaffected.
+
+        Deterministic: corruption is a pure function of (payload, attack),
+        draws no randomness, and therefore never desyncs the per-pair
+        decision streams. Every corrupted frame is counted as
+        ``byzantine_<attack>`` in the fault table and the registry.
+        """
+        with self._lock:
+            byz = self._byzantine.get(src)
+        if byz is None or not env.is_weights:
+            return env
+        try:
+            corrupted = self._corrupt(env, byz)
+        except Exception:  # noqa: BLE001 — chaos must not take down the send path
+            log.exception("chaos: byzantine corruption of a frame from %s failed", src)
+            return env
+        with self._lock:
+            self._count(src, f"byzantine_{byz.attack}")
+        return corrupted
+
+    @staticmethod
+    def _corrupt(env: "Envelope", byz: _Byzantine) -> "Envelope":
+        import numpy as np
+
+        from p2pfl_tpu.ops.serialization import deserialize_arrays, serialize_arrays
+
+        if byz.attack == "inflate":
+            # The num_samples claim rides the envelope, not the payload.
+            return _dc_replace(
+                env, num_samples=max(1, int(env.num_samples)) * byz.inflate_factor
+            )
+
+        def floatlike(dt: np.dtype) -> bool:
+            return (
+                np.issubdtype(dt, np.floating)
+                or dt.name == "bfloat16"
+                or dt.name.startswith("float8")
+            )
+
+        arrays, meta = deserialize_arrays(bytes(env.payload))
+        out = []
+        for a in arrays:
+            a = np.asarray(a)
+            if not floatlike(a.dtype):
+                out.append(a)  # sparse index tensors etc. stay intact
+                continue
+            if byz.attack == "signflip":
+                out.append(-a)
+            elif byz.attack == "scaled":
+                out.append((a.astype(np.float32) * byz.scale).astype(a.dtype))
+            else:  # "nan"
+                out.append(np.full_like(a, np.nan))
+        return _dc_replace(env, payload=serialize_arrays(out, meta))
 
     # --- scoped configuration ----------------------------------------------
 

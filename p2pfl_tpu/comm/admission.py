@@ -1,0 +1,256 @@
+"""Wire-path admission control: screen inbound model frames before they
+touch the aggregator or the local model.
+
+The federation wire path used to accept any decodable frame: a Byzantine
+peer could ship a wrong-shaped tree, NaN/Inf payloads, or an
+arbitrarily-scaled update and it would flow straight into
+``aggregator.add_model`` / ``apply_frame`` (production FL systems treat
+inbound-update validation as a first-class plane — Papaya, arxiv
+2111.04877; APPFL, arxiv 2409.11585). This module is the screening step
+between ``decode_frame`` and those sinks, applied AFTER sparse-delta
+reconstruction so a poisoned top-k frame is judged by the dense model it
+reconstructs to and can never corrupt the round anchor or residuals.
+
+Checks, in order (first failure wins; every rejection is counted into
+``p2pfl_updates_rejected_total{node, reason}``):
+
+* ``corrupt`` — the frame did not decode at all (counted by the command
+  handlers via :meth:`AdmissionController.record`, not here);
+* ``tree`` — leaf count differs from the local model spec;
+* ``shape`` — some leaf's shape differs;
+* ``dtype`` — some leaf's float/non-float class differs (exact-width
+  mismatches within a class are admitted: the wire codecs legitimately
+  deliver e.g. float32 for bfloat16 leaves and ``set_parameters`` casts);
+* ``nonfinite`` — any NaN/Inf in a float leaf;
+* ``norm`` — the update norm ``||recv - local||`` exceeds the adaptive
+  bound: ``median(recently admitted norms) * Settings.ADMISSION_NORM_MULT``
+  once enough history exists, else the local model's own norm (an "update"
+  as large as the whole model is not an update — the same norm-bounding
+  idea as the mesh path's ``clip_update_norm``, Sun et al. 2019, applied
+  as an accept/reject gate at the wire boundary).
+
+The norm bound applies to PARTIAL models only (the path where Byzantine
+mass enters aggregation). Full-model adoption is screened structurally and
+for finiteness but not by norm: a crashed-and-rejoined node must be able
+to adopt an aggregate arbitrarily far from its stale weights (the PR 3
+anchor-resync path), so distance-from-local is not a meaningful signal
+there.
+
+``num_samples`` arrives unauthenticated on the same frames;
+:meth:`AdmissionController.clamp_num_samples` caps it at
+``Settings.MAX_CLAIMED_SAMPLES`` so a single peer cannot dominate FedAvg's
+sample weighting (the inflation attack GeometricMedian's unit weights
+already neutralize — robust.py docstring).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from typing import Any, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.telemetry import REGISTRY
+
+log = logging.getLogger("p2pfl_tpu")
+
+_REJECTED = REGISTRY.counter(
+    "p2pfl_updates_rejected_total",
+    "Inbound model-plane frames rejected by wire admission control, by reason",
+    labels=("node", "reason"),
+)
+_CLAMPED = REGISTRY.counter(
+    "p2pfl_claimed_samples_clamped_total",
+    "Wire-supplied num_samples claims clamped to MAX_CLAIMED_SAMPLES",
+    labels=("node",),
+)
+
+#: Admitted-norm history entries required before the adaptive bound engages
+#: (below this the bootstrap bound — the local model's own norm — applies).
+MIN_NORM_HISTORY = 4
+
+#: Init frames: reject when the received WEIGHT norm exceeds this multiple
+#: of the local (fresh-init) weight norm. Both sides initialize the same
+#: architecture, so honest inits sit near ratio 1; a x10-scaled init is ~10.
+INIT_NORM_MULT = 4.0
+
+
+def _is_floatlike(dt: np.dtype) -> bool:
+    """Float class check that also covers ml_dtypes (bfloat16 reports numpy
+    kind 'V', so ``np.issubdtype`` alone misses it)."""
+    return (
+        np.issubdtype(dt, np.floating)
+        or dt.name == "bfloat16"
+        or dt.name.startswith("float8")
+    )
+
+
+class AdmissionController:
+    """Per-node screening state (held on :class:`~p2pfl_tpu.node_state.
+    NodeState` like the delta codec). Thread-safe: screening runs on
+    transport threads."""
+
+    def __init__(self, addr: str = "unknown-node") -> None:
+        self._addr = addr
+        self._lock = threading.Lock()
+        self._norms: deque = deque(maxlen=Settings.ADMISSION_NORM_WINDOW)
+        # (source, reason) pairs already warned about — repeats drop to
+        # debug so a gossip loop re-shipping a rejected frame every 100ms
+        # cannot flood the log.
+        self._warned: Set[Tuple[str, str]] = set()
+
+    # --- accounting ----------------------------------------------------------
+
+    def record(self, reason: str, source: str = "?", cmd: str = "?") -> str:
+        """Count (and log) one rejection; returns ``reason`` so handlers can
+        ``return admission.record(...)``-style early-exit."""
+        _REJECTED.labels(self._addr, reason).inc()
+        key = (source, reason)
+        msg = "(%s) rejected %s frame from %s: reason=%s"
+        if key in self._warned:
+            log.debug(msg, self._addr, cmd, source, reason)
+        else:
+            self._warned.add(key)
+            log.warning(msg, self._addr, cmd, source, reason)
+        return reason
+
+    def rejected_count(self, reason: Optional[str] = None) -> int:
+        fam = REGISTRY.get("p2pfl_updates_rejected_total")
+        if fam is None:
+            return 0
+        total = 0
+        for labels, child in fam.samples():
+            if labels.get("node") != self._addr:
+                continue
+            if reason is not None and labels.get("reason") != reason:
+                continue
+            total += int(child.value)
+        return total
+
+    # --- the screen -----------------------------------------------------------
+
+    def screen(
+        self,
+        arrays: Sequence[np.ndarray],
+        local_model: Any,
+        *,
+        source: str = "?",
+        cmd: str = "?",
+        check_norm: bool = True,
+    ) -> Optional[str]:
+        """Validate decoded ``arrays`` against ``local_model``'s spec.
+
+        Returns ``None`` when the frame is admitted (and, with
+        ``check_norm``, records its update norm into the adaptive-bound
+        history), else the rejection reason (already counted/logged).
+        """
+        if not Settings.ADMISSION_ENABLED:
+            return None
+        local: List[np.ndarray] = local_model.get_parameters()
+        if len(arrays) != len(local):
+            return self.record("tree", source, cmd)
+        for recv, mine in zip(arrays, local):
+            recv = np.asarray(recv)
+            if tuple(recv.shape) != tuple(mine.shape):
+                return self.record("shape", source, cmd)
+            if _is_floatlike(recv.dtype) != _is_floatlike(mine.dtype):
+                return self.record("dtype", source, cmd)
+        # Finiteness + norm in one float32 pass over the float leaves.
+        sq_dist = 0.0
+        sq_local = 0.0
+        for recv, mine in zip(arrays, local):
+            recv = np.asarray(recv)
+            if not _is_floatlike(recv.dtype):
+                continue
+            r32 = recv.astype(np.float32, copy=False)
+            if not np.isfinite(r32).all():
+                return self.record("nonfinite", source, cmd)
+            m32 = mine.astype(np.float32, copy=False)
+            d = (r32 - m32).ravel()
+            sq_dist += float(np.dot(d, d))
+            m = m32.ravel()
+            sq_local += float(np.dot(m, m))
+        if not check_norm:
+            return None
+        norm = float(np.sqrt(sq_dist))
+        with self._lock:
+            if len(self._norms) >= MIN_NORM_HISTORY:
+                bound = float(np.median(self._norms)) * Settings.ADMISSION_NORM_MULT
+            else:
+                # Bootstrap: before history exists, an update at least as
+                # large as the entire local model is rejected outright.
+                bound = float(np.sqrt(sq_local))
+            if norm > bound:
+                pass  # reject outside the lock (record logs)
+            else:
+                self._norms.append(norm)
+                return None
+        log.debug(
+            "(%s) update norm %.3f exceeds bound %.3f (history=%d)",
+            self._addr, norm, bound, len(self._norms),
+        )
+        return self.record("norm", source, cmd)
+
+    def screen_init(
+        self,
+        arrays: Sequence[np.ndarray],
+        local_model: Any,
+        *,
+        source: str = "?",
+    ) -> Optional[str]:
+        """Screen an init-model frame: structure + finiteness, plus an
+        init-scale sanity bound on the WEIGHT norm (not the update norm —
+        there is no meaningful "update" before round 0). Both sides hold a
+        fresh init of the same architecture, so ``||recv||`` should be
+        comparable to ``||local||``; a scaled init (x10 weights from a
+        Byzantine initiator) is ~10x out and rejected as ``init_norm``.
+        Sign-preserving attacks (e.g. signflip) pass — a negated init is
+        still a valid-scale init, which is exactly why init frames are the
+        one place the protocol must trust the experiment operator."""
+        reason = self.screen(
+            arrays, local_model, source=source, cmd="init_model", check_norm=False
+        )
+        if reason is not None or not Settings.ADMISSION_ENABLED:
+            return reason
+        sq_recv = 0.0
+        sq_local = 0.0
+        for recv, mine in zip(arrays, local_model.get_parameters()):
+            recv = np.asarray(recv)
+            if not _is_floatlike(recv.dtype):
+                continue
+            r = recv.astype(np.float32, copy=False).ravel()
+            m = mine.astype(np.float32, copy=False).ravel()
+            sq_recv += float(np.dot(r, r))
+            sq_local += float(np.dot(m, m))
+        local_norm = float(np.sqrt(sq_local))
+        if local_norm < 1e-6:  # zero-init local model: nothing to compare to
+            return None
+        if float(np.sqrt(sq_recv)) > INIT_NORM_MULT * local_norm:
+            return self.record("init_norm", source, "init_model")
+        return None
+
+    # --- num_samples clamp ----------------------------------------------------
+
+    def clamp_num_samples(self, claimed: int, source: str = "?") -> int:
+        """Cap the unauthenticated wire claim at ``MAX_CLAIMED_SAMPLES``."""
+        claimed = int(claimed)
+        cap = Settings.MAX_CLAIMED_SAMPLES
+        if claimed <= cap:
+            return max(claimed, 0)
+        _CLAMPED.labels(self._addr).inc()
+        key = (source, "samples")
+        if key not in self._warned:
+            self._warned.add(key)
+            log.warning(
+                "(%s) %s claims %d samples — clamped to MAX_CLAIMED_SAMPLES=%d",
+                self._addr, source, claimed, cap,
+            )
+        return cap
+
+    def reset(self) -> None:
+        with self._lock:
+            self._norms.clear()
+            self._warned.clear()

@@ -160,12 +160,27 @@ class InitModelCommand(Command):
         return "init_model"
 
     def execute(self, source: str, round: int, *args: str, **kwargs: Any) -> None:
+        from p2pfl_tpu.models.model_handle import decode_wire_frame
+
         state = self._node.state
         if state.model_initialized_event.is_set():
             return
         weights: bytes = kwargs["weights"]
         try:
-            self._node.learner.get_model().set_parameters(weights)
+            arrays, meta = decode_wire_frame(weights)
+        except Exception as exc:  # corrupt/truncated init frame
+            log.debug("init_model from %s undecodable: %s", source, exc)
+            state.admission.record("corrupt", source, "init_model")
+            return
+        # Round-0 weights define every peer's starting point — a poisoned
+        # init outlives any later defense, so screen structure/finiteness
+        # plus the init-scale weight-norm sanity bound here.
+        if state.admission.screen_init(
+            arrays, self._node.learner.get_model(), source=source
+        ):
+            return
+        try:
+            self._node.learner.get_model().apply_frame(arrays, meta)
             state.model_initialized_event.set()
             self._node.protocol.broadcast(
                 self._node.protocol.build_msg(ModelInitializedCommand.get_name())
@@ -195,7 +210,10 @@ class PartialModelCommand(Command):
             return
         weights: bytes = kwargs["weights"]
         contributors: List[str] = list(kwargs.get("contributors", []))
-        num_samples: int = int(kwargs.get("num_samples", 1))
+        # Clamp the unauthenticated wire claim before it can weight FedAvg.
+        num_samples: int = state.admission.clamp_num_samples(
+            int(kwargs.get("num_samples", 1)), source
+        )
         try:
             # Frames decode through the node's delta codec: dense frames pass
             # straight through; sparse top-k deltas reconstruct against this
@@ -204,6 +222,20 @@ class PartialModelCommand(Command):
         except DeltaAnchorError as exc:
             # Out of phase, not corrupt: drop it, the gossip loop re-ships.
             log.debug("partial model from %s dropped: %s", source, exc)
+            return
+        except Exception as exc:  # corrupt/truncated frame: reject, don't raise
+            # Decode failures used to escape onto the transport thread; a
+            # Byzantine (or bit-flipped) frame must be a counted rejection,
+            # not an exception storm.
+            log.debug("partial model from %s undecodable: %s", source, exc)
+            state.admission.record("corrupt", source, "partial_model")
+            return
+        # Admission control: screen the RECONSTRUCTED arrays (post sparse-
+        # delta decode) against the local model spec + adaptive norm bound
+        # before anything reaches the aggregator.
+        if state.admission.screen(
+            arrays, node.learner.get_model(), source=source, cmd="partial_model"
+        ):
             return
         # Trace context: the envelope slot (in-memory) is already attached by
         # handle_envelope; the PFLT header slot covers gRPC weights frames.
@@ -241,8 +273,23 @@ class FullModelCommand(Command):
             return
         if round < state.round:
             return
+        if round <= state.last_full_model_round:
+            # Redundant re-delivery: we already hold this round's full model
+            # (adopted from the wire, or our own aggregate — TrainStage marks
+            # it). FIRST WINS: never re-apply — a later frame for the same
+            # round can legitimately differ (aggregation-order epsilon) or
+            # maliciously differ (a Byzantine peer overwriting the honest
+            # aggregate in the post-aggregation window), and we have no basis
+            # to prefer it. The sender keeps gossiping because it never saw
+            # our round progress — our fire-once models_ready broadcast was
+            # probably lost. Re-announce so the sender's candidate set
+            # shrinks instead of it re-shipping full models until its stall
+            # exit trips (ack repair under message loss).
+            node.protocol.broadcast(
+                node.protocol.build_msg(ModelsReadyCommand.get_name(), round=round)
+            )
+            return
         weights: bytes = kwargs["weights"]
-        already_adopted = round <= state.last_full_model_round
         try:
             try:
                 arrays, meta = state.wire.decode_frame(weights)
@@ -251,6 +298,20 @@ class FullModelCommand(Command):
                 # lead the sender) — drop; the sender's gossip loop retries
                 # and falls back to a dense frame for out-of-round peers.
                 log.debug("full model from %s dropped: %s", source, exc)
+                return
+            except Exception as exc:  # corrupt/truncated frame
+                log.debug("full model from %s undecodable: %s", source, exc)
+                state.admission.record("corrupt", source, "full_model")
+                return
+            # Structure + finiteness screening BEFORE adoption and before the
+            # anchor resync below, so a poisoned frame can never become the
+            # next round's delta anchor. No norm bound here: a rejoining node
+            # must be able to adopt an aggregate arbitrarily far from its
+            # stale local weights (admission.py module docstring).
+            if state.admission.screen(
+                arrays, node.learner.get_model(),
+                source=source, cmd="full_model", check_norm=False,
+            ):
                 return
             wire_ctx = meta.get(tracing.TRACE_META_KEY, "") or tracing.current_wire()
             with TRACER.recv_span(
@@ -272,16 +333,5 @@ class FullModelCommand(Command):
                         node.learner.get_model().get_parameters(), round + 1
                     )
                 state.aggregated_model_event.set()
-            if already_adopted:
-                # Redundant re-delivery: the sender keeps gossiping because it
-                # never saw our round progress — our fire-once models_ready
-                # broadcast was probably lost. Re-announce so the sender's
-                # candidate set shrinks instead of it re-shipping full models
-                # until its stall exit trips (ack repair under message loss).
-                node.protocol.broadcast(
-                    node.protocol.build_msg(
-                        ModelsReadyCommand.get_name(), round=round
-                    )
-                )
         except Exception:
             log.exception("full_model from %s failed", source)

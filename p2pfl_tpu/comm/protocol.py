@@ -239,6 +239,12 @@ class CommunicationProtocol:
             else:
                 return
         attempts = 1 + max(0, int(retries))
+        if CHAOS.active and env.is_weights:
+            # Byzantine peer behavior (chaos plane): a node marked adversarial
+            # poisons every model-plane frame it sends — corrupted ONCE per
+            # send call, before the retry loop, so retries re-ship the same
+            # (corrupted) frame like a real adversary would.
+            env = CHAOS.corrupt_weights(self._addr, env)
         for attempt in range(attempts):
             try:
                 if CHAOS.active:

@@ -108,6 +108,23 @@ class Settings:
     CHAOS_DELAY_JITTER_S: float = _env_float("CHAOS_DELAY_JITTER_S", 0.0, 0.0, 10.0)
     CHAOS_DUPLICATE_RATE: float = _env_float("CHAOS_DUPLICATE_RATE", 0.0, 0.0, 1.0)
 
+    # --- Byzantine defense / wire admission control -------------------------
+    # Screening of inbound model-plane frames between decode and
+    # aggregator.add_model / apply_frame (comm/admission.py): structural
+    # validation against the local model spec, NaN/Inf rejection, and an
+    # adaptive update-norm bound (median of recently admitted norms x
+    # ADMISSION_NORM_MULT; before enough history exists the bound falls back
+    # to the local model's own norm). All values validated at load with the
+    # WIRE_COMPRESSION fail-fast pattern.
+    ADMISSION_ENABLED: bool = _env_override("ADMISSION_ENABLED", True)
+    ADMISSION_NORM_MULT: float = _env_float("ADMISSION_NORM_MULT", 5.0, 1.0, 1e6)
+    ADMISSION_NORM_WINDOW: int = _env_int("ADMISSION_NORM_WINDOW", 16, 4, 4096)
+    # Cap on the wire-supplied (unauthenticated) num_samples claim: a single
+    # peer claiming 10**9 samples would dominate FedAvg's sample weighting
+    # (the attack GeometricMedian's unit weights already neutralize). Claims
+    # above the cap are clamped, warned about, and counted.
+    MAX_CLAIMED_SAMPLES: int = _env_int("MAX_CLAIMED_SAMPLES", 1_000_000, 1, 2**53)
+
     # --- wire compression ---------------------------------------------------
     # Lossy-but-bounded codec for gossiped weights ("none" | "bf16" | "int8"
     # | "topk", ops/compression.py). Sender-local: the codec spec rides in

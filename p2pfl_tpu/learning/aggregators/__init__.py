@@ -6,11 +6,12 @@ from p2pfl_tpu.learning.aggregators.fedmedian import FedMedian  # noqa: F401
 from p2pfl_tpu.learning.aggregators.robust import (  # noqa: F401
     GeometricMedian,
     Krum,
+    MultiKrum,
     TrimmedMean,
 )
 from p2pfl_tpu.learning.aggregators.scaffold import Scaffold  # noqa: F401
 
 __all__ = [
     "Aggregator", "FedAvg", "FedMedian", "GeometricMedian", "Krum",
-    "TrimmedMean", "Scaffold",
+    "MultiKrum", "TrimmedMean", "Scaffold",
 ]

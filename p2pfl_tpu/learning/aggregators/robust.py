@@ -74,7 +74,16 @@ class GeometricMedian(Aggregator):
 
 class Krum(Aggregator):
     """(Multi-)Krum (Blanchard et al. 2017): select the model(s) closest to
-    their peers, discarding up to ``num_byzantine`` outliers."""
+    their peers, discarding up to ``num_byzantine`` outliers.
+
+    ``partial_aggregation`` stays ``False``: Krum scores RAW models against
+    each other, so intermediate subsets must never be pre-averaged and
+    re-gossiped (an average would smuggle Byzantine mass past the distance
+    filter). The round-survival machinery is unaffected — ``remove_node``
+    and the JIT stall patience live on the base accumulator, so a dead
+    trainset member still shrinks the wait and a stalled round still
+    aggregates what arrived.
+    """
 
     partial_aggregation = False
 
@@ -83,11 +92,14 @@ class Krum(Aggregator):
         self.num_byzantine = int(num_byzantine)
         self.num_selected = int(num_selected)
 
+    def _select_count(self, n: int) -> int:
+        return min(self.num_selected, n)
+
     def aggregate(self, models: List[ModelHandle]) -> ModelHandle:
         if not models:
             raise ValueError("nothing to aggregate")
         n = len(models)
-        sel = min(self.num_selected, n)
+        sel = self._select_count(n)
         stacked = agg_ops.tree_stack([m.params for m in models])
         weights = jnp.asarray([m.get_num_samples() for m in models], jnp.float32)
         out, idx = agg_ops.krum(
@@ -99,3 +111,20 @@ class Krum(Aggregator):
         chosen = [models[i] for i in idx.tolist()]
         contributors, total = self._merge_metadata(chosen)
         return models[0].build_copy(params=out, contributors=contributors, num_samples=total)
+
+
+class MultiKrum(Krum):
+    """Multi-Krum with the paper's standard selection size: average the
+    ``m = n - num_byzantine - 2`` lowest-scored models (Blanchard et al.
+    2017, §4) instead of committing to a single winner — smoother than
+    plain Krum (closer to FedAvg on the honest subset) while keeping the
+    distance filter. Pass ``num_selected`` explicitly to override the
+    automatic ``m``."""
+
+    def __init__(self, num_byzantine: int = 1, num_selected: int = 0) -> None:
+        super().__init__(num_byzantine=num_byzantine, num_selected=num_selected)
+
+    def _select_count(self, n: int) -> int:
+        if self.num_selected > 0:
+            return min(self.num_selected, n)
+        return max(1, n - self.num_byzantine - 2)

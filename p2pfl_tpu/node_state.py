@@ -39,9 +39,13 @@ class NodeState:
             residuals, :class:`~p2pfl_tpu.comm.delta.DeltaWireCodec`).
             Anchors are snapshotted by the stage machine at every round
             boundary; active only under ``Settings.WIRE_COMPRESSION="topk"``.
+        admission: Wire admission controller (structural/NaN/norm screening
+            of inbound model frames,
+            :class:`~p2pfl_tpu.comm.admission.AdmissionController`).
     """
 
     def __init__(self, addr: str) -> None:
+        from p2pfl_tpu.comm.admission import AdmissionController
         from p2pfl_tpu.comm.delta import DeltaWireCodec
 
         self.addr = addr
@@ -49,6 +53,10 @@ class NodeState:
         self.experiment: Optional[Experiment] = None
         self.simulation = False
         self.wire = DeltaWireCodec(addr)
+        # Byzantine defense: inbound model-plane frames are screened here
+        # (structure/dtype/NaN/norm-bound, comm/admission.py) between
+        # decode_frame and aggregator.add_model / apply_frame.
+        self.admission = AdmissionController(addr)
         # Federation-wide trace id of the running experiment: minted by the
         # initiator, adopted by peers from the start_learning frame's span
         # context (telemetry/tracing.py). None -> the workflow opens a

@@ -241,6 +241,14 @@ class TrainStage(Stage):
             aggregated.contributors, aggregated.get_num_samples()
         )
         node.learner.get_model().additional_info.update(aggregated.additional_info)
+        # Mark the round's full model as held: a later full_model frame for
+        # this round is a redundant delivery and must NOT overwrite our own
+        # aggregate (first wins — FullModelCommand honors this; it also
+        # closes the window where a Byzantine peer's corrupted full model
+        # could clobber an honest aggregate post-aggregation).
+        state.last_full_model_round = max(
+            state.last_full_model_round, state.round or 0
+        )
         state.aggregated_model_event.set()
         node.protocol.broadcast(
             node.protocol.build_msg(ModelsReadyCommand.get_name(), round=state.round or 0)

@@ -1,0 +1,143 @@
+"""CI gate: 3-node in-memory federation with ONE signflip adversary (chaos
+plane byzantine behavior at the send choke point) and Krum + wire admission
+control on the honest side. The honest nodes must finish ALL rounds within a
+wall budget, the admission plane must have rejected at least one poisoned
+frame (``p2pfl_updates_rejected_total`` nonzero), and the honest final
+accuracy must sit above the attacked-FedAvg floor (undefended FedAvg under a
+signflip trainer converges to ~chance). Fast, CPU-only, tier-1-safe —
+invoked by ``make byzantine-check``.
+
+Exit 0 when every check passes; nonzero with a reason on stderr otherwise.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import time  # noqa: E402
+
+ROUNDS = 2
+#: Wall budget for the whole learning run. Generous for a loaded 1-core CI
+#: box and covers the per-round JIT stall patience (the adversary's rejected
+#: contributions never arrive, so each round stalls AGGREGATION_STALL_PATIENCE
+#: before aggregating what did), yet far below sleeping out the fixed
+#: timeouts (ROUNDS x (VOTE_TIMEOUT + AGGREGATION_TIMEOUT) = 80s under test
+#: settings plus training time).
+WALL_BUDGET_S = 90.0
+#: Floor the defended accuracy must clear: an UNDEFENDED FedAvg federation
+#: with a signflip trainer in a 3-committee collapses toward chance (~0.1 on
+#: 10 classes); the defended run excludes the adversary and trains normally
+#: (~0.7+ after 2 rounds on synthetic MNIST).
+ATTACKED_FEDAVG_FLOOR = 0.3
+
+
+def main() -> int:
+    from p2pfl_tpu.chaos import CHAOS
+    from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+    from p2pfl_tpu.config import Settings
+    from p2pfl_tpu.learning.aggregators import Krum
+    from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.node import Node
+    from p2pfl_tpu.telemetry import REGISTRY
+    from p2pfl_tpu.utils.utils import set_test_settings, wait_convergence
+
+    set_test_settings()
+    Settings.RESOURCE_MONITOR_PERIOD = 0
+    Settings.LOG_LEVEL = "WARNING"
+    Settings.TRAIN_SET_SIZE = 3  # full committee: the adversary is a trainer
+    REGISTRY.reset()
+    CHAOS.reset()
+
+    n = 3
+    data = synthetic_mnist(n_train=128 * n, n_test=64)
+    parts = data.generate_partitions(n, RandomIIDPartitionStrategy)
+    nodes = [
+        Node(mlp_model(seed=i), parts[i], batch_size=32,
+             aggregator=Krum(num_byzantine=1))
+        for i in range(n)
+    ]
+    adversary, honest = nodes[2], nodes[:2]
+    for nd in nodes:
+        nd.start()
+    try:
+        CHAOS.set_byzantine(adversary.addr, "signflip")
+        for i in range(1, n):
+            nodes[i].connect(nodes[0].addr)
+        wait_convergence(nodes, n - 1, wait=15)
+
+        t0 = time.monotonic()
+        nodes[0].set_start_learning(rounds=ROUNDS, epochs=1)
+
+        finish_deadline = time.monotonic() + WALL_BUDGET_S
+        while time.monotonic() < finish_deadline:
+            if all(
+                not nd.learning_in_progress() and nd.learning_workflow is not None
+                for nd in honest
+            ):
+                break
+            time.sleep(0.2)
+        else:
+            print(
+                f"FAIL: honest nodes did not finish {ROUNDS} rounds within "
+                f"{WALL_BUDGET_S:.0f}s under the signflip adversary",
+                file=sys.stderr,
+            )
+            return 1
+        elapsed = time.monotonic() - t0
+        faults = CHAOS.fault_counts()
+
+        for nd in honest:
+            finished = nd.learning_workflow.history.count("RoundFinishedStage")
+            if finished != ROUNDS:
+                print(
+                    f"FAIL: {nd.addr} finished {finished}/{ROUNDS} rounds",
+                    file=sys.stderr,
+                )
+                return 1
+
+        rejected = {}
+        fam = REGISTRY.get("p2pfl_updates_rejected_total")
+        if fam is not None:
+            for labels, child in fam.samples():
+                r = labels.get("reason", "?")
+                rejected[r] = rejected.get(r, 0) + int(child.value)
+        if sum(rejected.values()) == 0:
+            print(
+                "FAIL: admission control rejected nothing — the adversary's "
+                f"poisoned frames were never screened (faults={faults})",
+                file=sys.stderr,
+            )
+            return 1
+
+        accs = [nd.learner.evaluate().get("test_acc", 0.0) for nd in honest]
+        if min(accs) < ATTACKED_FEDAVG_FLOOR:
+            print(
+                f"FAIL: honest accuracy {min(accs):.3f} below the "
+                f"attacked-FedAvg floor {ATTACKED_FEDAVG_FLOOR} "
+                f"(accs={[round(a, 3) for a in accs]})",
+                file=sys.stderr,
+            )
+            return 1
+    finally:
+        for nd in nodes:
+            nd.stop()
+        CHAOS.reset()
+        InMemoryRegistry.reset()
+
+    print(
+        f"byzantine-check OK: {len(honest)} honest nodes finished {ROUNDS} "
+        f"rounds in {elapsed:.1f}s with 1 signflip adversary "
+        f"(rejections: {rejected}, injected: {faults}, "
+        f"honest acc: {[round(a, 3) for a in accs]})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

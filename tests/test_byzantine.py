@@ -1,0 +1,602 @@
+"""Byzantine defense plane tests.
+
+Covers: wire admission control (structural/dtype/NaN/norm screening, the
+adaptive bound, the enable flag, corrupt-frame accounting, the num_samples
+clamp + its fail-fast env validation), Krum/Multi-Krum selection against
+signflip and scaled attackers (kernel and node-mode aggregator), the chaos
+plane's Byzantine peer behaviors (determinism, each attack's effect, the
+real send choke point), screening after sparse-delta reconstruction (a
+poisoned top-k frame never corrupts the anchor), full-model first-wins
+adoption, and a non-slow 3-node e2e where one adversarial trainer is
+screened out and the round still completes within the PR 3 wait bounds.
+"""
+
+import os
+import subprocess
+import sys
+import time
+from typing import Any
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.chaos import BYZANTINE_ATTACKS, CHAOS, ChaosPlane
+from p2pfl_tpu.comm.admission import MIN_NORM_HISTORY, AdmissionController
+from p2pfl_tpu.comm.envelope import Envelope
+from p2pfl_tpu.comm.memory.memory_protocol import InMemoryCommunicationProtocol
+from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.learning.aggregators import FedAvg, Krum, MultiKrum
+from p2pfl_tpu.models import mlp_model
+from p2pfl_tpu.models.model_handle import ModelHandle
+from p2pfl_tpu.ops import aggregation as agg_ops
+from p2pfl_tpu.ops.serialization import deserialize_arrays
+from p2pfl_tpu.telemetry import REGISTRY
+
+
+def _small_model() -> ModelHandle:
+    return mlp_model(seed=0, hidden_sizes=(16,))
+
+
+def _rejected(reason=None) -> int:
+    fam = REGISTRY.get("p2pfl_updates_rejected_total")
+    total = 0
+    if fam is not None:
+        for labels, child in fam.samples():
+            if reason is None or labels.get("reason") == reason:
+                total += int(child.value)
+    return total
+
+
+# --- admission control: the screen -------------------------------------------
+
+
+def test_admission_legit_frame_passes_and_builds_history():
+    m = _small_model()
+    adm = AdmissionController("adm-legit")
+    for i in range(MIN_NORM_HISTORY + 1):
+        frame = [p + 0.01 * (i + 1) for p in m.get_parameters()]
+        assert adm.screen(frame, m, source="peer") is None
+    assert adm.rejected_count() == 0
+
+
+def test_admission_structural_rejections():
+    m = _small_model()
+    params = m.get_parameters()
+    adm = AdmissionController("adm-struct")
+    # wrong leaf count
+    assert adm.screen(params[:-1], m) == "tree"
+    # wrong shape (transpose a 2D leaf)
+    bad = [p.copy() for p in params]
+    i2d = next(i for i, p in enumerate(bad) if p.ndim == 2)
+    bad[i2d] = bad[i2d].T.copy()
+    assert adm.screen(bad, m) == "shape"
+    # wrong dtype class (int where float expected)
+    bad = [p.copy() for p in params]
+    bad[0] = bad[0].astype(np.int32)
+    assert adm.screen(bad, m) == "dtype"
+    assert adm.rejected_count("tree") == 1
+    assert adm.rejected_count("shape") == 1
+    assert adm.rejected_count("dtype") == 1
+
+
+def test_admission_rejects_nonfinite():
+    m = _small_model()
+    adm = AdmissionController("adm-nan")
+    nan_frame = [p.copy() for p in m.get_parameters()]
+    nan_frame[0][0] = np.nan
+    assert adm.screen(nan_frame, m) == "nonfinite"
+    inf_frame = [p.copy() for p in m.get_parameters()]
+    inf_frame[-1][...] = np.inf
+    assert adm.screen(inf_frame, m) == "nonfinite"
+    assert adm.rejected_count("nonfinite") == 2
+
+
+def test_admission_norm_bound_bootstrap_and_adaptive():
+    m = _small_model()
+    params = m.get_parameters()
+    adm = AdmissionController("adm-norm")
+    # Bootstrap (no history yet): an update at least as large as the whole
+    # model is rejected outright — signflip (2||w||) and scaled (9||w||)
+    # both trip before any honest norms have been observed.
+    assert adm.screen([-p for p in params], m) == "norm"
+    assert adm.screen([10.0 * p for p in params], m) == "norm"
+    # Build honest history: small perturbations around the local model.
+    for i in range(MIN_NORM_HISTORY):
+        assert adm.screen([p + 0.01 * (i + 1) for p in params], m) is None
+    # Adaptive bound: an outlier far beyond median * ADMISSION_NORM_MULT
+    # rejects (+1.0 per element ~ 25x the largest honest perturbation).
+    assert adm.screen([p + 1.0 for p in params], m) == "norm"
+    # ...and honest frames keep passing after the rejection.
+    assert adm.screen([p + 0.02 for p in params], m) is None
+
+
+def test_admission_disabled_admits_everything():
+    m = _small_model()
+    adm = AdmissionController("adm-off")
+    nan_frame = [np.full_like(p, np.nan) for p in m.get_parameters()]
+    with Settings.overridden(ADMISSION_ENABLED=False):
+        assert adm.screen(nan_frame, m) is None
+        assert adm.screen(nan_frame[:-1], m) is None
+    assert adm.rejected_count() == 0
+
+
+def test_admission_skips_norm_check_when_asked():
+    """The full-model path screens structure+finiteness but not distance —
+    a rejoining node must be able to adopt a far-away aggregate."""
+    m = _small_model()
+    adm = AdmissionController("adm-rejoin")
+    far = [p + 100.0 for p in m.get_parameters()]
+    assert adm.screen(far, m, check_norm=False) is None
+    nan_frame = [np.full_like(p, np.nan) for p in m.get_parameters()]
+    assert adm.screen(nan_frame, m, check_norm=False) == "nonfinite"
+
+
+def test_num_samples_clamp():
+    adm = AdmissionController("adm-clamp")
+    cap = Settings.MAX_CLAIMED_SAMPLES
+    assert adm.clamp_num_samples(17, "peer") == 17
+    assert adm.clamp_num_samples(cap, "peer") == cap
+    assert adm.clamp_num_samples(cap * 1000, "peer") == cap
+    assert adm.clamp_num_samples(-3, "peer") == 0
+    fam = REGISTRY.get("p2pfl_claimed_samples_clamped_total")
+    clamped = sum(
+        int(c.value) for labels, c in fam.samples()
+        if labels.get("node") == "adm-clamp"
+    )
+    assert clamped == 1
+
+
+def test_admission_env_validation_fails_fast():
+    """A typo'd admission/clamp env value must fail at config import (the
+    CHAOS_*/WIRE_COMPRESSION fail-fast pattern)."""
+    for var, bad in (
+        ("P2PFL_TPU_MAX_CLAIMED_SAMPLES", "lots"),
+        ("P2PFL_TPU_MAX_CLAIMED_SAMPLES", "0"),
+        ("P2PFL_TPU_ADMISSION_NORM_MULT", "0.5"),
+        ("P2PFL_TPU_ADMISSION_NORM_WINDOW", "2"),
+    ):
+        env = dict(os.environ)
+        env[var] = bad
+        proc = subprocess.run(
+            [sys.executable, "-c", "import p2pfl_tpu.config"],
+            capture_output=True, text=True, env=env,
+        )
+        assert proc.returncode != 0, (var, bad)
+        assert "ValueError" in proc.stderr and var in proc.stderr, proc.stderr
+
+
+def test_admission_init_screen():
+    """Init frames: honest fresh-init weights pass (ratio ~1 to the local
+    init), a x10-scaled init rejects as init_norm, NaN rejects, and a
+    signflip init passes (valid-scale — the documented trust boundary)."""
+    m = _small_model()
+    other = mlp_model(seed=7, hidden_sizes=(16,))
+    adm = AdmissionController("adm-init")
+    assert adm.screen_init(other.get_parameters(), m) is None
+    assert adm.screen_init([10.0 * p for p in other.get_parameters()], m) == "init_norm"
+    nan_init = [np.full_like(p, np.nan) for p in other.get_parameters()]
+    assert adm.screen_init(nan_init, m) == "nonfinite"
+    assert adm.screen_init([-p for p in other.get_parameters()], m) is None
+
+
+def test_init_model_command_rejects_scaled_init():
+    """A Byzantine initiator's scaled init frame must not seed the node."""
+    from p2pfl_tpu.comm.commands.impl import InitModelCommand
+
+    with Settings.overridden(EXECUTOR_MAX_WORKERS=0):
+        node = _make_node()
+        before = [p.copy() for p in node.learner.get_model().get_parameters()]
+        evil = mlp_model(seed=3)
+        evil_frame = evil.build_copy(
+            params=[10.0 * p for p in evil.get_parameters()]
+        ).encode_parameters()
+        InitModelCommand(node).execute("evil", 0, weights=evil_frame)
+        assert not node.state.model_initialized_event.is_set()
+        for a, b in zip(before, node.learner.get_model().get_parameters()):
+            np.testing.assert_array_equal(a, b)
+        assert _rejected("init_norm") >= 1
+
+
+# --- admission on the command path --------------------------------------------
+
+
+def _make_node(seed=0, aggregator=None):
+    from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from p2pfl_tpu.node import Node
+
+    data = synthetic_mnist(n_train=64, n_test=32)
+    parts = data.generate_partitions(1, RandomIIDPartitionStrategy)
+    return Node(mlp_model(seed=seed), parts[0], batch_size=32,
+                aggregator=aggregator or FedAvg())
+
+
+def test_corrupt_frame_counted_not_raised():
+    """A truncated/garbage frame must become a reason="corrupt" rejection on
+    the handler, never an exception on the transport thread."""
+    from p2pfl_tpu.comm.commands.impl import FullModelCommand, PartialModelCommand
+
+    with Settings.overridden(EXECUTOR_MAX_WORKERS=0):
+        node = _make_node()
+        node.state.set_experiment("corrupt-test", 3)
+        before = _rejected("corrupt")
+        PartialModelCommand(node).execute("evil", 0, weights=b"PFLTgarbage")
+        FullModelCommand(node).execute("evil", 0, weights=b"\x00\x01\x02")
+        assert _rejected("corrupt") - before == 2
+
+
+def test_partial_model_rejected_before_aggregator():
+    """A poisoned partial model must never reach aggregator.add_model."""
+    from p2pfl_tpu.comm.commands.impl import PartialModelCommand
+
+    with Settings.overridden(EXECUTOR_MAX_WORKERS=0):
+        node = _make_node()
+        node.state.set_experiment("screen-test", 3)
+        node.state.train_set = [node.addr, "evil"]
+        node.aggregator.set_nodes_to_aggregate([node.addr, "evil"])
+        evil = node.learner.get_model().build_copy(
+            params=[10.0 * p for p in node.learner.get_model().get_parameters()],
+            contributors=["evil"], num_samples=1,
+        )
+        before = _rejected("norm")
+        PartialModelCommand(node).execute(
+            "evil", 0, weights=evil.encode_parameters(),
+            contributors=["evil"], num_samples=1,
+        )
+        assert _rejected("norm") - before == 1
+        assert node.aggregator.get_aggregated_models() == []
+
+
+def test_inflated_num_samples_clamped_on_partial_path():
+    from p2pfl_tpu.comm.commands.impl import PartialModelCommand
+
+    with Settings.overridden(EXECUTOR_MAX_WORKERS=0):
+        node = _make_node()
+        node.start()  # the admitted model triggers a models_aggregated broadcast
+        try:
+            node.state.set_experiment("clamp-test", 3)
+            node.state.train_set = [node.addr, "evil"]
+            node.aggregator.set_nodes_to_aggregate([node.addr, "evil"])
+            # In-norm (honest-looking) frame with an absurd num_samples claim.
+            m = node.learner.get_model()
+            frame = m.build_copy(
+                params=[p + 0.001 for p in m.get_parameters()],
+                contributors=["evil"], num_samples=1,
+            )
+            PartialModelCommand(node).execute(
+                "evil", 0, weights=frame.encode_parameters(),
+                contributors=["evil"], num_samples=10**15,
+            )
+            stored = [
+                mm for mm in node.aggregator._models if "evil" in mm.contributors
+            ]
+            assert stored
+            assert stored[0].get_num_samples() == Settings.MAX_CLAIMED_SAMPLES
+        finally:
+            node.stop()
+            InMemoryRegistry.reset()
+
+
+def test_full_model_first_wins_blocks_overwrite():
+    """Once a round's full model is held (adopted or own aggregate), a later
+    full_model frame for the same round must NOT overwrite it — only
+    re-announce models_ready (ack repair)."""
+    from p2pfl_tpu.comm.commands.impl import FullModelCommand
+
+    with Settings.overridden(EXECUTOR_MAX_WORKERS=0):
+        node = _make_node()
+        node.start()
+        try:
+            node.state.set_experiment("firstwins-test", 3)
+            node.state.last_full_model_round = 0  # round 0 already held
+            before = [p.copy() for p in node.learner.get_model().get_parameters()]
+            other = mlp_model(seed=9)
+            other.contributors = ["evil"]
+            FullModelCommand(node).execute(
+                "evil", 0, weights=other.encode_parameters()
+            )
+            after = node.learner.get_model().get_parameters()
+            for a, b in zip(before, after):
+                np.testing.assert_array_equal(a, b)
+        finally:
+            node.stop()
+
+
+# --- screening after sparse-delta reconstruction -------------------------------
+
+
+def test_poisoned_sparse_frame_rejected_and_anchor_survives():
+    """A NaN-poisoned top-k frame must be screened AFTER reconstruction and
+    must never corrupt the receiver's round anchor: a subsequent honest
+    sparse frame still decodes cleanly."""
+    from p2pfl_tpu.comm.commands.impl import PartialModelCommand
+    from p2pfl_tpu.comm.delta import DeltaWireCodec
+
+    with Settings.overridden(EXECUTOR_MAX_WORKERS=0, WIRE_COMPRESSION="topk"):
+        node = _make_node()
+        node.state.set_experiment("sparse-poison", 3)
+        node.state.train_set = [node.addr, "evil"]
+        node.aggregator.set_nodes_to_aggregate([node.addr, "evil"])
+        anchor = node.learner.get_model().get_parameters()
+        node.state.wire.set_anchor(anchor, 0)
+
+        sender = DeltaWireCodec("evil")
+        sender.set_anchor(anchor, 0)
+        honest_update = node.learner.get_model().build_copy(
+            params=[p + 0.01 for p in anchor], contributors=["evil"], num_samples=1,
+        )
+        sparse = sender.encode_model(honest_update, 0)
+        assert sparse is not None
+
+        # Poison the sparse frame's float (value) tensors with NaN, exactly
+        # like the chaos plane's "nan" byzantine behavior does on the wire.
+        plane = ChaosPlane()
+        plane.set_byzantine("evil", "nan")
+        env = Envelope.weights("evil", "partial_model", 0, sparse, ["evil"], 1)
+        poisoned = plane.corrupt_weights("evil", env).payload
+
+        before = _rejected("nonfinite")
+        PartialModelCommand(node).execute(
+            "evil", 0, weights=poisoned, contributors=["evil"], num_samples=1
+        )
+        assert _rejected("nonfinite") - before == 1
+        assert node.aggregator.get_aggregated_models() == []
+
+        # Anchor unpoisoned: a later HONEST sparse frame decodes to finite
+        # arrays that match the sender's update.
+        sender2 = DeltaWireCodec("evil2")
+        sender2.set_anchor(anchor, 0)
+        sparse2 = sender2.encode_model(honest_update, 0)
+        arrays, _ = node.state.wire.decode_frame(sparse2)
+        for a in arrays:
+            assert np.isfinite(np.asarray(a, dtype=np.float32)).all()
+
+
+# --- Krum / Multi-Krum ---------------------------------------------------------
+
+
+def _attacked_stack(n_honest=6, n_adv=2, attack="signflip"):
+    base = _small_model().get_parameters()
+    honest = [[p + 0.01 * (i + 1) for p in base] for i in range(n_honest)]
+    if attack == "signflip":
+        adv = [[-p for p in base] for _ in range(n_adv)]
+    else:  # scaled
+        adv = [[10.0 * p for p in base] for _ in range(n_adv)]
+    return agg_ops.tree_stack(honest + adv), n_honest, n_adv
+
+
+@pytest.mark.parametrize("attack", ["signflip", "scaled"])
+def test_krum_select_excludes_attackers(attack):
+    stacked, n_honest, n_adv = _attacked_stack(attack=attack)
+    idx = agg_ops.krum_select(stacked, num_byzantine=n_adv, num_selected=1)
+    assert int(np.asarray(idx)[0]) < n_honest
+    idx_multi = agg_ops.krum_select(
+        stacked, num_byzantine=n_adv,
+        num_selected=n_honest + n_adv - n_adv - 2,
+    )
+    assert set(int(i) for i in np.asarray(idx_multi)) <= set(range(n_honest))
+
+
+@pytest.mark.parametrize("attack", ["signflip", "scaled"])
+def test_krum_aggregator_contributors_exclude_attackers(attack):
+    base = _small_model().get_parameters()
+    models = [
+        ModelHandle([p + 0.01 * (i + 1) for p in base], contributors=[f"h{i}"])
+        for i in range(6)
+    ]
+    mult = -1.0 if attack == "signflip" else 10.0
+    models += [
+        ModelHandle([mult * p for p in base], contributors=[f"adv{i}"])
+        for i in range(2)
+    ]
+    out = MultiKrum(num_byzantine=2).aggregate(models)
+    assert out.contributors
+    assert not any(c.startswith("adv") for c in out.contributors)
+    single = Krum(num_byzantine=2, num_selected=1).aggregate(models)
+    assert len(single.contributors) == 1
+    assert single.contributors[0].startswith("h")
+
+
+def test_multikrum_auto_selection_size():
+    mk = MultiKrum(num_byzantine=2)
+    assert mk._select_count(8) == 4  # n - f - 2
+    assert mk._select_count(3) == 1  # floors at 1
+    assert MultiKrum(num_byzantine=2, num_selected=3)._select_count(8) == 3
+    assert mk.partial_aggregation is False  # raw models only — never pre-averaged
+
+
+def test_krum_remove_node_wakes_wait():
+    """PR 3 interplay: a dead trainset member shrinks Krum's wait too."""
+    import threading
+
+    agg = Krum(num_byzantine=1)
+    agg.set_addr("n1")
+    agg.set_nodes_to_aggregate(["n1", "n2", "n3"])
+    base = _small_model().get_parameters()
+    agg.add_model(ModelHandle(base, contributors=["n1"]))
+    agg.add_model(ModelHandle([p + 0.01 for p in base], contributors=["n2"]))
+    result = {}
+
+    def waiter():
+        t0 = time.monotonic()
+        result["model"] = agg.wait_and_get_aggregation(timeout=30.0)
+        result["waited"] = time.monotonic() - t0
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    assert t.is_alive()
+    assert agg.remove_node("n3") is True
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert result["waited"] < 5.0, result
+
+
+# --- chaos plane: byzantine behaviors ------------------------------------------
+
+
+def test_byzantine_attack_validation_and_active_flag():
+    plane = ChaosPlane()
+    with pytest.raises(ValueError, match="attack"):
+        plane.set_byzantine("x", "meteor")
+    assert not plane.active
+    plane.set_byzantine("x", "signflip")
+    assert plane.active
+    assert plane.byzantine_peers() == {"x": "signflip"}
+    plane.clear_byzantine("x")
+    assert not plane.active
+    plane.set_byzantine("x", "nan")
+    plane.reset()
+    assert not plane.active and plane.byzantine_peers() == {}
+
+
+def test_byzantine_corruption_effects():
+    m = _small_model()
+    params = m.get_parameters()
+    payload = m.encode_parameters()
+    env = Envelope.weights("adv", "partial_model", 0, payload, ["adv"], 128)
+    plane = ChaosPlane()
+
+    plane.set_byzantine("adv", "signflip")
+    arrays, _ = deserialize_arrays(plane.corrupt_weights("adv", env).payload)
+    np.testing.assert_allclose(np.asarray(arrays[0]), -params[0])
+
+    plane.set_byzantine("adv", "scaled", scale=10.0)
+    arrays, _ = deserialize_arrays(plane.corrupt_weights("adv", env).payload)
+    np.testing.assert_allclose(
+        np.asarray(arrays[0]), 10.0 * params[0], rtol=1e-6
+    )
+
+    plane.set_byzantine("adv", "nan")
+    arrays, _ = deserialize_arrays(plane.corrupt_weights("adv", env).payload)
+    assert not np.isfinite(np.asarray(arrays[0]).astype(np.float32)).any()
+
+    plane.set_byzantine("adv", "inflate", inflate_factor=1000)
+    out = plane.corrupt_weights("adv", env)
+    assert out.num_samples == 128 * 1000
+    assert out.payload == env.payload  # weights untouched by inflation
+
+    # honest source / control frames are identity
+    assert plane.corrupt_weights("honest", env) is env
+    ctrl = Envelope.message("adv", "vote_train_set", args=["a", "1"])
+    assert plane.corrupt_weights("adv", ctrl) is ctrl
+
+    counts = plane.fault_counts()
+    for attack in BYZANTINE_ATTACKS:
+        assert counts.get(f"byzantine_{attack}", 0) >= 1, counts
+
+
+def test_byzantine_corruption_deterministic():
+    """Same attack + same frame sequence through two fresh planes =>
+    identical corrupted payloads AND identical fault counts."""
+    m = _small_model()
+    frame = m.encode_parameters()
+    outs = []
+    for _ in range(2):
+        plane = ChaosPlane()
+        plane.set_byzantine("adv", "scaled")
+        payloads = []
+        for k in range(20):
+            env = Envelope.weights("adv", "partial_model", k, frame, ["adv"], 1)
+            payloads.append(plane.corrupt_weights("adv", env).payload)
+        outs.append((payloads, plane.fault_counts()))
+    assert outs[0] == outs[1]
+
+
+def test_byzantine_through_real_send_path():
+    """Corruption happens at the shared send choke point: a weights frame
+    from a byzantine protocol arrives corrupted at the receiver."""
+    from p2pfl_tpu.comm.commands.command import Command
+
+    received = []
+
+    class Capture(Command):
+        @staticmethod
+        def get_name() -> str:
+            return "partial_model"
+
+        def execute(self, source: str, round: int, *args: str, **kwargs: Any) -> None:
+            received.append(kwargs["weights"])
+
+    a, b = InMemoryCommunicationProtocol(), InMemoryCommunicationProtocol()
+    a.start()
+    b.start()
+    b.add_command(Capture())
+    try:
+        a.connect(b.addr)
+        m = _small_model()
+        CHAOS.set_byzantine(a.addr, "signflip")
+        try:
+            env = a.build_weights("partial_model", 0, m.encode_parameters(), ["a"], 1)
+            a.send(b.addr, env)
+            deadline = time.time() + 5.0
+            while time.time() < deadline and not received:
+                time.sleep(0.05)
+            assert received, "frame never arrived"
+            arrays, _ = deserialize_arrays(received[0])
+            np.testing.assert_allclose(
+                np.asarray(arrays[0]), -m.get_parameters()[0]
+            )
+            assert CHAOS.fault_counts().get("byzantine_signflip", 0) >= 1
+        finally:
+            CHAOS.reset()
+    finally:
+        a.stop()
+        b.stop()
+        InMemoryRegistry.reset()
+
+
+# --- e2e: adversary screened out, round survives -------------------------------
+
+
+def test_e2e_adversary_screened_round_completes():
+    """3-node full-committee federation with one scaled adversary: the honest
+    nodes reject its frames at admission, JIT-aggregate what arrived (PR 3
+    stall patience), and finish the round well inside the fixed timeouts."""
+    from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from p2pfl_tpu.node import Node
+    from p2pfl_tpu.utils.utils import wait_convergence
+
+    Settings.RESOURCE_MONITOR_PERIOD = 0
+    n = 3
+    with Settings.overridden(TRAIN_SET_SIZE=3):
+        data = synthetic_mnist(n_train=128 * n, n_test=64)
+        parts = data.generate_partitions(n, RandomIIDPartitionStrategy)
+        nodes = [
+            Node(mlp_model(seed=i), parts[i], batch_size=32,
+                 aggregator=Krum(num_byzantine=1))
+            for i in range(n)
+        ]
+        adversary, honest = nodes[2], nodes[:2]
+        for nd in nodes:
+            nd.start()
+        try:
+            CHAOS.set_byzantine(adversary.addr, "scaled")
+            for i in range(1, n):
+                nodes[i].connect(nodes[0].addr)
+            wait_convergence(nodes, n - 1, wait=8)
+            rejected_before = _rejected()
+            t0 = time.monotonic()
+            nodes[0].set_start_learning(rounds=1, epochs=1)
+            deadline = time.time() + Settings.VOTE_TIMEOUT + Settings.AGGREGATION_TIMEOUT
+            while time.time() < deadline:
+                if all(
+                    not nd.learning_in_progress()
+                    and nd.learning_workflow is not None
+                    for nd in honest
+                ):
+                    break
+                time.sleep(0.2)
+            else:
+                pytest.fail("honest nodes did not finish under the adversary")
+            elapsed = time.monotonic() - t0
+            # "well under": no stage slept out its full fixed timeout.
+            assert elapsed < Settings.AGGREGATION_TIMEOUT, elapsed
+            for nd in honest:
+                assert nd.learning_workflow.history.count("RoundFinishedStage") == 1
+            assert _rejected() > rejected_before, "no poisoned frame was screened"
+        finally:
+            CHAOS.reset()
+            for nd in nodes:
+                nd.stop()
+            InMemoryRegistry.reset()

@@ -1889,6 +1889,244 @@ def run_byzantine_bench() -> None:
     os._exit(1 if "error" in out else 0)
 
 
+def run_observatory_bench() -> None:
+    """Subprocess-style mode ``--observatory``: federation-observatory
+    acceptance run.
+
+    One 8-node in-memory MNIST chaos federation (5% seeded message drop)
+    with three seeded anomalies over the real Node/gossip stack:
+
+    * a **straggler** — one honest node whose fits take longer than the
+      fleet's JIT stall patience, so the fleet aggregates without it each
+      round and it genuinely falls behind in round index (the lag its
+      gossiped digests expose),
+    * a **Byzantine peer** — signflip model poisoning at the chaos plane's
+      send choke point (its frames are rejected by wire admission,
+      attributed per sender),
+    * a **digest-free node** — emission disabled, proving digest-bearing
+      and digest-free nodes interoperate on the same wire,
+
+    plus one bystander node killed mid-run (``Node.crash()``). Asserts the
+    observatory contract: every surviving honest digest-bearing node flags
+    the straggler as its top straggler AND the Byzantine peer as its top
+    suspect within 2 rounds (scores derived purely from gossiped digests),
+    and the killed node's flight-recorder dump lands in ``artifacts/``.
+
+    Shape overrides: P2PFL_TPU_OBS_BENCH_NODES (default 8),
+    P2PFL_TPU_OBS_BENCH_ROUNDS (default 3), P2PFL_TPU_OBS_BENCH_DROP
+    (default 0.05), P2PFL_TPU_OBS_BENCH_SEED (default 42),
+    P2PFL_TPU_OBS_BENCH_STRAGGLE_S (default 12.0 — must exceed
+    AGGREGATION_STALL_PATIENCE, else the fleet waits for the straggler and
+    no round lag can develop).
+    """
+    out: dict = {}
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"  # protocol-stack bench: CPU venue
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from p2pfl_tpu.chaos import CHAOS
+        from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+        from p2pfl_tpu.config import Settings
+        from p2pfl_tpu.learning.dataset import (
+            RandomIIDPartitionStrategy,
+            synthetic_mnist,
+        )
+        from p2pfl_tpu.models import mlp_model
+        from p2pfl_tpu.node import Node
+        from p2pfl_tpu.telemetry import REGISTRY, TRACER
+        from p2pfl_tpu.utils.utils import set_test_settings, wait_convergence
+
+        n_nodes = int(os.environ.get("P2PFL_TPU_OBS_BENCH_NODES", "8"))
+        rounds = int(os.environ.get("P2PFL_TPU_OBS_BENCH_ROUNDS", "3"))
+        drop = float(os.environ.get("P2PFL_TPU_OBS_BENCH_DROP", "0.05"))
+        seed = int(os.environ.get("P2PFL_TPU_OBS_BENCH_SEED", "42"))
+        straggle_s = float(os.environ.get("P2PFL_TPU_OBS_BENCH_STRAGGLE_S", "12.0"))
+        set_test_settings()
+        Settings.RESOURCE_MONITOR_PERIOD = 0
+        Settings.LOG_LEVEL = "WARNING"
+        # Everyone trains: the Byzantine node's poisoned partials must flow
+        # every round for per-sender rejection attribution to accumulate.
+        Settings.TRAIN_SET_SIZE = n_nodes
+        REGISTRY.reset()
+        TRACER.reset()
+        CHAOS.reset()
+
+        _phase(
+            f"observatory bench: {n_nodes} nodes, {rounds} rounds, "
+            f"drop={drop}, straggler +{straggle_s}s/fit, 1 signflip adversary"
+        )
+        data = synthetic_mnist(n_train=256 * n_nodes, n_test=256)
+        parts = data.generate_partitions(n_nodes, RandomIIDPartitionStrategy)
+        nodes = [
+            Node(mlp_model(seed=i), parts[i], batch_size=32)
+            for i in range(n_nodes)
+        ]
+        # Role cast (all by index, deterministic): 0 = initiator, 1 = seeded
+        # straggler, 2 = Byzantine, 3 = digest-free, 4 = mid-run kill victim.
+        straggler, byzantine, digest_free, victim = (
+            nodes[1], nodes[2], nodes[3], nodes[4],
+        )
+        honest_observers = [
+            nd for nd in nodes
+            if nd not in (straggler, byzantine, victim)
+        ]
+
+        # Straggler: stretch every fit past the stall patience. The fleet
+        # JIT-aggregates without it each round (PR 3 machinery) and the
+        # straggler's round index falls behind — the lag its gossiped
+        # digests expose, and the signal the straggler score is built from.
+        inner_fit = straggler.learner.fit
+
+        def slow_fit(*a, **kw):
+            time.sleep(straggle_s)
+            return inner_fit(*a, **kw)
+
+        straggler.learner.fit = slow_fit
+        # Digest-free node: beats stay in the pre-digest wire format.
+        digest_free.protocol.set_digest_source(None)
+
+        flag_round: dict = {}  # observer addr -> round when both flagged
+        victim_killed = False
+        try:
+            with CHAOS.overridden(drop_rate=drop, seed=seed):
+                CHAOS.set_byzantine(byzantine.addr, "signflip")
+                for nd in nodes:
+                    nd.start()
+                for i in range(1, n_nodes):
+                    nodes[i].connect(nodes[0].addr)
+                wait_convergence(nodes, n_nodes - 1, wait=30)
+                t0 = time.monotonic()
+                nodes[0].set_start_learning(rounds=rounds, epochs=1)
+                deadline = time.time() + 900
+                while time.time() < deadline:
+                    for nd in honest_observers:
+                        if nd.addr in flag_round:
+                            continue
+                        obs = nd.observatory
+                        if (
+                            obs.top("straggler") == straggler.addr
+                            and obs.top("suspect") == byzantine.addr
+                        ):
+                            r = nd.state.round
+                            flag_round[nd.addr] = -1 if r is None else int(r)
+                    if (
+                        not victim_killed
+                        and nodes[0].state.round is not None
+                        and nodes[0].state.round >= 1
+                    ):
+                        _phase(f"killing bystander {victim.addr} mid-round 1")
+                        victim.crash()
+                        victim_killed = True
+                    alive = [nd for nd in nodes if nd is not victim or not victim_killed]
+                    if all(
+                        not nd.learning_in_progress()
+                        and nd.learning_workflow is not None
+                        for nd in alive
+                    ):
+                        break
+                    time.sleep(0.25)
+                else:
+                    raise TimeoutError("observatory federation did not finish")
+                wall_s = time.monotonic() - t0
+                faults = CHAOS.fault_counts()
+                # Final flag sweep (scores persist after the run ends).
+                for nd in honest_observers:
+                    if nd.addr not in flag_round:
+                        obs = nd.observatory
+                        if (
+                            obs.top("straggler") == straggler.addr
+                            and obs.top("suspect") == byzantine.addr
+                        ):
+                            r = nd.state.round
+                            flag_round[nd.addr] = rounds if r is None else int(r)
+                # Federation snapshot for fed_top (from the initiator's view).
+                os.makedirs("artifacts", exist_ok=True)
+                snap_path = nodes[0].observatory.write_snapshot(
+                    os.path.join("artifacts", "federation_snapshot.json")
+                )
+                # Fleet-view facts must be read BEFORE stop(): teardown
+                # clears neighbor tables, which forgets observatory peers.
+                df_known = len(digest_free.observatory.scores())
+                df_rounds = digest_free.learning_workflow.history.count(
+                    "RoundFinishedStage"
+                )
+        finally:
+            for nd in nodes:
+                try:
+                    nd.stop()
+                except Exception:
+                    pass
+            InMemoryRegistry.reset()
+
+        # --- the acceptance assertions --------------------------------------
+        unflagged = [nd.addr for nd in honest_observers if nd.addr not in flag_round]
+        if unflagged:
+            raise AssertionError(
+                f"honest nodes never flagged both anomalies: {unflagged}"
+            )
+        late = {a: r for a, r in flag_round.items() if r > 2}
+        if late:
+            raise AssertionError(
+                f"anomalies flagged later than round 2 on: {late}"
+            )
+        dump = victim.protocol.flight_recorder.dump_path("artifacts")
+        if not os.path.exists(dump):
+            raise AssertionError(f"killed node's flight-recorder dump missing: {dump}")
+        with open(dump) as f:
+            dump_doc = json.load(f)
+        if dump_doc.get("trigger") != "crash" or not dump_doc.get("events"):
+            raise AssertionError(f"flight-recorder dump malformed: {dump}")
+        # Digest-free interop: the opted-out node finished every round AND
+        # its observatory still assembled the fleet (ingest-only works).
+        if df_rounds != rounds:
+            raise AssertionError(
+                f"digest-free node finished {df_rounds}/{rounds} rounds"
+            )
+        if df_known < n_nodes - 2:  # fleet minus itself and the dead victim
+            raise AssertionError(
+                f"digest-free node assembled only {df_known} peers' digests"
+            )
+
+        out = {
+            "metric": "observatory_flag_latency_8node_chaos",
+            "value": max(flag_round.values()),
+            "unit": "rounds_to_flag_both_anomalies_worst_node",
+            "vs_baseline": None,
+            "extra": {
+                "nodes": n_nodes,
+                "rounds": rounds,
+                "drop_rate": drop,
+                "seed": seed,
+                "wall_s": round(wall_s, 2),
+                "straggler": straggler.addr,
+                "byzantine": byzantine.addr,
+                "digest_free": digest_free.addr,
+                "killed": victim.addr,
+                "flag_round_by_observer": flag_round,
+                "injected_faults": faults,
+                "flightrec_dump": dump,
+                "flightrec_events": len(dump_doc.get("events", [])),
+                "federation_snapshot": snap_path,
+                "digest_free_peers_known": df_known,
+                "note": "flag = observer's top straggler AND top suspect "
+                "match the seeded anomalies, derived ONLY from gossiped "
+                "health digests; digest-free node proves wire compat",
+            },
+        }
+        with open(os.path.join("artifacts", "OBSERVATORY_BENCH.json"), "w") as f:
+            json.dump(out, f, indent=1)
+        _phase(
+            f"observatory bench done: worst flag round "
+            f"{max(flag_round.values())}, {len(flag_round)} observers"
+        )
+    except Exception as e:  # noqa: BLE001
+        traceback.print_exc(file=sys.stderr)
+        out["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out), flush=True)
+    os._exit(1 if "error" in out else 0)
+
+
 def run_telemetry_bench() -> None:
     """Subprocess-style mode ``--telemetry``: run an 8-node in-memory MNIST
     federation (sparse delta wire path, so codec metrics engage) with the
@@ -2510,6 +2748,8 @@ if __name__ == "__main__":
         run_wire_bench()
     elif "--telemetry" in sys.argv:
         run_telemetry_bench()
+    elif "--observatory" in sys.argv:
+        run_observatory_bench()
     elif "--chaos" in sys.argv:
         run_chaos_bench()
     elif "--byzantine" in sys.argv:

@@ -59,8 +59,10 @@ log = logging.getLogger("p2pfl_tpu")
 
 _REJECTED = REGISTRY.counter(
     "p2pfl_updates_rejected_total",
-    "Inbound model-plane frames rejected by wire admission control, by reason",
-    labels=("node", "reason"),
+    "Inbound model-plane frames rejected by wire admission control, by "
+    "reason and claimed sender (the observatory's suspect score sums the "
+    "source attribution across the fleet's gossiped digests)",
+    labels=("node", "reason", "source"),
 )
 _CLAMPED = REGISTRY.counter(
     "p2pfl_claimed_samples_clamped_total",
@@ -101,13 +103,21 @@ class AdmissionController:
         # debug so a gossip loop re-shipping a rejected frame every 100ms
         # cannot flood the log.
         self._warned: Set[Tuple[str, str]] = set()
+        # Optional flight recorder (set by Node): every rejection becomes a
+        # postmortem event alongside the metric.
+        self.recorder: Optional[Any] = None
 
     # --- accounting ----------------------------------------------------------
 
     def record(self, reason: str, source: str = "?", cmd: str = "?") -> str:
         """Count (and log) one rejection; returns ``reason`` so handlers can
-        ``return admission.record(...)``-style early-exit."""
-        _REJECTED.labels(self._addr, reason).inc()
+        ``return admission.record(...)``-style early-exit. The ``source``
+        label is the frame's CLAIMED sender (unauthenticated, like
+        everything else on this wire) — per-sender attribution feeds the
+        observatory's suspect score via the gossiped digest."""
+        _REJECTED.labels(self._addr, reason, source).inc()
+        if self.recorder is not None:
+            self.recorder.record("reject", reason=reason, source=source, cmd=cmd)
         key = (source, reason)
         msg = "(%s) rejected %s frame from %s: reason=%s"
         if key in self._warned:

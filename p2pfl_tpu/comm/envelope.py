@@ -35,6 +35,12 @@ class Envelope:
     # control arg (weights frames carry it in the PFLT header instead —
     # telemetry/tracing.py module docstring).
     trace: str = ""
+    # Piggybacked health digest (telemetry/digest.py encoded JSON, normally
+    # only on heartbeats). Same wire story as ``trace``: native on the
+    # in-memory transport, a reserved trailing control arg on gRPC. Empty =
+    # absent, and absent digests MUST be tolerated by every receiver —
+    # digest-free (older or opted-out) nodes share the wire.
+    digest: str = ""
 
     @property
     def is_weights(self) -> bool:

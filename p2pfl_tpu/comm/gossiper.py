@@ -70,10 +70,14 @@ class Gossiper:
         self_addr: str,
         send_fn: Callable[[str, Envelope], None],
         get_direct_neighbors_fn: Callable[[], List[str]],
+        recorder: Optional[Any] = None,
     ) -> None:
         self._self_addr = self_addr
         self._send = send_fn
         self._get_direct = get_direct_neighbors_fn
+        # Optional flight recorder (comm/protocol.py wires its own): model-
+        # plane sends and gossip give-ups become postmortem events.
+        self._recorder = recorder
         self._pending: deque[Tuple[Envelope, List[str]]] = deque()
         self._pending_lock = threading.Lock()
         self._processed: "OrderedDict[int, None]" = OrderedDict()
@@ -107,7 +111,7 @@ class Gossiper:
 
     # --- wire accounting ----------------------------------------------------
 
-    def _record_tx(self, env: Envelope) -> None:
+    def _record_tx(self, env: Envelope, nei: str = "") -> None:
         if env.payload is None:
             return
         with self._tx_lock:
@@ -116,6 +120,11 @@ class Gossiper:
             row[1] += len(env.payload)
         _TX_FRAMES.labels(self._self_addr, env.cmd, env.round).inc()
         _TX_BYTES.labels(self._self_addr, env.cmd, env.round).inc(len(env.payload))
+        if self._recorder is not None:
+            self._recorder.record(
+                "send", cmd=env.cmd, peer=nei,
+                round=env.round, bytes=len(env.payload),
+            )
 
     def wire_stats(self) -> Dict[Tuple[str, int], Tuple[int, int]]:
         """Copy of the model-plane TX table: (cmd, round) -> (frames, bytes)."""
@@ -226,6 +235,10 @@ class Gossiper:
                         self._self_addr, equal_rounds, candidates,
                     )
                     _ABANDONED.labels(self._self_addr).inc()
+                    if self._recorder is not None:
+                        self._recorder.record(
+                            "gossip_abandoned", candidates=list(candidates)
+                        )
                     return
             else:
                 equal_rounds = 0
@@ -239,7 +252,7 @@ class Gossiper:
                     continue
                 try:
                     self._send(nei, env)
-                    self._record_tx(env)
+                    self._record_tx(env, nei)
                 except ProtocolNotStartedError:
                     return  # protocol stopping under us — normal shutdown
                 except Exception:

@@ -29,6 +29,7 @@ from p2pfl_tpu.comm.neighbors import Neighbors
 from p2pfl_tpu.comm.protocol import CommunicationProtocol
 from p2pfl_tpu.config import Settings
 from p2pfl_tpu.exceptions import CommunicationError
+from p2pfl_tpu.telemetry import digest as digest_mod
 from p2pfl_tpu.telemetry import tracing
 
 log = logging.getLogger("p2pfl_tpu")
@@ -47,11 +48,14 @@ def _env_to_pb(env: Envelope) -> node_pb2.Envelope:
         pb.weights.num_samples = env.num_samples
     else:
         pb.control.args.extend(env.args)
-        if env.trace:
-            # Reserved trailing arg: the schema predates tracing and protoc
+        if env.digest:
+            # Reserved trailing args (digest before trace, popped in reverse
+            # by _pb_to_env): the schema predates tracing/digests and protoc
             # isn't in the image to regenerate it; every receiver strips
-            # this in _pb_to_env before dispatch, and a version-skewed peer
-            # just sees one extra arg (handlers index from the front).
+            # these before dispatch, and a version-skewed peer just sees
+            # extra args (handlers index from the front).
+            pb.control.args.append(digest_mod.WIRE_ARG_PREFIX + env.digest)
+        if env.trace:
             pb.control.args.append(tracing.WIRE_ARG_PREFIX + env.trace)
         pb.control.ttl = env.ttl
         pb.control.msg_id = env.msg_id
@@ -72,6 +76,9 @@ def _pb_to_env(pb: node_pb2.Envelope) -> Envelope:
     trace = ""
     if args and args[-1].startswith(tracing.WIRE_ARG_PREFIX):
         trace = args.pop()[len(tracing.WIRE_ARG_PREFIX):]
+    digest = ""
+    if args and args[-1].startswith(digest_mod.WIRE_ARG_PREFIX):
+        digest = args.pop()[len(digest_mod.WIRE_ARG_PREFIX):]
     return Envelope(
         source=pb.source,
         cmd=pb.cmd,
@@ -80,6 +87,7 @@ def _pb_to_env(pb: node_pb2.Envelope) -> Envelope:
         ttl=int(pb.control.ttl),
         msg_id=int(pb.control.msg_id),
         trace=trace,
+        digest=digest,
     )
 
 

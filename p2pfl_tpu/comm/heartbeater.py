@@ -11,6 +11,13 @@ per-peer clock-skew gauge — in-process federations read ~0, a real
 deployment surfaces NTP drift, the thing that silently breaks timeout-based
 failure detection — plus a beat inter-arrival gauge (receive-side jitter),
 a live-peer gauge and a missed-beat counter.
+
+Observatory piggyback: when a digest source is wired (``digest_fn``) and
+``Settings.DIGEST_ENABLED``, every ``DIGEST_EVERY_BEATS``-th beat carries
+the node's encoded health digest in ``Envelope.digest`` — the heartbeat was
+already the one frame every peer sees periodically, so fleet observability
+rides it for free. Beats without a digest stay byte-identical to the
+pre-digest wire format.
 """
 
 from __future__ import annotations
@@ -57,14 +64,22 @@ class Heartbeater:
         self_addr: str,
         neighbors: Neighbors,
         broadcast_fn: Callable[[Envelope], None],
+        digest_fn: Optional[Callable[[], Optional[str]]] = None,
     ) -> None:
         self._self_addr = self_addr
         self._neighbors = neighbors
         self._broadcast = broadcast_fn
+        # Returns the node's ENCODED health digest (or None to skip this
+        # beat). Settable after construction (protocol.set_digest_source);
+        # None keeps beats digest-free — the pre-observatory wire format.
+        self._digest_fn = digest_fn
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_beat_at: Dict[str, float] = {}  # peer -> local monotonic
         self._live_peers = _LIVE_PEERS.labels(self_addr)
+
+    def set_digest_source(self, digest_fn: Optional[Callable[[], Optional[str]]]) -> None:
+        self._digest_fn = digest_fn
 
     def start(self) -> None:
         self._stop.clear()
@@ -101,6 +116,17 @@ class Heartbeater:
                 env = Envelope.message(
                     self._self_addr, HEARTBEAT_CMD, args=[str(time.time())]
                 )
+                if (
+                    self._digest_fn is not None
+                    and Settings.DIGEST_ENABLED
+                    and tick % Settings.DIGEST_EVERY_BEATS == 0
+                ):
+                    try:
+                        env.digest = self._digest_fn() or ""
+                    except Exception:  # digest trouble must not stop the beat
+                        log.exception(
+                            "(%s) health-digest source failed", self._self_addr
+                        )
                 self._broadcast(env)
             except Exception:
                 pass

@@ -110,4 +110,7 @@ class InMemoryCommunicationProtocol(CommunicationProtocol):
         if peer is None:
             raise CommunicationError(f"no in-memory server at {nei}")
         # Copy the envelope so receivers can't mutate the sender's view.
+        # The trace and digest slots travel natively (str fields copied by
+        # replace); the gRPC transport maps them onto reserved trailing
+        # control args instead — same wire semantics either way.
         peer.deliver(replace(env, args=list(env.args), contributors=list(env.contributors)))

@@ -31,6 +31,9 @@ from p2pfl_tpu.exceptions import (
     ProtocolNotStartedError,
 )
 from p2pfl_tpu.telemetry import REGISTRY, TRACER
+from p2pfl_tpu.telemetry import digest as digest_mod
+from p2pfl_tpu.telemetry.flight_recorder import FlightRecorder
+from p2pfl_tpu.telemetry.observatory import Observatory
 
 log = logging.getLogger("p2pfl_tpu")
 
@@ -82,13 +85,30 @@ class CommunicationProtocol:
         self._running = False
         self._lock = threading.Lock()
         self.dispatcher = CommandDispatcher()
+        # Federation observatory + flight recorder (telemetry/): the
+        # observatory assembles peers' heartbeat-piggybacked health digests
+        # into a fleet view; the recorder keeps the postmortem event ring.
+        self.observatory = Observatory(self._addr)
+        self.flight_recorder = FlightRecorder(self._addr)
+        # Digest source: returns this node's HealthDigest for the next beat.
+        # The default sees only the registry; Node swaps in a state-aware
+        # provider (round/stage); None disables emission entirely (the node
+        # stays wire-compatible — its beats are simply digest-free).
+        self._digest_provider: Optional[Callable[[], Optional[digest_mod.HealthDigest]]] = (
+            lambda: digest_mod.collect(self._addr)
+        )
         self.neighbors = self._build_neighbors(self._addr)
         self.gossiper = Gossiper(
             self._addr,
             send_fn=self._safe_send,
             get_direct_neighbors_fn=lambda: self.neighbors.get_all(only_direct=True),
+            recorder=self.flight_recorder,
         )
-        self.heartbeater = Heartbeater(self._addr, self.neighbors, self.broadcast)
+        self.heartbeater = Heartbeater(
+            self._addr, self.neighbors, self.broadcast, digest_fn=self._digest_wire
+        )
+        # Dead peers leave the fleet view and the postmortem record together.
+        self.neighbors.add_removal_listener(self._observe_peer_removed)
         # auto-register the heartbeat handler (reference
         # grpc_communication_protocol.py:63-89)
         protocol = self
@@ -103,6 +123,51 @@ class CommunicationProtocol:
                 protocol.heartbeater.beat(source, ts)
 
         self.dispatcher.register([_BeatCommand()])
+
+    # --- observatory / flight recorder --------------------------------------
+
+    def set_digest_source(
+        self, provider: Optional[Callable[[], Optional[digest_mod.HealthDigest]]]
+    ) -> None:
+        """Install the health-digest provider piggybacked on heartbeats
+        (``None`` disables emission — the node keeps interoperating, its
+        beats are just digest-free)."""
+        self._digest_provider = provider
+
+    def _digest_wire(self) -> Optional[str]:
+        """Encoded digest for the next beat (None = skip). The self view
+        rides the same ingest path as peers' digests, so the local fleet
+        snapshot always includes this node."""
+        provider = self._digest_provider
+        if provider is None:
+            return None
+        dig = provider()
+        if dig is None:
+            return None
+        self.observatory.ingest(dig)
+        return dig.encode()
+
+    def _ingest_digest(self, env: Envelope) -> None:
+        dig = digest_mod.decode(env.digest)
+        if dig is None:
+            log.debug("(%s) undecodable digest from %s ignored", self._addr, env.source)
+            return
+        if dig.node != env.source:
+            # A digest must describe its sender; a mismatch is either a bug
+            # or spoofed attribution — drop it (beats stay valid either way).
+            log.debug(
+                "(%s) digest node %s != envelope source %s — ignored",
+                self._addr, dig.node, env.source,
+            )
+            return
+        if self.observatory.ingest(dig):
+            self.flight_recorder.record(
+                "digest", peer=dig.node, round=dig.round, stage=dig.stage
+            )
+
+    def _observe_peer_removed(self, addr: str) -> None:
+        self.observatory.forget(addr)
+        self.flight_recorder.record("peer_lost", peer=addr)
 
     # --- transport hooks ----------------------------------------------------
 
@@ -159,6 +224,10 @@ class CommunicationProtocol:
         if not self._running:
             return
         self._running = False
+        # Postmortem FIRST, while the ring still holds the final moments —
+        # the teardown below emits nothing worth recording.
+        self.flight_recorder.record("crash")
+        self.flight_recorder.dump("crash")
         self.heartbeater.stop()
         self.gossiper.stop()
         self.neighbors.clear(notify=False)
@@ -250,11 +319,17 @@ class CommunicationProtocol:
                 if CHAOS.active:
                     decision = CHAOS.intercept(self._addr, nei)
                     if decision.blocked:
+                        self.flight_recorder.record(
+                            "fault", fault=decision.blocked, peer=nei, cmd=env.cmd
+                        )
                         raise CommunicationError(
                             f"chaos: link {self._addr} -> {nei} blocked "
                             f"({decision.blocked})"
                         )
                     if decision.drop:
+                        self.flight_recorder.record(
+                            "fault", fault="drop", peer=nei, cmd=env.cmd
+                        )
                         return  # injected loss: the sender never learns
                     if decision.delay_s > 0.0:
                         time.sleep(decision.delay_s)
@@ -281,6 +356,9 @@ class CommunicationProtocol:
                     continue
                 if remove_on_error:
                     _PEER_WRITTEN_OFF.labels(self._addr).inc()
+                    self.flight_recorder.record(
+                        "peer_written_off", peer=nei, cmd=env.cmd, error=str(exc)[:200]
+                    )
                     if attempts > 1:
                         log.warning(
                             "(%s) writing off %s after %d failed send attempts: %s",
@@ -345,6 +423,10 @@ class CommunicationProtocol:
         _RX_FRAMES.labels(self._addr, env.cmd).inc()
         if env.is_weights:
             _RX_BYTES.labels(self._addr, env.cmd).inc(len(env.payload))
+            self.flight_recorder.record(
+                "recv", cmd=env.cmd, peer=env.source,
+                round=env.round, bytes=len(env.payload),
+            )
             with TRACER.recv_span(
                 f"recv:{env.cmd}", self._addr, env.trace,
                 source=env.source, round=env.round, bytes=len(env.payload),
@@ -358,6 +440,11 @@ class CommunicationProtocol:
             return
         if not self.gossiper.check_and_set_processed(env.msg_id):
             return
+        # Piggybacked health digest (normally on beats): feed the fleet view
+        # AFTER dedup so re-gossiped copies don't re-ingest. Absent digests
+        # (older / opted-out peers) skip this entirely — wire compatibility.
+        if env.digest:
+            self._ingest_digest(env)
         with TRACER.recv_span(
             f"recv:{env.cmd}", self._addr, env.trace,
             source=env.source, round=env.round,
@@ -372,6 +459,7 @@ class CommunicationProtocol:
                 ttl=env.ttl - 1,
                 msg_id=env.msg_id,
                 trace=env.trace,  # re-gossip stays in the sender's trace
+                digest=env.digest,  # digests reach non-direct peers this way
             )
             self.gossiper.add_message(fwd)
 

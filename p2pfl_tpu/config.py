@@ -183,6 +183,22 @@ class Settings:
     LOG_LEVEL: str = _env_override("LOG_LEVEL", "INFO")
     LOG_DIR: str = _env_override("LOG_DIR", "logs")
     RESOURCE_MONITOR_PERIOD: float = _env_override("RESOURCE_MONITOR_PERIOD", 1.0)
+    # Federation observatory (telemetry/digest.py + observatory.py): each
+    # node piggybacks a compact health digest on every DIGEST_EVERY_BEATS-th
+    # heartbeat; peers assemble the digests into a fleet view with derived
+    # straggler/suspect/link scores. Disabling emission keeps the node fully
+    # wire-compatible — absent digests are tolerated by every receiver.
+    DIGEST_ENABLED: bool = _env_override("DIGEST_ENABLED", True)
+    DIGEST_EVERY_BEATS: int = _env_int("DIGEST_EVERY_BEATS", 1, 1, 1000)
+    # Flight recorder (telemetry/flight_recorder.py): bounded per-node ring
+    # of structured events, dumped to artifacts/flightrec_<node>.json on
+    # crash / aggregation-stall / workflow failure.
+    FLIGHTREC_CAPACITY: int = _env_int("FLIGHTREC_CAPACITY", 512, 1, 1 << 20)
+    # Span-buffer bound for the process-wide tracer (telemetry/tracing.py):
+    # oldest spans are evicted past this (counted in
+    # p2pfl_trace_spans_dropped_total) so multi-day experiments cannot grow
+    # the span tree without limit.
+    TRACE_MAX_SPANS: int = _env_int("TRACE_MAX_SPANS", 65536, 256, 1 << 22)
 
     # --- TPU execution ------------------------------------------------------
     # Default dtype for training compute. bfloat16 feeds the MXU at full rate;

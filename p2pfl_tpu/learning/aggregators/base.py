@@ -24,7 +24,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 from p2pfl_tpu.config import Settings
 from p2pfl_tpu.models.model_handle import ModelHandle
@@ -76,6 +76,11 @@ class Aggregator:
         # monotonic timestamp of the last round progress (a stored model, a
         # death-shrink, or the round opening) — drives the JIT stall patience.
         self._last_progress = time.monotonic()
+        # Optional stall hook (set by Node): called with the missing-
+        # contributor list when the JIT stall patience fires — the trigger
+        # that dumps the flight recorder, because a stalled aggregation is
+        # exactly the postmortem the event ring exists for.
+        self.on_stall: Optional[Callable[[List[str]], None]] = None
 
     # --- learner integration -------------------------------------------------
 
@@ -205,11 +210,17 @@ class Aggregator:
                     )
                 if stalled:
                     _AGG_STALL.labels(self.node_addr).inc()
+                    missing = self.get_missing_models()
                     log.warning(
                         "(%s) aggregation stalled for %.1fs with %s still "
                         "missing — JIT-aggregating what arrived",
-                        self.node_addr, patience, self.get_missing_models(),
+                        self.node_addr, patience, missing,
                     )
+                    if self.on_stall is not None:
+                        try:
+                            self.on_stall(missing)
+                        except Exception:  # a hook bug must not break the round
+                            log.exception("(%s) on_stall hook failed", self.node_addr)
                     break
         _AGG_WAIT.labels(self.node_addr).observe(time.perf_counter() - t0)
         with self._lock:

@@ -107,6 +107,19 @@ class Node:
         # instead of sleeping out their fixed timeouts.
         self.protocol.on_neighbor_removed(self._on_peer_death)
 
+        # Federation observatory: replace the protocol's registry-only
+        # digest source with the state-aware one (round/stage/total_rounds
+        # only the node knows), wire admission rejections and aggregation
+        # stalls into the flight recorder, and dump the ring when the stall
+        # patience fires — that stall IS the postmortem worth keeping.
+        from p2pfl_tpu.telemetry import digest as _digest
+
+        self.protocol.set_digest_source(
+            lambda: _digest.collect(self.addr, self.state)
+        )
+        self.state.admission.recorder = self.protocol.flight_recorder
+        self.aggregator.on_stall = self._on_aggregation_stall
+
         # Register the command handlers (reference node.py:121-134).
         self.protocol.add_command(
             [
@@ -128,6 +141,12 @@ class Node:
     @property
     def addr(self) -> str:
         return self.protocol.get_address()
+
+    @property
+    def observatory(self):
+        """This node's federation observatory (fleet view assembled from
+        peers' gossiped health digests — telemetry/observatory.py)."""
+        return self.protocol.observatory
 
     def __repr__(self) -> str:
         return f"Node({self.addr}, running={self._running})"
@@ -303,6 +322,14 @@ class Node:
         return self._workflow
 
     # --- round survival ------------------------------------------------------
+
+    def _on_aggregation_stall(self, missing: List[str]) -> None:
+        """JIT stall patience fired: the round is limping. Record and dump
+        the flight recorder — the ring currently holds exactly the events
+        (sends, rejections, faults, peer deaths) that explain the stall."""
+        rec = self.protocol.flight_recorder
+        rec.record("agg_stall", missing=list(missing), round=self.state.round)
+        rec.dump("stall")
 
     def _on_peer_death(self, addr: str) -> None:
         """Death callback (runs on the heartbeater/transport thread that

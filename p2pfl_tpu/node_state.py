@@ -62,6 +62,10 @@ class NodeState:
         # context (telemetry/tracing.py). None -> the workflow opens a
         # fresh local trace.
         self.trace_id: Optional[str] = None
+        # Stage the workflow is currently executing ("" outside a session) —
+        # gossiped to the fleet in the node's health digest so peers can see
+        # WHERE a stalled node is stuck, not just that it lags.
+        self.current_stage: str = ""
 
         # Learning info (populated by commands / stages).
         self.models_aggregated: Dict[str, List[str]] = {}

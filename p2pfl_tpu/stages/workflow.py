@@ -52,10 +52,16 @@ class LearningWorkflow:
                 trace_id=node.state.trace_id,  # None -> fresh trace
                 experiment=exp.exp_name if exp is not None else None,
             ):
+                recorder = node.protocol.flight_recorder
                 while stage is not None:
                     self.history.append(stage.name)
                     log.debug("%s: stage %s", node.addr, stage.name)
                     name = stage.name
+                    # Visible to the fleet: the next health digest carries
+                    # the stage, and the transition lands in the postmortem
+                    # ring — "where was node 5 when it stalled" is answerable.
+                    node.state.current_stage = name
+                    recorder.record("stage", stage=name, round=node.state.round)
                     t0 = time.perf_counter()
                     with TRACER.span(name, node=node.addr, round=node.state.round):
                         stage = stage.execute(node)
@@ -70,4 +76,10 @@ class LearningWorkflow:
             log.info("%s: protocol stopped mid-workflow — aborting learning", node.addr)
         except Exception:
             log.exception("%s: workflow crashed", node.addr)
+            # The failure the flight recorder exists for: dump the ring
+            # before the daemon thread dies with the evidence.
+            node.protocol.flight_recorder.record("workflow_crash")
+            node.protocol.flight_recorder.dump("workflow_crash")
             raise
+        finally:
+            node.state.current_stage = ""

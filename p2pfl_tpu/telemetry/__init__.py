@@ -15,6 +15,15 @@ framework can import them without cycles:
 
 Export surfaces live in :mod:`p2pfl_tpu.telemetry.export`: Prometheus text
 exposition and a JSON snapshot of the registry.
+
+The federation observatory builds on both halves:
+
+* :mod:`p2pfl_tpu.telemetry.digest` — the versioned per-node health digest
+  piggybacked on heartbeats (``Envelope.digest``),
+* :mod:`p2pfl_tpu.telemetry.observatory` — the per-node fleet view with
+  derived straggler / suspect / link scores (``p2pfl_fed_*`` section),
+* :mod:`p2pfl_tpu.telemetry.flight_recorder` — the bounded postmortem
+  event ring dumped to ``artifacts/flightrec_<node>.json`` on failure.
 """
 
 from p2pfl_tpu.telemetry.metrics import (  # noqa: F401

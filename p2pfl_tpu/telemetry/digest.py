@@ -1,0 +1,244 @@
+"""Gossiped health digests: one node's vitals, compact enough to ride a beat.
+
+In a decentralized federation there is no coordinator to scrape the
+telemetry registry (PR 2), so every node's rich local view is trapped in its
+own process. The fix is to make observability itself ride the membership
+wire: each node periodically snapshots a :class:`HealthDigest` — current
+round/stage, learner throughput, wire traffic, aggregation progress,
+admission rejections (attributed per sender), chaos faults, device memory —
+and piggybacks it on the heartbeat it was already broadcasting.
+
+Wire format: the encoded digest travels in ``Envelope.digest`` (carried
+natively by the in-memory transport; the gRPC transport maps it onto a
+reserved trailing control arg with :data:`WIRE_ARG_PREFIX`, exactly like
+``Envelope.trace`` — see ``grpc_protocol._env_to_pb``). The payload itself
+is versioned compact JSON:
+
+* **absent digests are fine** — a digest-free (older) node's beats dispatch
+  unchanged, and its peers simply have no fleet entry for it;
+* **unknown versions are tolerated** — :func:`decode` keeps every field it
+  recognizes and ignores the rest, so a newer node's digest still feeds an
+  older observatory instead of breaking membership.
+
+The federation-wide assembly of these digests lives in
+:mod:`p2pfl_tpu.telemetry.observatory`.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, Optional
+
+from p2pfl_tpu.telemetry.metrics import REGISTRY
+
+log = logging.getLogger("p2pfl_tpu")
+
+#: Bump when the digest schema changes incompatibly. Decoders keep reading
+#: newer digests best-effort (known fields only).
+DIGEST_VERSION = 1
+
+#: Reserved prefix for the trailing gRPC control-frame digest arg (the
+#: ``__trace__:`` pattern — the proto schema predates digests and protoc is
+#: not in the image to regenerate it).
+WIRE_ARG_PREFIX = "__digest__:"
+
+#: Digest payloads above this are dropped at decode: a digest is a few
+#: hundred bytes of JSON; anything larger is corrupt or hostile (heartbeats
+#: must stay cheap — they are the failure detector).
+MAX_DIGEST_BYTES = 8192
+
+
+@dataclass
+class HealthDigest:
+    """One node's self-reported vitals at a point in time.
+
+    All counters are cumulative process-lifetime values (the observatory
+    differentiates); gauges are instantaneous. Unknown/unavailable values
+    stay at their defaults — consumers must treat 0/-1/"" as "not reported".
+    """
+
+    node: str
+    ts: float = 0.0  # sender wall clock (time.time())
+    version: int = DIGEST_VERSION
+    # Round machine.
+    round: int = -1  # -1: no experiment in progress
+    total_rounds: int = -1
+    stage: str = ""
+    # Learner.
+    steps_per_s: float = 0.0
+    jit_compile_s: float = 0.0
+    # Wire.
+    tx_bytes: float = 0.0
+    rx_bytes: float = 0.0
+    queue_depth: float = 0.0
+    # Aggregation.
+    agg_waits: int = 0  # completed aggregation waits (histogram count)
+    agg_wait_s: float = 0.0  # cumulative seconds spent waiting
+    contributors: float = 0.0  # contributors merged in the last aggregation
+    # Defense / fault planes.
+    rejections: Dict[str, float] = field(default_factory=dict)  # reason -> n
+    rejected_by_source: Dict[str, float] = field(default_factory=dict)
+    faults_seen: float = 0.0  # chaos faults injected at this node's sends
+    # Device.
+    mem_bytes: float = 0.0
+
+    # --- wire codec ---------------------------------------------------------
+
+    def encode(self) -> str:
+        """Compact JSON, stable key order (diffable in flight-recorder
+        dumps and deterministic for tests)."""
+        d = asdict(self)
+        d["v"] = d.pop("version")
+        return json.dumps(d, separators=(",", ":"), sort_keys=True)
+
+
+def decode(payload: str) -> Optional["HealthDigest"]:
+    """Best-effort decode: ``None`` for malformed/oversized payloads; for a
+    NEWER version, every recognized field is kept and the rest ignored, so
+    version skew degrades to a sparser digest instead of a dead peer entry."""
+    if not payload or len(payload) > MAX_DIGEST_BYTES:
+        return None
+    try:
+        raw = json.loads(payload)
+    except (ValueError, TypeError):
+        return None
+    if not isinstance(raw, dict) or not isinstance(raw.get("node"), str):
+        return None
+    dig = HealthDigest(node=raw["node"])
+    try:
+        dig.version = int(raw.get("v", raw.get("version", DIGEST_VERSION)))
+    except (TypeError, ValueError):
+        dig.version = DIGEST_VERSION
+    for name, kind in (
+        ("ts", float), ("round", int), ("total_rounds", int), ("stage", str),
+        ("steps_per_s", float), ("jit_compile_s", float),
+        ("tx_bytes", float), ("rx_bytes", float), ("queue_depth", float),
+        ("agg_waits", int), ("agg_wait_s", float), ("contributors", float),
+        ("faults_seen", float), ("mem_bytes", float),
+    ):
+        v = raw.get(name)
+        if v is None:
+            continue
+        try:
+            setattr(dig, name, kind(v))
+        except (TypeError, ValueError):
+            pass  # a newer version may have retyped the field — keep default
+    for name in ("rejections", "rejected_by_source"):
+        v = raw.get(name)
+        if isinstance(v, dict):
+            table = {}
+            for k, n in v.items():
+                try:
+                    table[str(k)] = float(n)
+                except (TypeError, ValueError):
+                    continue
+            setattr(dig, name, table)
+    return dig
+
+
+# --- collection -------------------------------------------------------------
+
+
+def _series_sum(name: str, node: str, group_by: Optional[str] = None) -> Any:
+    """Sum a family's series for ``node``; with ``group_by``, a dict keyed by
+    that label instead of a scalar."""
+    fam = REGISTRY.get(name)
+    if fam is None:
+        return {} if group_by else 0.0
+    if group_by:
+        out: Dict[str, float] = {}
+        for labels, child in fam.samples():
+            if labels.get("node") != node:
+                continue
+            key = labels.get(group_by, "?")
+            out[key] = out.get(key, 0.0) + child.value
+        return out
+    return sum(c.value for lbl, c in fam.samples() if lbl.get("node") == node)
+
+
+def _gauge_value(name: str, node: str) -> float:
+    fam = REGISTRY.get(name)
+    if fam is None:
+        return 0.0
+    for labels, child in fam.samples():
+        if labels.get("node") == node:
+            return float(child.value)
+    return 0.0
+
+
+def device_mem_bytes() -> float:
+    """Accelerator memory in use, best effort: backend memory stats when the
+    platform exposes them, else the sum of live jax array buffers (process-
+    wide — in-process federations share one device). 0.0 when JAX is absent
+    or the backend reports nothing."""
+    try:
+        import jax
+
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+            if stats and stats.get("bytes_in_use"):
+                return float(stats["bytes_in_use"])
+        except Exception:  # noqa: BLE001 — CPU backend has no memory_stats
+            pass
+        return float(sum(int(a.nbytes) for a in jax.live_arrays()))
+    except Exception:  # noqa: BLE001 — digest collection must never raise
+        return 0.0
+
+
+def collect(addr: str, state: Any = None) -> HealthDigest:
+    """Snapshot ``addr``'s vitals from the process-wide registry (plus the
+    node's :class:`~p2pfl_tpu.node_state.NodeState` when provided — round,
+    stage, total_rounds are state-only facts).
+
+    Cheap: a handful of locked gauge reads; called once per heartbeat
+    period. Never raises — a broken collector must not stop the beat.
+    """
+    dig = HealthDigest(node=addr, ts=time.time())
+    try:
+        if state is not None:
+            r = getattr(state, "round", None)
+            dig.round = -1 if r is None else int(r)
+            t = getattr(state, "total_rounds", None)
+            dig.total_rounds = -1 if t is None else int(t)
+            dig.stage = str(getattr(state, "current_stage", "") or "")
+        dig.steps_per_s = _gauge_value("p2pfl_learner_steps_per_second", addr)
+        dig.jit_compile_s = _gauge_value("p2pfl_learner_jit_compile_seconds", addr)
+        dig.tx_bytes = float(_series_sum("p2pfl_gossip_tx_bytes_total", addr))
+        dig.rx_bytes = float(_series_sum("p2pfl_gossip_rx_bytes_total", addr))
+        dig.queue_depth = _gauge_value("p2pfl_gossip_queue_depth", addr)
+        wait = REGISTRY.get("p2pfl_aggregation_wait_seconds")
+        if wait is not None:
+            for labels, child in wait.samples():
+                if labels.get("node") == addr:
+                    dig.agg_waits = int(child.count)
+                    dig.agg_wait_s = float(child.sum)
+                    break
+        dig.contributors = _gauge_value("p2pfl_aggregation_contributors", addr)
+        dig.rejections = _series_sum(
+            "p2pfl_updates_rejected_total", addr, group_by="reason"
+        )
+        by_source = _series_sum(
+            "p2pfl_updates_rejected_total", addr, group_by="source"
+        )
+        # "?" is the unattributed bucket (direct API calls) — not a peer.
+        by_source.pop("?", None)
+        dig.rejected_by_source = by_source
+        dig.faults_seen = float(_series_sum("p2pfl_chaos_faults_total", addr))
+        dig.mem_bytes = device_mem_bytes()
+    except Exception:  # noqa: BLE001
+        log.exception("(%s) health-digest collection failed", addr)
+    return dig
+
+
+__all__ = [
+    "DIGEST_VERSION",
+    "HealthDigest",
+    "MAX_DIGEST_BYTES",
+    "WIRE_ARG_PREFIX",
+    "collect",
+    "decode",
+    "device_mem_bytes",
+]

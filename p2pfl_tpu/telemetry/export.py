@@ -35,6 +35,8 @@ def _fmt_labels(labels: Dict[str, str], extra: Optional[Dict[str, str]] = None) 
 
 
 def _fmt_value(v: float) -> str:
+    if math.isnan(v):
+        return "NaN"
     if math.isinf(v):
         return "+Inf" if v > 0 else "-Inf"
     if v == int(v) and abs(v) < 1e15:

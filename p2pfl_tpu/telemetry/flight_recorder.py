@@ -1,0 +1,127 @@
+"""Flight recorder: a bounded ring of structured events, dumped postmortem.
+
+Every node keeps the last ``Settings.FLIGHTREC_CAPACITY`` notable events —
+stage transitions, model-plane sends/recvs, admission rejections, injected
+chaos faults, peer deaths, digest deltas — cheaply in memory. Nobody reads
+it while things work; when a node crashes (``Node.crash()``, a workflow
+exception) or the aggregation stall patience fires, the ring dumps to
+``artifacts/flightrec_<node>.json`` so the postmortem for exactly the
+failures PR 3's chaos plane injects is a file, not N processes' interleaved
+logs.
+
+Recording is a deque append under a small lock (the deque's ``maxlen``
+drops the oldest event; drops are counted in
+``p2pfl_flightrec_events_dropped_total``). Dumping never raises — a broken
+disk must not break the crash path it is documenting.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.telemetry.metrics import REGISTRY
+
+log = logging.getLogger("p2pfl_tpu")
+
+_DROPPED = REGISTRY.counter(
+    "p2pfl_flightrec_events_dropped_total",
+    "Flight-recorder events evicted by the ring bound (oldest first)",
+    labels=("node",),
+)
+_DUMPS = REGISTRY.counter(
+    "p2pfl_flightrec_dumps_total",
+    "Flight-recorder postmortem dumps written, by trigger",
+    labels=("node", "trigger"),
+)
+
+
+def _safe_name(addr: str) -> str:
+    """Address -> filesystem-safe dump-file stem ("127.0.0.1:50051" and
+    in-memory "node-3" both must map to a writable name)."""
+    return re.sub(r"[^A-Za-z0-9_.-]", "_", addr) or "node"
+
+
+class FlightRecorder:
+    """Per-node bounded event ring + postmortem dumper."""
+
+    def __init__(self, addr: str, capacity: Optional[int] = None) -> None:
+        self._addr = addr
+        cap = int(capacity if capacity is not None else Settings.FLIGHTREC_CAPACITY)
+        self._events: deque = deque(maxlen=max(1, cap))
+        self._lock = threading.Lock()
+        self._dropped = _DROPPED.labels(addr)
+        self._epoch = time.time() - time.monotonic()  # mono -> wall mapping
+
+    @property
+    def capacity(self) -> int:
+        return self._events.maxlen or 0
+
+    def record(self, kind: str, **detail: Any) -> None:
+        """Append one event. ``detail`` values must be JSON-able (strings /
+        numbers — callers pass addresses, rounds, byte counts)."""
+        ev = {"t": round(time.monotonic() + self._epoch, 6), "kind": kind}
+        ev.update(detail)
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self._dropped.inc()
+            self._events.append(ev)
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e) for e in self._events]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    # --- postmortem ----------------------------------------------------------
+
+    def dump_path(self, directory: str = "artifacts") -> str:
+        return os.path.join(directory, f"flightrec_{_safe_name(self._addr)}.json")
+
+    def dump(self, trigger: str, directory: str = "artifacts") -> Optional[str]:
+        """Write the ring (newest last) to ``flightrec_<node>.json``.
+
+        Called from crash paths and transport threads: swallows every error
+        (logged) and returns ``None`` on failure, the path on success. A
+        later dump for the same node overwrites — the freshest postmortem
+        wins.
+        """
+        try:
+            events = self.events()
+            path = self.dump_path(directory)
+            os.makedirs(directory, exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(
+                    {
+                        "node": self._addr,
+                        "trigger": trigger,
+                        "dumped_at": time.time(),
+                        "dropped_before_ring": self._dropped.value,
+                        "events": events,
+                    },
+                    f,
+                    indent=1,
+                )
+            os.replace(tmp, path)
+            _DUMPS.labels(self._addr, trigger).inc()
+            log.warning(
+                "(%s) flight recorder dumped %d events to %s (trigger=%s)",
+                self._addr, len(events), path, trigger,
+            )
+            return path
+        except Exception:  # noqa: BLE001 — never break the crash path
+            log.exception("(%s) flight-recorder dump failed", self._addr)
+            return None
+
+
+__all__ = ["FlightRecorder"]

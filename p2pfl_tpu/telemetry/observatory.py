@@ -1,0 +1,320 @@
+"""Federation observatory: assemble gossiped health digests into a fleet view.
+
+Every node runs one :class:`Observatory` (owned by its communication
+protocol). Peers' :class:`~p2pfl_tpu.telemetry.digest.HealthDigest` frames
+arrive on the heartbeat path (``CommunicationProtocol.handle_envelope``
+feeds :meth:`Observatory.ingest`); the observatory keeps the latest digest
+per peer plus enough history to derive federation-level health nobody
+reports directly:
+
+* **straggler score** — how far behind the fleet a peer is running, three
+  components summed: round lag behind the fleet-max round; the positive
+  z-score of the peer's ROUND-ENTRY LATENESS (seconds between the fleet
+  leader entering the current round and this peer entering it — persistent
+  for the whole round, unlike raw round lag, which the vote barrier erases
+  within seconds when a straggler catches up); and the positive z-score of
+  its step time against the fleet's step-time distribution (a peer in the
+  current round whose steps crawl scores high too). APPFL's server does
+  this centrally (arxiv 2409.11585); here every node derives it from
+  gossip.
+* **suspect score** — Byzantine suspicion: admission rejections the fleet
+  attributes to this peer (PR 4's ``p2pfl_updates_rejected_total`` gained a
+  ``source`` label exactly so digests can carry per-sender attribution),
+  summed across every reporting observer.
+* **link score** — local link quality to the peer: missed heartbeats and
+  clock skew, read from the heartbeater's own gauges (these are facts about
+  OUR link, so they come from the local registry, not from digests).
+
+Exports: the ``p2pfl_fed_*`` Prometheus section (refreshed on every
+ingest), :meth:`snapshot` (the JSON federation view ``scripts/fed_top.py``
+renders live) and :meth:`top` (argmax helpers the chaos bench asserts on).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from p2pfl_tpu.telemetry.digest import HealthDigest
+from p2pfl_tpu.telemetry.metrics import REGISTRY
+
+_PEER_ROUND = REGISTRY.gauge(
+    "p2pfl_fed_peer_round",
+    "Latest round a peer reported via its gossiped health digest",
+    labels=("node", "peer"),
+)
+_STRAGGLER = REGISTRY.gauge(
+    "p2pfl_fed_straggler_score",
+    "Derived straggler score per peer (round lag + positive step-time "
+    "z-score vs the fleet); higher = further behind",
+    labels=("node", "peer"),
+)
+_SUSPECT = REGISTRY.gauge(
+    "p2pfl_fed_suspect_score",
+    "Derived Byzantine-suspect score per peer (admission rejections the "
+    "fleet attributes to frames this peer sent)",
+    labels=("node", "peer"),
+)
+_LINK = REGISTRY.gauge(
+    "p2pfl_fed_link_score",
+    "Local link-quality score per peer (missed heartbeats + |clock skew|); "
+    "higher = worse link",
+    labels=("node", "peer"),
+)
+_PEERS_KNOWN = REGISTRY.gauge(
+    "p2pfl_fed_peers_known",
+    "Peers (self included) with a live health digest in the observatory",
+    labels=("node",),
+)
+_DIGESTS_RX = REGISTRY.counter(
+    "p2pfl_fed_digests_rx_total",
+    "Health digests ingested, by reporting peer",
+    labels=("node", "peer"),
+)
+
+#: A digest older than this many seconds is stale: its peer stops counting
+#: toward fleet statistics (it is probably dead and the heartbeater will
+#: sweep it; keeping its frozen round would poison the round-lag baseline).
+STALE_AFTER_S = 60.0
+
+#: Round-entry lateness below this (seconds) never contributes to the
+#: straggler score: every healthy fleet has a statistically-latest member,
+#: and sub-second entry skew is gossip jitter, not straggling.
+LATENESS_FLOOR_S = 1.0
+
+
+class Observatory:
+    """Per-node fleet view assembled from gossiped health digests.
+
+    Thread-safe: ingest runs on transport threads, snapshots on whatever
+    thread asks (bench pollers, ``fed_top`` writers, tests).
+    """
+
+    def __init__(self, addr: str) -> None:
+        self._addr = addr
+        self._lock = threading.Lock()
+        #: peer -> (digest, local-monotonic arrival time)
+        self._peers: Dict[str, Tuple[HealthDigest, float]] = {}
+        #: peer -> (round, local-monotonic time the peer's digests FIRST
+        #: reported that round) — the round-entry lateness base.
+        self._entries: Dict[str, Tuple[int, float]] = {}
+        self._peers_known = _PEERS_KNOWN.labels(addr)
+
+    # --- ingest --------------------------------------------------------------
+
+    def ingest(self, dig: HealthDigest) -> bool:
+        """Record a peer's digest (or our own — the self view rides the same
+        path). Returns True when the peer's round or stage CHANGED — the
+        signal the flight recorder logs as a digest-delta event."""
+        now = time.monotonic()
+        with self._lock:
+            prev = self._peers.get(dig.node)
+            # Out-of-order delivery (gossip re-forwarding): keep the newest
+            # by sender timestamp when both carry one.
+            if prev is not None and dig.ts and prev[0].ts and dig.ts < prev[0].ts:
+                return False
+            self._peers[dig.node] = (dig, now)
+            entry = self._entries.get(dig.node)
+            if entry is None or entry[0] != dig.round:
+                self._entries[dig.node] = (dig.round, now)
+        if dig.node != self._addr:
+            _DIGESTS_RX.labels(self._addr, dig.node).inc()
+        self._refresh()
+        return prev is None or prev[0].round != dig.round or prev[0].stage != dig.stage
+
+    def forget(self, peer: str) -> None:
+        """Drop a peer's entry (heartbeat sweep declared it dead)."""
+        with self._lock:
+            self._peers.pop(peer, None)
+            self._entries.pop(peer, None)
+        self._refresh()
+
+    # --- derived health ------------------------------------------------------
+
+    def _live(self) -> List[Tuple[HealthDigest, float]]:
+        now = time.monotonic()
+        with self._lock:
+            return [
+                (d, seen) for d, seen in self._peers.values()
+                if now - seen <= STALE_AFTER_S
+            ]
+
+    def scores(self) -> Dict[str, Dict[str, float]]:
+        """{peer: {straggler, suspect, link, round, age_s}} over live
+        digests. Scores are comparable within one observatory; the bench
+        contract is about the ARGMAX (top straggler / top suspect), not
+        absolute values."""
+        live = self._live()
+        now = time.monotonic()
+        if not live:
+            return {}
+        # Fleet baselines. Round lag is measured against the fleet-max
+        # round among live digests; step times against the fleet mean/std.
+        max_round = max(d.round for d, _ in live)
+        step_times = [1.0 / d.steps_per_s for d, _ in live if d.steps_per_s > 0]
+        mean_st = sum(step_times) / len(step_times) if step_times else 0.0
+        var_st = (
+            sum((t - mean_st) ** 2 for t in step_times) / len(step_times)
+            if step_times
+            else 0.0
+        )
+        std_st = math.sqrt(var_st)
+        # Round-entry lateness: seconds behind the FIRST peer to enter the
+        # fleet-max round. A straggler that catches up at the next vote
+        # barrier erases its round-index lag within seconds, but its late
+        # entry stays on the books for the whole round — this is what keeps
+        # the straggler score up between the transient lag windows.
+        with self._lock:
+            entries = dict(self._entries)
+        lead_entry: Optional[float] = None
+        if max_round >= 0:
+            at_max = [
+                t for r, t in entries.values() if r == max_round
+            ]
+            if at_max:
+                lead_entry = min(at_max)
+        lateness: Dict[str, float] = {}
+        for d, _ in live:
+            if d.round < 0 or lead_entry is None:
+                lateness[d.node] = 0.0
+            elif d.round == max_round:
+                lateness[d.node] = max(
+                    0.0, entries.get(d.node, (max_round, now))[1] - lead_entry
+                )
+            else:  # still hasn't entered the fleet round — clock keeps running
+                lateness[d.node] = max(0.0, now - lead_entry)
+        mean_lt = sum(lateness.values()) / len(lateness) if lateness else 0.0
+        var_lt = (
+            sum((t - mean_lt) ** 2 for t in lateness.values()) / len(lateness)
+            if lateness
+            else 0.0
+        )
+        std_lt = math.sqrt(var_lt)
+        # Suspect attribution: sum every observer's rejected_by_source.
+        attributed: Dict[str, float] = {}
+        for d, _ in live:
+            for src, n in d.rejected_by_source.items():
+                attributed[src] = attributed.get(src, 0.0) + float(n)
+        out: Dict[str, Dict[str, float]] = {}
+        for d, seen in live:
+            lag = float(max(0, max_round - d.round)) if d.round >= 0 else 0.0
+            z = 0.0
+            if d.steps_per_s > 0 and std_st > 1e-9:
+                z = max(0.0, ((1.0 / d.steps_per_s) - mean_st) / std_st)
+            lz = 0.0
+            lt = lateness.get(d.node, 0.0)
+            if std_lt > 1e-9 and lt >= LATENESS_FLOOR_S:
+                lz = max(0.0, (lt - mean_lt) / std_lt)
+            straggler = lag + lz + z
+            suspect = attributed.get(d.node, 0.0)
+            link = 0.0
+            if d.node != self._addr:
+                link = self._link_score(d.node)
+            out[d.node] = {
+                "straggler": round(straggler, 4),
+                "suspect": round(suspect, 4),
+                "link": round(link, 4),
+                "round": float(d.round),
+                "age_s": round(now - seen, 3),
+            }
+        return out
+
+    def _link_score(self, peer: str) -> float:
+        """Missed beats + |clock skew| for OUR link to ``peer`` (heartbeater
+        gauges — already computed locally, not gossiped)."""
+        score = 0.0
+        missed = REGISTRY.get("p2pfl_heartbeat_missed_total")
+        if missed is not None:
+            for labels, child in missed.samples():
+                if labels.get("node") == self._addr and labels.get("peer") == peer:
+                    score += child.value
+        skew = REGISTRY.get("p2pfl_heartbeat_clock_skew_seconds")
+        if skew is not None:
+            for labels, child in skew.samples():
+                if labels.get("node") == self._addr and labels.get("peer") == peer:
+                    score += abs(child.value)
+        return score
+
+    def top(self, metric: str) -> Optional[str]:
+        """Peer (never self) with the highest nonzero ``metric`` score —
+        ``"straggler"`` | ``"suspect"`` | ``"link"``. None when no peer
+        scores above zero (a healthy fleet has no top straggler)."""
+        best, best_score = None, 0.0
+        for peer, s in self.scores().items():
+            if peer == self._addr:
+                continue
+            if s.get(metric, 0.0) > best_score:
+                best, best_score = peer, s[metric]
+        return best
+
+    # --- export --------------------------------------------------------------
+
+    def _refresh(self) -> None:
+        """Mirror the derived view into the p2pfl_fed_* registry section."""
+        scores = self.scores()
+        for peer, s in scores.items():
+            _PEER_ROUND.labels(self._addr, peer).set(s["round"])
+            _STRAGGLER.labels(self._addr, peer).set(s["straggler"])
+            _SUSPECT.labels(self._addr, peer).set(s["suspect"])
+            if peer != self._addr:
+                _LINK.labels(self._addr, peer).set(s["link"])
+        self._peers_known.set(len(scores))
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able federation view: every live peer's latest digest plus
+        the derived scores — what ``scripts/fed_top.py`` renders."""
+        live = self._live()
+        scores = self.scores()
+        peers: Dict[str, Any] = {}
+        for d, _ in live:
+            entry = {
+                "ts": d.ts,
+                "version": d.version,
+                "round": d.round,
+                "total_rounds": d.total_rounds,
+                "stage": d.stage,
+                "steps_per_s": d.steps_per_s,
+                "jit_compile_s": d.jit_compile_s,
+                "tx_bytes": d.tx_bytes,
+                "rx_bytes": d.rx_bytes,
+                "queue_depth": d.queue_depth,
+                "agg_waits": d.agg_waits,
+                "agg_wait_s": d.agg_wait_s,
+                "contributors": d.contributors,
+                "rejections": dict(d.rejections),
+                "rejected_by_source": dict(d.rejected_by_source),
+                "faults_seen": d.faults_seen,
+                "mem_bytes": d.mem_bytes,
+                "scores": scores.get(d.node, {}),
+            }
+            peers[d.node] = entry
+        return {
+            "observer": self._addr,
+            "written_at": time.time(),
+            "peers": peers,
+            "top_straggler": self.top("straggler"),
+            "top_suspect": self.top("suspect"),
+        }
+
+    def write_snapshot(self, path: str) -> str:
+        """Atomically write :meth:`snapshot` as JSON to ``path`` (the file
+        ``fed_top.py`` polls). Returns the path."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(self.snapshot(), f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        return path
+
+    def reset(self) -> None:
+        with self._lock:
+            self._peers.clear()
+            self._entries.clear()
+        self._peers_known.set(0)
+
+
+__all__ = ["Observatory", "STALE_AFTER_S"]

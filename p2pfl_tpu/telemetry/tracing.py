@@ -36,6 +36,14 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional
 
+from p2pfl_tpu.telemetry.metrics import REGISTRY
+
+_SPANS_DROPPED = REGISTRY.counter(
+    "p2pfl_trace_spans_dropped_total",
+    "Spans evicted from the bounded tracer buffer (oldest first) — nonzero "
+    "means the exported trace is a suffix of the experiment",
+)
+
 #: PFLT weights-frame metadata key carrying the sender's wire context.
 TRACE_META_KEY = "__trace__"
 
@@ -122,7 +130,14 @@ class Tracer:
     the heartbeat clock-skew gauge surfaces.
     """
 
-    def __init__(self, max_spans: int = 65536) -> None:
+    def __init__(self, max_spans: Optional[int] = None) -> None:
+        if max_spans is None:
+            # Deferred import: config is dependency-free, but keeping the
+            # read lazy lets tests construct bespoke tracers with explicit
+            # caps without touching Settings.
+            from p2pfl_tpu.config import Settings
+
+            max_spans = Settings.TRACE_MAX_SPANS
         self._spans: deque[Span] = deque(maxlen=max_spans)
         self._lock = threading.Lock()
         self._epoch = time.perf_counter()
@@ -194,6 +209,7 @@ class Tracer:
         with self._lock:
             if len(self._spans) == self._spans.maxlen:
                 self.dropped += 1
+                _SPANS_DROPPED.inc()
             self._spans.append(span)
 
     def spans(self) -> List[Span]:

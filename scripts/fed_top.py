@@ -1,0 +1,139 @@
+"""fed_top — live terminal view of the federation observatory.
+
+``top`` for a p2p federation: renders the JSON federation snapshot a node's
+:class:`~p2pfl_tpu.telemetry.observatory.Observatory` writes
+(``Observatory.write_snapshot``; ``bench.py --observatory`` and
+``scripts/observatory_check.py`` both write ``artifacts/
+federation_snapshot.json``) as a continuously-refreshing table:
+
+    python scripts/fed_top.py                         # poll the default path
+    python scripts/fed_top.py artifacts/federation_snapshot.json --interval 1
+    python scripts/fed_top.py --once                  # one frame, no ANSI
+
+Columns: peer, reported round/total, stage, steps/s, TX/RX MiB, straggler /
+suspect / link scores (sorted worst-straggler first), digest age. The top
+straggler and top suspect are called out under the table. Stdlib-only — no
+curses, no dependencies — so it runs anywhere the repo does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict
+
+DEFAULT_PATH = os.path.join("artifacts", "federation_snapshot.json")
+
+_CLEAR = "\x1b[2J\x1b[H"
+_BOLD = "\x1b[1m"
+_RED = "\x1b[31m"
+_YELLOW = "\x1b[33m"
+_DIM = "\x1b[2m"
+_RESET = "\x1b[0m"
+
+
+def _mib(v: float) -> str:
+    return f"{v / (1 << 20):.1f}"
+
+
+def _short(addr: str, width: int = 22) -> str:
+    return addr if len(addr) <= width else "…" + addr[-(width - 1):]
+
+
+def render(snap: Dict[str, Any], color: bool = True) -> str:
+    def paint(code: str, s: str) -> str:
+        return f"{code}{s}{_RESET}" if color else s
+
+    peers = snap.get("peers", {})
+    top_straggler = snap.get("top_straggler")
+    top_suspect = snap.get("top_suspect")
+    header = (
+        f"{'PEER':<23} {'ROUND':>7} {'STAGE':<22} {'STEP/S':>8} "
+        f"{'TX MiB':>8} {'RX MiB':>8} {'STRAG':>7} {'SUSP':>7} "
+        f"{'LINK':>6} {'AGE s':>6}"
+    )
+    lines = [
+        paint(
+            _BOLD,
+            f"federation observatory — observer {snap.get('observer', '?')} "
+            f"— {len(peers)} peers",
+        ),
+        paint(_BOLD, header),
+    ]
+    rows = sorted(
+        peers.items(),
+        key=lambda kv: -(kv[1].get("scores", {}).get("straggler", 0.0)),
+    )
+    for addr, p in rows:
+        s = p.get("scores", {})
+        rnd = p.get("round", -1)
+        total = p.get("total_rounds", -1)
+        round_s = f"{rnd}/{total}" if rnd >= 0 and total >= 0 else ("-" if rnd < 0 else str(rnd))
+        row = (
+            f"{_short(addr):<23} {round_s:>7} {p.get('stage') or '-':<22.22} "
+            f"{p.get('steps_per_s', 0.0):>8.1f} {_mib(p.get('tx_bytes', 0.0)):>8} "
+            f"{_mib(p.get('rx_bytes', 0.0)):>8} {s.get('straggler', 0.0):>7.2f} "
+            f"{s.get('suspect', 0.0):>7.1f} {s.get('link', 0.0):>6.1f} "
+            f"{s.get('age_s', 0.0):>6.1f}"
+        )
+        if addr == top_suspect:
+            row = paint(_RED, row)
+        elif addr == top_straggler:
+            row = paint(_YELLOW, row)
+        lines.append(row)
+    lines.append("")
+    lines.append(
+        f"top straggler: {top_straggler or '-'}    top suspect: {top_suspect or '-'}"
+    )
+    written = snap.get("written_at")
+    if written:
+        lines.append(
+            paint(_DIM, f"snapshot written {max(0.0, time.time() - written):.1f}s ago")
+        )
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", nargs="?", default=DEFAULT_PATH,
+                    help=f"federation snapshot JSON (default {DEFAULT_PATH})")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame (no ANSI clear) and exit")
+    args = ap.parse_args()
+
+    color = sys.stdout.isatty() or not args.once
+    while True:
+        try:
+            with open(args.path) as f:
+                snap = json.load(f)
+            frame = render(snap, color=color and not args.once)
+        except FileNotFoundError:
+            frame = (
+                f"waiting for {args.path} — run a federation that writes the "
+                "snapshot (bench.py --observatory, make observatory-check, or "
+                "Observatory.write_snapshot in your own run)"
+            )
+        except (ValueError, OSError) as exc:  # mid-write / malformed
+            frame = f"unreadable snapshot at {args.path}: {exc}"
+        if args.once:
+            print(frame)
+            return 0
+        sys.stdout.write(_CLEAR + frame + "\n")
+        sys.stdout.flush()
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # fed_top | head — not an error
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -1,0 +1,151 @@
+"""CI gate for the federation observatory: 3-node in-memory federation —
+digests must propagate to every node, an injected slow peer's straggler
+score must rise to the top of the fleet view, and a killed node's flight
+recorder must dump to artifacts/. Fast, CPU-only, tier-1-safe — invoked by
+``make observatory-check``.
+
+Exit 0 when every check passes; nonzero with a reason on stderr otherwise.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import time  # noqa: E402
+
+ROUNDS = 2
+#: Per-fit extra delay for the seeded straggler; must exceed the stall
+#: patience below so the fleet JIT-aggregates without it and real round lag
+#: develops (lag is the straggler score's primary input).
+STRAGGLE_S = 5.0
+STALL_PATIENCE_S = 3.0
+WALL_BUDGET_S = 90.0
+
+
+def main() -> int:
+    from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+    from p2pfl_tpu.config import Settings
+    from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.node import Node
+    from p2pfl_tpu.telemetry import REGISTRY
+    from p2pfl_tpu.utils.utils import set_test_settings, wait_convergence
+
+    set_test_settings()
+    Settings.RESOURCE_MONITOR_PERIOD = 0
+    Settings.LOG_LEVEL = "WARNING"
+    Settings.TRAIN_SET_SIZE = 3
+    Settings.AGGREGATION_STALL_PATIENCE = STALL_PATIENCE_S
+    REGISTRY.reset()
+
+    n = 3
+    data = synthetic_mnist(n_train=128 * n, n_test=64)
+    parts = data.generate_partitions(n, RandomIIDPartitionStrategy)
+    nodes = [Node(mlp_model(seed=i), parts[i], batch_size=32) for i in range(n)]
+    straggler = nodes[1]
+    inner_fit = straggler.learner.fit
+
+    def slow_fit(*a, **kw):
+        time.sleep(STRAGGLE_S)
+        return inner_fit(*a, **kw)
+
+    straggler.learner.fit = slow_fit
+
+    flagged_by = set()
+    try:
+        for nd in nodes:
+            nd.start()
+        for i in range(1, n):
+            nodes[i].connect(nodes[0].addr)
+        wait_convergence(nodes, n - 1, wait=15)
+
+        # --- check 1: digests propagate on heartbeats alone -----------------
+        deadline = time.monotonic() + 15
+        addrs = {nd.addr for nd in nodes}
+        while time.monotonic() < deadline:
+            if all(set(nd.observatory.scores()) >= addrs for nd in nodes):
+                break
+            time.sleep(0.1)
+        else:
+            views = {nd.addr: sorted(nd.observatory.scores()) for nd in nodes}
+            print(f"FAIL: digests did not propagate to every node: {views}",
+                  file=sys.stderr)
+            return 1
+        print("digests propagated to all 3 nodes", file=sys.stderr)
+
+        # --- check 2: the slow peer's straggler score rises ------------------
+        t0 = time.monotonic()
+        nodes[0].set_start_learning(rounds=ROUNDS, epochs=1)
+        observers = [nd for nd in nodes if nd is not straggler]
+        finish_deadline = time.monotonic() + WALL_BUDGET_S
+        while time.monotonic() < finish_deadline:
+            for nd in observers:
+                if nd.observatory.top("straggler") == straggler.addr:
+                    flagged_by.add(nd.addr)
+            if len(flagged_by) == len(observers) and all(
+                not nd.learning_in_progress() and nd.learning_workflow is not None
+                for nd in nodes
+            ):
+                break
+            time.sleep(0.1)
+        if len(flagged_by) != len(observers):
+            missing = {nd.addr for nd in observers} - flagged_by
+            print(f"FAIL: straggler never topped the fleet view on {missing}",
+                  file=sys.stderr)
+            return 1
+        elapsed = time.monotonic() - t0
+        print(
+            f"straggler {straggler.addr} flagged by all observers "
+            f"({elapsed:.1f}s into the run)",
+            file=sys.stderr,
+        )
+        nodes[0].observatory.write_snapshot(
+            os.path.join("artifacts", "federation_snapshot.json")
+        )
+
+        # --- check 3: flight recorder dumps on kill --------------------------
+        victim = nodes[2]
+        dump_path = victim.protocol.flight_recorder.dump_path("artifacts")
+        try:
+            os.remove(dump_path)
+        except FileNotFoundError:
+            pass
+        victim.crash()
+        if not os.path.exists(dump_path):
+            print(f"FAIL: no flight-recorder dump at {dump_path} after kill",
+                  file=sys.stderr)
+            return 1
+        import json
+
+        with open(dump_path) as f:
+            doc = json.load(f)
+        if doc.get("trigger") != "crash" or not doc.get("events"):
+            print(f"FAIL: malformed flight-recorder dump: {dump_path}",
+                  file=sys.stderr)
+            return 1
+        print(
+            f"flight recorder dumped {len(doc['events'])} events to {dump_path}",
+            file=sys.stderr,
+        )
+    finally:
+        for nd in nodes:
+            try:
+                nd.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        InMemoryRegistry.reset()
+
+    print(
+        "observatory-check OK: digests propagated, straggler flagged by all "
+        f"observers, flight recorder dumped on kill ({elapsed:.1f}s run)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

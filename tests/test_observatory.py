@@ -1,0 +1,386 @@
+"""Federation observatory tests: digest wire codec (round trip, absent
+digest, unknown-version tolerance), observatory scoring against synthetic
+digests, flight-recorder ring bounds + crash dump, Prometheus label
+escaping, per-sender rejection attribution, and the heartbeat piggyback end
+to end on the in-memory transport."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from p2pfl_tpu.telemetry import REGISTRY
+from p2pfl_tpu.telemetry import digest as digest_mod
+from p2pfl_tpu.telemetry.digest import HealthDigest, collect, decode
+from p2pfl_tpu.telemetry.flight_recorder import FlightRecorder
+from p2pfl_tpu.telemetry.observatory import Observatory
+
+
+# --- digest codec ------------------------------------------------------------
+
+
+def test_digest_encode_decode_round_trip():
+    dig = HealthDigest(
+        node="mem://node-7",
+        ts=123.5,
+        round=3,
+        total_rounds=10,
+        stage="TrainStage",
+        steps_per_s=42.5,
+        jit_compile_s=1.25,
+        tx_bytes=1e6,
+        rx_bytes=2e6,
+        queue_depth=4,
+        agg_waits=3,
+        agg_wait_s=7.5,
+        contributors=5,
+        rejections={"norm": 2.0, "nonfinite": 1.0},
+        rejected_by_source={"mem://node-2": 3.0},
+        faults_seen=9.0,
+        mem_bytes=1 << 20,
+    )
+    back = decode(dig.encode())
+    assert back is not None
+    assert back == dig
+
+
+def test_digest_decode_rejects_garbage():
+    assert decode("") is None
+    assert decode("not json{") is None
+    assert decode(json.dumps([1, 2, 3])) is None
+    assert decode(json.dumps({"no_node": True})) is None
+    # Oversized payloads are dropped before parsing.
+    huge = json.dumps({"node": "n", "stage": "x" * digest_mod.MAX_DIGEST_BYTES})
+    assert decode(huge) is None
+
+
+def test_digest_unknown_version_tolerated():
+    """A NEWER digest version must decode best-effort: known fields kept,
+    unknown fields and retyped fields ignored."""
+    payload = json.dumps(
+        {
+            "v": 99,
+            "node": "mem://future",
+            "round": 5,
+            "stage": "WarpStage",
+            "steps_per_s": "not-a-number",  # retyped in v99 — must not raise
+            "frobnication_index": {"deeply": ["nested"]},  # unknown field
+            "rejections": {"norm": 1, "bad": "x"},  # partially parseable
+        }
+    )
+    dig = decode(payload)
+    assert dig is not None
+    assert dig.version == 99
+    assert dig.node == "mem://future"
+    assert dig.round == 5
+    assert dig.stage == "WarpStage"
+    assert dig.steps_per_s == 0.0  # retyped field fell back to default
+    assert dig.rejections == {"norm": 1.0}
+
+
+def test_collect_reads_registry_and_state():
+    addr = "obs-collect-node"
+    REGISTRY.gauge(
+        "p2pfl_learner_steps_per_second", "", labels=("node",)
+    ).labels(addr).set(17.0)
+    REGISTRY.counter(
+        "p2pfl_updates_rejected_total", "", labels=("node", "reason", "source")
+    ).labels(addr, "norm", "evil-peer").inc(3)
+
+    class _State:
+        round = 2
+        total_rounds = 5
+        current_stage = "TrainStage"
+
+    dig = collect(addr, _State())
+    assert dig.node == addr
+    assert dig.round == 2 and dig.total_rounds == 5
+    assert dig.stage == "TrainStage"
+    assert dig.steps_per_s == 17.0
+    assert dig.rejected_by_source == {"evil-peer": 3.0}
+    assert dig.rejections.get("norm") == 3.0
+    assert dig.ts > 0
+
+
+# --- gRPC control-arg mapping (wire compat without a server) -----------------
+
+
+def test_grpc_mapping_round_trips_digest_and_trace():
+    from p2pfl_tpu.comm.envelope import Envelope
+    from p2pfl_tpu.comm.grpc.grpc_protocol import _env_to_pb, _pb_to_env
+
+    dig = HealthDigest(node="n1", ts=1.0, round=2).encode()
+    for trace, digest in [("", ""), ("t:s", ""), ("", dig), ("t:s", dig)]:
+        env = Envelope(
+            source="n1", cmd="beat", args=["123.0"], ttl=3, msg_id=7,
+            trace=trace, digest=digest,
+        )
+        back = _pb_to_env(_env_to_pb(env))
+        assert back.args == ["123.0"], (trace, digest)
+        assert back.trace == trace
+        assert back.digest == digest
+
+
+def test_grpc_mapping_tolerates_absent_digest_from_old_peer():
+    """A pre-digest peer's frame (no reserved args at all) must decode with
+    digest == '' — wire compatibility is absence-tolerant by construction."""
+    from p2pfl_tpu.comm.grpc import node_pb2
+    from p2pfl_tpu.comm.grpc.grpc_protocol import _pb_to_env
+
+    pb = node_pb2.Envelope(source="old-node", cmd="beat")
+    pb.control.args.append("456.0")
+    pb.control.ttl = 5
+    pb.control.msg_id = 9
+    env = _pb_to_env(pb)
+    assert env.digest == "" and env.trace == ""
+    assert env.args == ["456.0"]
+
+
+# --- observatory scoring -----------------------------------------------------
+
+
+def _mk(node: str, **kw) -> HealthDigest:
+    kw.setdefault("ts", time.time())
+    return HealthDigest(node=node, **kw)
+
+
+def test_observatory_straggler_from_round_lag():
+    obs = Observatory("obs-a")
+    obs.ingest(_mk("obs-a", round=5, steps_per_s=10.0))
+    obs.ingest(_mk("peer-fast", round=5, steps_per_s=10.0))
+    obs.ingest(_mk("peer-slow", round=3, steps_per_s=10.0))
+    scores = obs.scores()
+    assert scores["peer-slow"]["straggler"] >= 2.0
+    assert scores["peer-fast"]["straggler"] < scores["peer-slow"]["straggler"]
+    assert obs.top("straggler") == "peer-slow"
+
+
+def test_observatory_straggler_from_step_time_zscore():
+    obs = Observatory("obs-b")
+    obs.ingest(_mk("obs-b", round=1, steps_per_s=100.0))
+    obs.ingest(_mk("peer-1", round=1, steps_per_s=95.0))
+    obs.ingest(_mk("peer-crawl", round=1, steps_per_s=2.0))
+    assert obs.top("straggler") == "peer-crawl"
+
+
+def test_observatory_suspect_from_fleet_attribution():
+    obs = Observatory("obs-c")
+    obs.ingest(_mk("obs-c", round=1, rejected_by_source={"peer-evil": 4.0}))
+    obs.ingest(_mk("peer-1", round=1, rejected_by_source={"peer-evil": 2.0}))
+    obs.ingest(_mk("peer-evil", round=1))
+    scores = obs.scores()
+    assert scores["peer-evil"]["suspect"] == 6.0  # summed across observers
+    assert obs.top("suspect") == "peer-evil"
+    assert obs.top("straggler") is None  # healthy round-wise fleet: no flag
+
+
+def test_observatory_forget_and_snapshot_shape():
+    obs = Observatory("obs-d")
+    obs.ingest(_mk("obs-d", round=2))
+    obs.ingest(_mk("peer-1", round=2, stage="TrainStage"))
+    snap = obs.snapshot()
+    assert snap["observer"] == "obs-d"
+    assert set(snap["peers"]) == {"obs-d", "peer-1"}
+    assert snap["peers"]["peer-1"]["stage"] == "TrainStage"
+    assert "straggler" in snap["peers"]["peer-1"]["scores"]
+    json.dumps(snap)  # must be JSON-able as-is
+    obs.forget("peer-1")
+    assert set(obs.scores()) == {"obs-d"}
+
+
+def test_observatory_ingest_reports_change_and_orders_by_ts():
+    obs = Observatory("obs-e")
+    assert obs.ingest(_mk("p", round=1, ts=10.0)) is True  # new peer
+    assert obs.ingest(_mk("p", round=1, ts=11.0)) is False  # same round/stage
+    assert obs.ingest(_mk("p", round=2, ts=12.0)) is True  # round advanced
+    # Out-of-order (older ts) must not regress the view.
+    assert obs.ingest(_mk("p", round=1, ts=5.0)) is False
+    assert obs.scores()["p"]["round"] == 2.0
+
+
+def test_observatory_exports_fed_metrics():
+    obs = Observatory("obs-f")
+    obs.ingest(_mk("obs-f", round=4))
+    obs.ingest(_mk("peer-lag", round=1))
+    fam = REGISTRY.get("p2pfl_fed_straggler_score")
+    vals = {
+        lbl["peer"]: c.value
+        for lbl, c in fam.samples()
+        if lbl["node"] == "obs-f"
+    }
+    assert vals.get("peer-lag", 0.0) >= 3.0
+    known = REGISTRY.get("p2pfl_fed_peers_known")
+    assert any(
+        c.value == 2.0 for lbl, c in known.samples() if lbl["node"] == "obs-f"
+    )
+
+
+# --- flight recorder ---------------------------------------------------------
+
+
+def test_flight_recorder_ring_bound_counts_drops():
+    rec = FlightRecorder("ring-node", capacity=8)
+    dropped0 = REGISTRY.get(
+        "p2pfl_flightrec_events_dropped_total"
+    ).labels("ring-node").value
+    for i in range(20):
+        rec.record("tick", i=i)
+    events = rec.events()
+    assert len(events) == 8
+    assert [e["i"] for e in events] == list(range(12, 20))  # oldest dropped
+    dropped = REGISTRY.get(
+        "p2pfl_flightrec_events_dropped_total"
+    ).labels("ring-node").value
+    assert dropped - dropped0 == 12
+
+
+def test_flight_recorder_dump_and_sanitized_filename(tmp_path):
+    rec = FlightRecorder("mem://node 3:99/x", capacity=16)
+    rec.record("stage", stage="TrainStage", round=1)
+    rec.record("reject", reason="norm", source="mem://evil")
+    path = rec.dump("crash", directory=str(tmp_path))
+    assert path is not None and os.path.exists(path)
+    assert os.path.basename(path) == "flightrec_mem___node_3_99_x.json"
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["trigger"] == "crash"
+    assert doc["node"] == "mem://node 3:99/x"
+    assert [e["kind"] for e in doc["events"]] == ["stage", "reject"]
+    assert all("t" in e for e in doc["events"])
+
+
+def test_flight_recorder_dump_failure_is_contained(tmp_path):
+    rec = FlightRecorder("contained-node")
+    rec.record("x")
+    blocked = tmp_path / "blocked"
+    blocked.write_text("a file, not a directory")
+    assert rec.dump("crash", directory=str(blocked)) is None  # no raise
+
+
+# --- prometheus escaping + per-sender attribution ----------------------------
+
+
+def test_prometheus_label_escaping():
+    from p2pfl_tpu.telemetry.export import render_prometheus
+    from p2pfl_tpu.telemetry.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    c = reg.counter("esc_total", 'help with \\ and newline\nhere', labels=("who",))
+    c.labels('evil"name\\with\nnewline').inc()
+    text = reg and render_prometheus(reg)
+    line = [l for l in text.splitlines() if l.startswith("esc_total{")][0]
+    assert line == 'esc_total{who="evil\\"name\\\\with\\nnewline"} 1'
+    assert line.count("\n") == 0  # one sample = one exposition line
+    help_line = [l for l in text.splitlines() if l.startswith("# HELP")][0]
+    assert "\\\\" in help_line and "\\n" in help_line
+
+
+def test_prometheus_nan_value_renders():
+    from p2pfl_tpu.telemetry.export import render_prometheus
+    from p2pfl_tpu.telemetry.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.gauge("weird_gauge").set(float("nan"))
+    assert "weird_gauge NaN" in render_prometheus(reg)
+
+
+def test_rejections_carry_source_label():
+    from p2pfl_tpu.comm.admission import AdmissionController
+
+    adm = AdmissionController("attr-node")
+    adm.record("norm", source="mem://evil-1", cmd="partial_model")
+    adm.record("norm", source="mem://evil-1", cmd="partial_model")
+    adm.record("tree", source="mem://evil-2", cmd="partial_model")
+    fam = REGISTRY.get("p2pfl_updates_rejected_total")
+    by_src = {}
+    for lbl, c in fam.samples():
+        if lbl["node"] == "attr-node":
+            by_src[(lbl["reason"], lbl["source"])] = c.value
+    assert by_src[("norm", "mem://evil-1")] == 2.0
+    assert by_src[("tree", "mem://evil-2")] == 1.0
+    # rejected_count still aggregates across sources.
+    assert adm.rejected_count("norm") == 2
+    assert adm.rejected_count() == 3
+
+
+def test_rejections_feed_flight_recorder():
+    from p2pfl_tpu.comm.admission import AdmissionController
+
+    adm = AdmissionController("attr-rec-node")
+    rec = FlightRecorder("attr-rec-node", capacity=4)
+    adm.recorder = rec
+    adm.record("nonfinite", source="mem://evil", cmd="full_model")
+    events = rec.events()
+    assert events and events[-1]["kind"] == "reject"
+    assert events[-1]["source"] == "mem://evil"
+
+
+# --- tracer span bound -------------------------------------------------------
+
+
+def test_tracer_bound_drops_oldest_and_counts():
+    from p2pfl_tpu.telemetry.tracing import Tracer
+
+    dropped_before = REGISTRY.get("p2pfl_trace_spans_dropped_total").value
+    tr = Tracer(max_spans=4)
+    for i in range(10):
+        with tr.span(f"s{i}", node="n"):
+            pass
+    spans = tr.spans()
+    assert len(spans) == 4
+    assert [s.name for s in spans] == ["s6", "s7", "s8", "s9"]
+    assert tr.dropped == 6
+    assert REGISTRY.get("p2pfl_trace_spans_dropped_total").value - dropped_before == 6
+
+
+def test_tracer_default_cap_comes_from_settings():
+    from p2pfl_tpu.config import Settings
+    from p2pfl_tpu.telemetry.tracing import Tracer
+
+    with Settings.overridden(TRACE_MAX_SPANS=1234):
+        assert Tracer()._spans.maxlen == 1234
+
+
+# --- heartbeat piggyback end-to-end (in-memory transport) --------------------
+
+
+def test_digests_ride_heartbeats_in_memory():
+    from p2pfl_tpu.comm.memory.memory_protocol import InMemoryCommunicationProtocol
+    from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+
+    a = InMemoryCommunicationProtocol()
+    b = InMemoryCommunicationProtocol()
+    c = InMemoryCommunicationProtocol()
+    c.set_digest_source(None)  # digest-free node: pre-digest wire format
+    for p in (a, b, c):
+        p.start()
+    try:
+        b.connect(a.addr)
+        c.connect(a.addr)
+        addrs = {a.addr, b.addr, c.addr}
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            # a and b must assemble each other (c emits nothing but still
+            # ingests); all three keep beating on one shared wire.
+            if (
+                set(a.observatory.scores()) >= {a.addr, b.addr}
+                and set(b.observatory.scores()) >= {a.addr, b.addr}
+                and set(c.observatory.scores()) >= {a.addr, b.addr}
+            ):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(
+                f"digest propagation failed: "
+                f"{ {p.addr: sorted(p.observatory.scores()) for p in (a, b, c)} }"
+            )
+        # The digest-free node never appears in anyone's fleet view...
+        assert c.addr not in a.observatory.scores()
+        # ...yet stays a first-class member of the federation.
+        assert c.addr in a.get_neighbors()
+        assert a.addr in c.get_neighbors()
+    finally:
+        for p in (a, b, c):
+            p.stop()
+        InMemoryRegistry.reset()

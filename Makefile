@@ -1,6 +1,6 @@
 # Convenience targets; everything also runs as the plain commands shown.
 
-.PHONY: test test-fast bench dryrun proto-check api-docs telemetry-check chaos-check byzantine-check observatory-check
+.PHONY: test test-fast bench dryrun proto-check api-docs telemetry-check chaos-check byzantine-check observatory-check perf-check
 
 test:            ## full suite on the virtual 8-device CPU mesh (~30 min, 1 core)
 	python -m pytest tests/ -q
@@ -28,6 +28,9 @@ byzantine-check: ## 3-node round with one signflip adversary; admission must rej
 
 observatory-check: ## 3-node gate: digests propagate, slow peer tops the straggler score, kill dumps the flight recorder (CPU-only)
 	JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/observatory_check.py
+
+perf-check:      ## 3-node gate: critical path produced, slow node gates it, perf_diff exit codes verified (CPU-only)
+	JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/perf_check.py
 
 api-docs:        ## regenerate docs/api.md from the live package
 	PYTHONPATH=. python scripts/gen_api_docs.py

@@ -178,6 +178,42 @@ def _phase(msg: str) -> None:
     print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
 
 
+# --- shared bench-JSON meta block ---------------------------------------------
+# Every arm stamps the same versioned meta so scripts/perf_diff.py can refuse
+# cross-schema comparisons instead of mis-diffing structurally different runs.
+BENCH_SCHEMA_VERSION = 1
+
+
+def _git_sha() -> str:
+    try:
+        proc = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            capture_output=True, text=True, cwd=REPO, timeout=10,
+        )
+        return proc.stdout.strip()[:40] if proc.returncode == 0 else ""
+    except Exception:  # noqa: BLE001 — meta must never kill a bench
+        return ""
+
+
+def _bench_meta(seed=None, backend=None) -> dict:
+    return {
+        "schema_version": BENCH_SCHEMA_VERSION,
+        "git_sha": _git_sha(),
+        "backend": backend or os.environ.get("JAX_PLATFORMS", "") or "default",
+        "seed": seed,
+        "created_at": round(time.time(), 3),
+    }
+
+
+def _emit(out: dict, seed=None, backend=None) -> None:
+    """Stamp the shared meta block, print the arm's ONE JSON line, exit."""
+    if backend is None:
+        backend = (out.get("extra") or {}).get("device_kind")
+    out.setdefault("meta", _bench_meta(seed=seed, backend=backend))
+    print(json.dumps(out), flush=True)
+    os._exit(1 if "error" in out else 0)
+
+
 def probe_backend(attempts: int = 2, timeout: float = 180.0) -> str:
     """Bounded, retried backend-init probe: a flaky TPU client must produce
     a JSON error line, not a hang or a bare rc=1 (round-1/2 failure mode)."""
@@ -760,6 +796,7 @@ def run_multihost_worker(port: int, pid: int) -> None:
         },
     }
     if pid == 0:
+        out["meta"] = _bench_meta(seed=1, backend="cpu")
         print(json.dumps(out), flush=True)
     else:
         print(f"MULTIHOST_WORKER_OK pid={pid} acc={res.test_acc[-1]:.4f}", flush=True)
@@ -874,8 +911,7 @@ def run_scale_500() -> None:
     except Exception as e:  # noqa: BLE001
         traceback.print_exc(file=sys.stderr)
         out["error"] = f"{type(e).__name__}: {e}"
-    print(json.dumps(out), flush=True)
-    os._exit(1 if "error" in out else 0)
+    _emit(out, seed=11)
 
 
 def attn_bench_body(kind: str, seqs=(1024, 2048, 4096, 8192), iters_cap: int = 65536) -> dict:
@@ -1025,8 +1061,7 @@ def run_attn_bench() -> None:
     except Exception as e:  # noqa: BLE001
         traceback.print_exc(file=sys.stderr)
         out["error"] = f"{type(e).__name__}: {e}"
-    print(json.dumps(out), flush=True)
-    os._exit(1 if "error" in out else 0)
+    _emit(out)
 
 
 def _production_mfu_row(model: str, kind: str, cost: dict, sec_per_round: float) -> dict:
@@ -1153,8 +1188,7 @@ def run_lm_mfu() -> None:
     except Exception as e:  # noqa: BLE001
         traceback.print_exc(file=sys.stderr)
         out["error"] = f"{type(e).__name__}: {e}"
-    print(json.dumps(out), flush=True)
-    os._exit(1 if "error" in out else 0)
+    _emit(out, seed=1)
 
 
 def run_cifar_bench() -> None:
@@ -1225,8 +1259,7 @@ def run_cifar_bench() -> None:
     except Exception as e:  # noqa: BLE001
         traceback.print_exc(file=sys.stderr)
         out["error"] = f"{type(e).__name__}: {e}"
-    print(json.dumps(out), flush=True)
-    os._exit(1 if "error" in out else 0)
+    _emit(out, seed=1)
 
 
 def run_wire_bench() -> None:
@@ -1344,8 +1377,7 @@ def run_wire_bench() -> None:
     except Exception as e:  # noqa: BLE001
         traceback.print_exc(file=sys.stderr)
         out["error"] = f"{type(e).__name__}: {e}"
-    print(json.dumps(out), flush=True)
-    os._exit(1 if "error" in out else 0)
+    _emit(out, backend="cpu")
 
 
 def run_chaos_bench() -> None:
@@ -1570,8 +1602,7 @@ def run_chaos_bench() -> None:
     except Exception as e:  # noqa: BLE001
         traceback.print_exc(file=sys.stderr)
         out["error"] = f"{type(e).__name__}: {e}"
-    print(json.dumps(out), flush=True)
-    os._exit(1 if "error" in out else 0)
+    _emit(out, seed=locals().get("seed"), backend="cpu")
 
 
 def run_byzantine_bench() -> None:
@@ -1885,8 +1916,7 @@ def run_byzantine_bench() -> None:
     except Exception as e:  # noqa: BLE001
         traceback.print_exc(file=sys.stderr)
         out["error"] = f"{type(e).__name__}: {e}"
-    print(json.dumps(out), flush=True)
-    os._exit(1 if "error" in out else 0)
+    _emit(out, seed=locals().get("seed"), backend="cpu")
 
 
 def run_observatory_bench() -> None:
@@ -2114,6 +2144,7 @@ def run_observatory_bench() -> None:
                 "health digests; digest-free node proves wire compat",
             },
         }
+        out["meta"] = _bench_meta(seed=seed, backend="cpu")
         with open(os.path.join("artifacts", "OBSERVATORY_BENCH.json"), "w") as f:
             json.dump(out, f, indent=1)
         _phase(
@@ -2123,8 +2154,319 @@ def run_observatory_bench() -> None:
     except Exception as e:  # noqa: BLE001
         traceback.print_exc(file=sys.stderr)
         out["error"] = f"{type(e).__name__}: {e}"
-    print(json.dumps(out), flush=True)
-    os._exit(1 if "error" in out else 0)
+    _emit(out, seed=locals().get("seed"), backend="cpu")
+
+
+def run_critical_path_bench() -> None:
+    """Subprocess-style mode ``--critical-path``: performance-attribution
+    acceptance run.
+
+    One 8-node in-memory MNIST federation over the real Node/gossip stack
+    with ONE seeded 3x-slow straggler (its ``fit`` is stretched to ~3x by
+    sleeping twice the measured fit duration, capped below the aggregation
+    deadlines; stall patience is raised so the fleet WAITS for it — the
+    straggler gates rounds instead of being abandoned). After the run the
+    federation-wide span DAG is fed to the critical-path analyzer and the
+    bench asserts the attribution contract:
+
+    * every round yields a critical path with an identified gating node,
+    * the seeded straggler is the gating node on >= 80% of round paths,
+    * the report carries per-stage wall-clock shares and the
+      train<->diffuse overlap fraction (ROADMAP item 4's before-number),
+    * the structured ``perf`` section (XLA FLOPs/bytes from the learner's
+      compiled train-epoch, compile + recompile events, windowed device
+      trace) lands in ``artifacts/CRITICAL_PATH_BENCH.json``, and
+      ``scripts/perf_diff.py`` exits 0 diffing that file against itself
+      and NONZERO against an injected 2x regression.
+
+    Shape overrides: P2PFL_TPU_CP_NODES (default 8), P2PFL_TPU_CP_ROUNDS
+    (default 5), P2PFL_TPU_CP_SEED (42), P2PFL_TPU_CP_SLOWDOWN (3.0).
+    """
+    out: dict = {}
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"  # protocol-stack bench: CPU venue
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+        from p2pfl_tpu.config import Settings
+        from p2pfl_tpu.learning.dataset import (
+            RandomIIDPartitionStrategy,
+            synthetic_mnist,
+        )
+        from p2pfl_tpu.management.profiler import perf_section
+        from p2pfl_tpu.models import mlp_model
+        from p2pfl_tpu.node import Node
+        from p2pfl_tpu.telemetry import REGISTRY, TRACER, CriticalPathAnalyzer
+        from p2pfl_tpu.utils.utils import set_test_settings, wait_convergence
+
+        n_nodes = int(os.environ.get("P2PFL_TPU_CP_NODES", "8"))
+        rounds = int(os.environ.get("P2PFL_TPU_CP_ROUNDS", "5"))
+        seed = int(os.environ.get("P2PFL_TPU_CP_SEED", "42"))
+        slowdown = float(os.environ.get("P2PFL_TPU_CP_SLOWDOWN", "3.0"))
+        set_test_settings()
+        Settings.RESOURCE_MONITOR_PERIOD = 0
+        Settings.LOG_LEVEL = "WARNING"
+        # Everyone trains, so the straggler is in every committee and its
+        # slow fit is load-bearing for every aggregation.
+        Settings.TRAIN_SET_SIZE = n_nodes
+        # The fleet must WAIT for the straggler (gating, not abandonment):
+        # stall patience sits ABOVE the stretched fit (capped at 8 s below)
+        # but below the aggregation timeout, so a genuine stall still
+        # unblocks. The observatory bench exercises the opposite regime
+        # (straggler beyond patience -> abandoned and lagging).
+        Settings.AGGREGATION_STALL_PATIENCE = 35.0
+        # Deadlines widened to match: the straggle below is up to 20 s, and
+        # a 1-core host can smear honest fits by ~10 s of scheduler noise
+        # on a bad round — gating must come from the SEEDED straggler, not
+        # from a timeout artifact.
+        Settings.VOTE_TIMEOUT = 30.0
+        Settings.AGGREGATION_TIMEOUT = 90.0
+        # A pegged 1-core host starves daemon threads for seconds at a
+        # time: the test-default 1.5 s heartbeat timeout then declares
+        # healthy peers dead mid-round (observed: a partitioned node
+        # soloing the experiment), and the 2 s gossip stall-abandon window
+        # gives up on peers that are merely descheduled. Both bounds are
+        # liveness tunables, not correctness ones — widen them so the only
+        # seeded anomaly in this bench is the straggler itself.
+        Settings.HEARTBEAT_TIMEOUT = 10.0
+        Settings.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS = 400
+        # Every node gets its own executor slot: with the cpu_count-derived
+        # default (2 on this host) fits QUEUE behind each other, so the
+        # straggler's sleep holds a slot and serializes into whichever
+        # honest node queued behind it — that node's "fit" span then
+        # inherits the straggle and steals the gating attribution.
+        Settings.EXECUTOR_MAX_WORKERS = n_nodes
+        # Continuous profiling: the windowed device trace is captured
+        # around the WARMUP fit below, not inside the measured federation
+        # (PERF_TRACE_DIR stays unset) — an open jax.profiler window traces
+        # the whole process, and on a 1-core host that overhead distorts
+        # the very round timings this bench attributes (observed: honest
+        # fits inflated ~10x while the window stayed open across the
+        # straggler's stretched fit).
+        REGISTRY.reset()
+        TRACER.reset()
+
+        _phase(
+            f"critical-path bench: {n_nodes} nodes, {rounds} rounds, "
+            f"{slowdown:.1f}x straggler"
+        )
+        # Tiny fits (128 samples -> 8 steps at 2 epochs): on a 1-core
+        # host, 8 concurrent heavy fits smear each round across many
+        # seconds of scheduler noise, which both desynchronizes the leaky
+        # vote barrier and drowns the straggle being measured. The straggle
+        # FLOOR below guarantees the margin; the fit only needs to be real.
+        data = synthetic_mnist(n_train=128 * n_nodes, n_test=128)
+        parts = data.generate_partitions(n_nodes, RandomIIDPartitionStrategy)
+        # One SHARED apply_fn across the fleet (per-node params still differ
+        # via build_copy): nodes in one process then share one XLA program —
+        # one compile total, like a real per-process deployment — instead of
+        # 8 identity-distinct compiles whose serialized first-fit costs
+        # desynchronize round 0 by more than the straggle being measured.
+        template = mlp_model(seed=0)
+        # Pre-warm the shared train/eval programs on a THROWAWAY learner:
+        # round 0 must measure federation dynamics, not one ~10 s XLA
+        # compile amplified by 8-way CPU contention (which can push the
+        # stretched straggler fit past stall patience and flip the fleet
+        # into the abandon regime this bench is not about).
+        from p2pfl_tpu.learning.learner import JaxLearner
+
+        from p2pfl_tpu.management.profiler import device_trace_window
+
+        _phase("critical-path bench: pre-warming the shared XLA programs")
+        warm = JaxLearner(
+            template.build_copy(), parts[0], self_addr="mem://warmup",
+            batch_size=32, seed=0,
+        )
+        warm.set_epochs(1)
+        with device_trace_window(
+            os.path.join("artifacts", "perf_traces"), label="warmup_fit"
+        ):
+            warm.fit()
+        warm.evaluate()
+        del warm
+        nodes = [
+            Node(
+                template.build_copy(params=mlp_model(seed=i).get_parameters()),
+                parts[i], batch_size=32,
+            )
+            for i in range(n_nodes)
+        ]
+        straggler = nodes[1]
+        inner_fit = straggler.learner.fit
+        measured_factor: list = []
+
+        def slow_fit(*a, **kw):
+            t0 = time.monotonic()
+            m = inner_fit(*a, **kw)
+            dt = time.monotonic() - t0
+            # Stretch to ~slowdown x. The 15 s floor keeps the straggle
+            # decisive on a contended 1-core host, where concurrent fits
+            # inflate any node's wall-clock by up to ~10 s of scheduler
+            # luck on a bad round (a sleeping straggler yields its core, so
+            # a purely relative stretch can vanish into that noise); the
+            # 20 s cap stays below stall patience (35 s) and the
+            # aggregation deadline (90 s) so the fleet waits for the
+            # straggler rather than abandoning it.
+            extra = min(max(dt * (slowdown - 1.0), 15.0), 20.0)
+            measured_factor.append((dt + extra) / max(dt, 1e-9))
+            time.sleep(extra)
+            return m
+
+        straggler.learner.fit = slow_fit
+
+        for nd in nodes:
+            nd.start()
+        try:
+            for i in range(1, n_nodes):
+                nodes[i].connect(nodes[0].addr)
+            wait_convergence(nodes, n_nodes - 1, wait=30)
+            t0 = time.monotonic()
+            nodes[0].set_start_learning(rounds=rounds, epochs=2)
+            deadline = time.time() + 900
+            while time.time() < deadline:
+                if all(
+                    not nd.learning_in_progress()
+                    and nd.learning_workflow is not None
+                    for nd in nodes
+                ):
+                    break
+                time.sleep(0.25)
+            else:
+                raise TimeoutError("critical-path federation did not finish")
+            wall_s = time.monotonic() - t0
+        finally:
+            for nd in nodes:
+                nd.stop()
+            InMemoryRegistry.reset()
+
+        # --- attribution ----------------------------------------------------
+        analyzer = CriticalPathAnalyzer.from_tracer(TRACER)
+        report = analyzer.report()
+        seen_rounds = analyzer.rounds()
+        missing = [r for r in range(rounds) if r not in seen_rounds]
+        if missing:
+            raise AssertionError(f"no spans for rounds {missing}")
+        gating_by_round = {
+            r: report["rounds"][str(r)]["gating_node"] for r in range(rounds)
+        }
+        unattributed = [r for r, g in gating_by_round.items() if not g]
+        if unattributed:
+            raise AssertionError(
+                f"rounds without a gating node: {unattributed}"
+            )
+        gated = sum(1 for g in gating_by_round.values() if g == straggler.addr)
+        frac = gated / rounds
+        _phase(
+            f"critical-path: straggler gates {gated}/{rounds} rounds "
+            f"({frac:.0%}); per-round {gating_by_round}"
+        )
+        if frac < 0.8:
+            # Diagnosable failure: dump every round's walk before raising.
+            for r in range(rounds):
+                rp = report["rounds"][str(r)]
+                _phase(
+                    f"  round {r}: gating={rp['gating_node']} "
+                    f"wall={rp['wall_s']:.2f} attr={rp['attributed_by_node']}"
+                )
+                for h in rp["path"]:
+                    _phase(
+                        f"    {h['start_s']:9.3f}..{h['end_s']:9.3f} "
+                        f"attr={h['attributed_s']:6.3f} {h['node'][-7:]:8s} "
+                        f"{h['name']} [{h['kind']}]"
+                    )
+            os.makedirs("artifacts", exist_ok=True)
+            with open(
+                os.path.join("artifacts", "CRITICAL_PATH_BENCH.failed.json"), "w"
+            ) as f:
+                json.dump(report, f, indent=1)
+            raise AssertionError(
+                f"straggler {straggler.addr} gates only {frac:.0%} of round "
+                f"critical paths (< 80%): {gating_by_round}"
+            )
+        overlap = report["overlap"]
+
+        # --- structured perf section ---------------------------------------
+        cost = nodes[0].learner.cost_analysis()
+        perf = perf_section(REGISTRY, cost=cost)
+        if not cost or not cost.get("flops_per_epoch"):
+            raise AssertionError(
+                f"XLA cost analysis missing from the perf section: {cost}"
+            )
+
+        mean_wall = sum(
+            report["rounds"][str(r)]["wall_s"] for r in range(rounds)
+        ) / rounds
+        out = {
+            "metric": f"critical_path_{n_nodes}node_mnist_3x_straggler",
+            "value": round(frac, 4),
+            "unit": "fraction_rounds_gated_by_straggler",
+            "vs_baseline": None,
+            "meta": _bench_meta(seed=seed, backend="cpu"),
+            "perf": perf,
+            "extra": {
+                "nodes": n_nodes,
+                "rounds": rounds,
+                "seed": seed,
+                "straggler": straggler.addr,
+                "target_slowdown_x": slowdown,
+                "measured_slowdown_x": round(
+                    sum(measured_factor) / len(measured_factor), 2
+                )
+                if measured_factor
+                else None,
+                "wall_s": round(wall_s, 2),
+                "mean_round_wall_s": round(mean_wall, 4),
+                "gating_by_round": {str(r): g for r, g in gating_by_round.items()},
+                "stage_shares": report["stage_shares"],
+                "train_diffuse_overlap_fraction": overlap[
+                    "train_diffuse_overlap_fraction"
+                ],
+                "serialized_diffuse_s": overlap["serialized_diffuse_s"],
+                "critical_path_report": report,
+                "note": "gating node = node with the largest attributed share "
+                "of each round's critical path (telemetry/critical_path.py); "
+                "overlap fraction ~0 quantifies the serialized train->gossip "
+                "headroom ROADMAP item 4 will reclaim",
+            },
+        }
+
+        # --- artifact + perf_diff exit-code demonstration -------------------
+        os.makedirs("artifacts", exist_ok=True)
+        bench_path = os.path.join("artifacts", "CRITICAL_PATH_BENCH.json")
+        with open(bench_path, "w") as f:
+            json.dump(out, f, indent=1)
+        regressed = json.loads(json.dumps(out))
+        regressed["extra"]["mean_round_wall_s"] *= 2.0
+        for node_label in regressed["perf"]["steady_state"]["step_s"]:
+            regressed["perf"]["steady_state"]["step_s"][node_label] *= 2.0
+        reg_path = os.path.join("artifacts", "CRITICAL_PATH_BENCH.regressed.json")
+        with open(reg_path, "w") as f:
+            json.dump(regressed, f, indent=1)
+        diff = os.path.join(REPO, "scripts", "perf_diff.py")
+        rc_self = subprocess.run(
+            [sys.executable, diff, bench_path, bench_path],
+            capture_output=True, text=True, cwd=REPO,
+        ).returncode
+        rc_reg = subprocess.run(
+            [sys.executable, diff, bench_path, reg_path],
+            capture_output=True, text=True, cwd=REPO,
+        ).returncode
+        if rc_self != 0:
+            raise AssertionError(f"perf_diff flagged a self-diff (rc={rc_self})")
+        if rc_reg == 0:
+            raise AssertionError("perf_diff missed an injected 2x regression")
+        out["extra"]["perf_diff_self_rc"] = rc_self
+        out["extra"]["perf_diff_regressed_rc"] = rc_reg
+        with open(bench_path, "w") as f:
+            json.dump(out, f, indent=1)
+        _phase(
+            f"critical-path bench done: {frac:.0%} gated, report at {bench_path}"
+        )
+    except Exception as e:  # noqa: BLE001
+        traceback.print_exc(file=sys.stderr)
+        out["error"] = f"{type(e).__name__}: {e}"
+    _emit(out, seed=locals().get("seed"), backend="cpu")
 
 
 def run_telemetry_bench() -> None:
@@ -2295,8 +2637,7 @@ def run_telemetry_bench() -> None:
     except Exception as e:  # noqa: BLE001
         traceback.print_exc(file=sys.stderr)
         out["error"] = f"{type(e).__name__}: {e}"
-    print(json.dumps(out), flush=True)
-    os._exit(1 if "error" in out else 0)
+    _emit(out, backend="cpu")
 
 
 def measure_reference_baseline(
@@ -2610,6 +2951,7 @@ def main() -> None:
         "unit": "s/round",
         "vs_baseline": None,
         "extra": {},
+        "meta": _bench_meta(),
     }
     best: dict = {}  # best-available complete line (the degraded fallback)
 
@@ -2750,6 +3092,8 @@ if __name__ == "__main__":
         run_telemetry_bench()
     elif "--observatory" in sys.argv:
         run_observatory_bench()
+    elif "--critical-path" in sys.argv:
+        run_critical_path_bench()
     elif "--chaos" in sys.argv:
         run_chaos_bench()
     elif "--byzantine" in sys.argv:

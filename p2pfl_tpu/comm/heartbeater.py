@@ -76,7 +76,15 @@ class Heartbeater:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_beat_at: Dict[str, float] = {}  # peer -> local monotonic
+        self._clock_skew: Dict[str, float] = {}  # peer -> our wall - theirs
         self._live_peers = _LIVE_PEERS.labels(self_addr)
+
+    def clock_skews(self) -> Dict[str, float]:
+        """Latest per-peer clock skew (our wall clock minus the sender's
+        stamped beat time, seconds). The snapshot trace export
+        (``CommunicationProtocol.export_trace``) annotates dumps with this
+        so the critical-path merge can align per-process timelines."""
+        return dict(self._clock_skew)
 
     def set_digest_source(self, digest_fn: Optional[Callable[[], Optional[str]]]) -> None:
         self._digest_fn = digest_fn
@@ -101,7 +109,9 @@ class Heartbeater:
         if timestamp > 0.0:
             # Skew folds in one-way latency; for drift detection that noise
             # floor (ms) is far below the drift that matters (seconds).
-            _CLOCK_SKEW.labels(self._self_addr, source).set(time.time() - timestamp)
+            skew = time.time() - timestamp
+            self._clock_skew[source] = skew
+            _CLOCK_SKEW.labels(self._self_addr, source).set(skew)
         now = time.monotonic()
         prev = self._last_beat_at.get(source)
         self._last_beat_at[source] = now
@@ -138,6 +148,7 @@ class Heartbeater:
                     if now - seen > Settings.HEARTBEAT_TIMEOUT:
                         _MISSED.labels(self._self_addr, addr).inc()
                         self._last_beat_at.pop(addr, None)
+                        self._clock_skew.pop(addr, None)
                         log.warning(
                             "(%s) declaring %s dead: no heartbeat for %.1fs "
                             "(timeout %.1fs)",

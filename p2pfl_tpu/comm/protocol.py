@@ -169,6 +169,34 @@ class CommunicationProtocol:
         self.observatory.forget(addr)
         self.flight_recorder.record("peer_lost", peer=addr)
 
+    def export_trace(self, path: str) -> str:
+        """Write this PROCESS's span buffer as an annotated Chrome trace.
+
+        On top of ``TRACER.export_chrome_trace()`` (which already carries
+        the wall-clock epoch anchor), the dump's ``metadata`` records this
+        node's address and its per-peer clock-skew snapshot from the
+        heartbeater — everything
+        :meth:`p2pfl_tpu.telemetry.critical_path.CriticalPathAnalyzer.
+        from_chrome_traces` needs to merge dumps from separate gRPC
+        processes onto one skew-corrected timeline. Atomic write (tmp +
+        rename) so a crash mid-dump never leaves a torn trace.
+        """
+        import json
+        import os
+
+        doc = TRACER.export_chrome_trace()
+        meta = doc.setdefault("metadata", {})
+        meta["node"] = self._addr
+        meta["peer_clock_skew_s"] = self.heartbeater.clock_skews()
+        directory = os.path.dirname(path)
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path
+
     # --- transport hooks ----------------------------------------------------
 
     def _default_addr(self) -> str:

@@ -199,6 +199,12 @@ class Settings:
     # p2pfl_trace_spans_dropped_total) so multi-day experiments cannot grow
     # the span tree without limit.
     TRACE_MAX_SPANS: int = _env_int("TRACE_MAX_SPANS", 65536, 256, 1 << 22)
+    # Continuous performance profiling (management/profiler.py): when set,
+    # the stage machine captures ONE windowed jax.profiler device trace of
+    # a fit per process under this directory (capture-once, never-raising),
+    # and MeshSimulation.run(profile_dir=...) defaults to it. Empty
+    # disables capture — the production default.
+    PERF_TRACE_DIR: str = _env_override("PERF_TRACE_DIR", "")
 
     # --- TPU execution ------------------------------------------------------
     # Default dtype for training compute. bfloat16 feeds the MXU at full rate;

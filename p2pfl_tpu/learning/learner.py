@@ -56,6 +56,32 @@ _STEPS_PER_S = REGISTRY.gauge(
     "Steady-state training steps per second",
     labels=("node",),
 )
+_RECOMPILES = REGISTRY.counter(
+    "p2pfl_learner_recompiles_total",
+    "XLA recompilations of the jitted train-epoch AFTER the node's first "
+    "compile (lowered-cache probe) — nonzero in steady state means a "
+    "retrace storm is hiding inside step time",
+    labels=("node",),
+)
+_RECOMPILE_S = REGISTRY.gauge(
+    "p2pfl_learner_recompile_seconds",
+    "Wall-clock of the most recent steady-state segment that recompiled "
+    "(compile included) — the latency spike each retrace costs",
+    labels=("node",),
+)
+
+
+def _jit_cache_size(fn: Any) -> Optional[int]:
+    """Compiled-program cache size of a ``jax.jit`` function, or ``None``
+    when this jax version exposes no probe (recompiles then go uncounted
+    rather than crashing the fit path)."""
+    probe = getattr(fn, "_cache_size", None)
+    if probe is None:
+        return None
+    try:
+        return int(probe())
+    except Exception:  # noqa: BLE001
+        return None
 
 
 class Learner(abc.ABC):
@@ -253,6 +279,12 @@ class JaxLearner(Learner):
     """
 
     SUPPORTED_CALLBACKS = ("scaffold",)
+
+    # Process-wide compiled-cache watermark for the SHARED jitted train
+    # epoch (`_train_epoch` is one static function for every in-process
+    # learner): growth across a call means that call compiled something.
+    _seen_cache_size = 0
+    _cache_probe_lock = threading.Lock()
 
     def __init__(
         self,
@@ -491,11 +523,26 @@ class JaxLearner(Learner):
                 total_steps += stop - start
                 loss_f = float(loss)  # blocks on the async dispatch
                 seg_dur = time.perf_counter() - t_seg
+                # Did this call compile? The lowered-cache watermark grows
+                # exactly when XLA traced a new program — the signal the
+                # first-compile gauge alone cannot give for RE-compiles
+                # (shape drift, weak-type flips, donated-buffer mismatches)
+                # that otherwise hide inside steady-state step time.
+                grew = 0
+                size = _jit_cache_size(type(self)._train_epoch)
+                if size is not None:
+                    with type(self)._cache_probe_lock:
+                        grew = size - type(self)._seen_cache_size
+                        if grew > 0:
+                            type(self)._seen_cache_size = size
                 if not self._jit_timed:
                     # First jitted call = XLA compile + the segment's steps;
                     # later calls hit the compile cache and time pure compute.
                     self._jit_timed = True
                     _JIT_COMPILE_S.labels(self._self_addr).set(seg_dur)
+                elif grew > 0:
+                    _RECOMPILES.labels(self._self_addr).inc(grew)
+                    _RECOMPILE_S.labels(self._self_addr).set(seg_dur)
                 else:
                     steady_time += seg_dur
                     steady_steps += stop - start
@@ -574,6 +621,57 @@ class JaxLearner(Learner):
             delta,
             nonprivate_steps=self._nonprivate_steps,
         )
+
+    def cost_analysis(self) -> Optional[Dict[str, float]]:
+        """XLA's own cost model for ONE jitted train-epoch call at this
+        learner's current shapes — FLOPs and logical bytes accessed, the
+        numbers the bench ``perf`` section exports so regressions in the
+        compiled program (not just its wall-clock) are diffable. Mirrors
+        ``MeshSimulation.round_cost_analysis``; returns ``None`` when the
+        backend exposes no cost analysis. AOT ``lower().compile()`` may
+        compile an executable the jit cache never reuses — acceptable for
+        a bench-time probe, never called on the round hot path.
+        """
+        model = self.get_model()
+        try:
+            xb, yb, wb = self.get_data().export_batches(
+                self.batch_size, train=True, seed=0
+            )
+        except Exception:  # noqa: BLE001 — no train split, no cost model
+            return None
+        params = model.params
+        opt_state = (
+            self._opt_state if self._opt_state is not None
+            else self.optimizer.init(params)
+        )
+        zeros = jax.tree.map(lambda x: jnp.zeros_like(x, dtype=jnp.float32), params)
+        xb, yb, wb = jnp.asarray(xb), jnp.asarray(yb), jnp.asarray(wb)
+        try:
+            lowered = type(self)._train_epoch.lower(
+                params, opt_state, xb, yb, wb, params, zeros, zeros,
+                jax.random.key(0),
+                apply_fn=model.apply_fn,
+                optimizer=self.optimizer,
+                fedprox_mu=self.fedprox_mu,
+                use_scaffold=self._scaffold,
+                dp_clip_norm=self.dp_clip_norm,
+                dp_noise_multiplier=self.dp_noise_multiplier,
+            )
+            ca = lowered.compile().cost_analysis()
+        except Exception:  # noqa: BLE001 — cost analysis is best-effort
+            return None
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if not ca or "flops" not in ca:
+            return None
+        steps = int(xb.shape[0])
+        flops = float(ca["flops"])
+        return {
+            "flops_per_epoch": flops,
+            "bytes_accessed_per_epoch": float(ca.get("bytes accessed", 0.0)),
+            "flops_per_step": flops / max(steps, 1),
+            "steps_per_epoch": steps,
+        }
 
     def evaluate(self) -> Dict[str, float]:
         model = self.get_model()

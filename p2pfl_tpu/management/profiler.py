@@ -1,4 +1,5 @@
-"""Profiling: host cProfile plus on-device XLA traces.
+"""Profiling: host cProfile, on-device XLA traces, and the continuous
+performance-profiling plane.
 
 Capability parity with the reference's profiling hook (yappi around the
 example run, p2pfl/examples/mnist.py:264-297 — host-side Python stacks
@@ -7,17 +8,47 @@ round loop is ONE jitted XLA program, so host profiles show a single
 opaque ``execute`` call; :func:`profile_run` therefore also captures the
 device timeline with ``jax.profiler.trace`` (per-op XLA execution, fusion
 boundaries, HBM traffic), viewable in TensorBoard / Perfetto.
+
+Continuous profiling (this PR's addition): instead of a one-shot wrapper
+the operator opts into, the running system captures its own evidence —
+
+* :func:`device_trace_window` — a bounded, never-raising
+  ``jax.profiler.trace`` window any subsystem can wrap around one unit of
+  work; ``capture_once`` labels make it safe to leave enabled (the stage
+  machine wraps ONE fit per process when ``Settings.PERF_TRACE_DIR`` is
+  set, ``MeshSimulation.run(profile_dir=...)`` wraps its first timed
+  chunk).
+* :func:`perf_section` — the structured ``perf`` block every bench JSON
+  embeds: compile events (first-compile seconds, recompile counts — the
+  retrace storms ``p2pfl_learner_jit_compile_seconds`` alone cannot see),
+  steady-state step timings, XLA ``cost_analysis`` FLOPs/bytes, and the
+  device-trace paths captured this process. ``scripts/perf_diff.py``
+  diffs two of these with noise-aware thresholds.
 """
 
 from __future__ import annotations
 
 import contextlib
 import cProfile
+import logging
 import pathlib
 import sys
+import threading
 import time
 import uuid
-from typing import Iterator, Optional
+from typing import Any, Dict, Iterator, List, Optional
+
+log = logging.getLogger("p2pfl_tpu")
+
+#: Schema version stamped into every perf section; perf_diff refuses to
+#: compare sections with different versions.
+PERF_SCHEMA_VERSION = 1
+
+# Device-trace windows captured by THIS process (paths), surfaced by
+# perf_section so bench JSONs can point at their own evidence.
+_captured_traces: List[str] = []
+_captured_labels: set = set()
+_capture_lock = threading.Lock()
 
 
 @contextlib.contextmanager
@@ -73,3 +104,116 @@ def profile_run(
             prof.dump_stats(str(path))
             info["host_profile"] = str(path)
             print(f"host profile written to {path}", file=sys.stderr)
+
+
+# --- continuous profiling -----------------------------------------------------
+
+
+@contextlib.contextmanager
+def device_trace_window(
+    trace_dir: Optional[str],
+    label: str = "window",
+    capture_once: bool = True,
+) -> Iterator[Optional[str]]:
+    """Capture a windowed ``jax.profiler`` device trace around the block.
+
+    Built to be LEFT ENABLED in production paths: a falsy ``trace_dir``
+    makes it a no-op, ``capture_once`` (default) captures only the first
+    window per ``label`` per process (a fit wrapped every round costs one
+    trace, not hundreds), and any profiler failure is logged and swallowed
+    — a broken trace backend must never break the round it was observing.
+
+    Yields the trace directory when capturing, else ``None``.
+    """
+    if not trace_dir:
+        yield None
+        return
+    with _capture_lock:
+        if capture_once and label in _captured_labels:
+            yield None
+            return
+        _captured_labels.add(label)
+    out = str(pathlib.Path(trace_dir) / label)
+    started = False
+    try:
+        import jax
+
+        pathlib.Path(out).mkdir(parents=True, exist_ok=True)
+        jax.profiler.start_trace(out)
+        started = True
+    except Exception:  # noqa: BLE001 — observation must not break the work
+        log.exception("device trace window %r failed to start", label)
+        yield None
+        return
+    try:
+        yield out
+    finally:
+        if started:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+                with _capture_lock:
+                    _captured_traces.append(out)
+            except Exception:  # noqa: BLE001
+                log.exception("device trace window %r failed to stop", label)
+
+
+def captured_device_traces() -> List[str]:
+    """Paths of device-trace windows captured by this process so far."""
+    with _capture_lock:
+        return list(_captured_traces)
+
+
+def _gauge_by_node(registry: Any, name: str) -> Dict[str, float]:
+    """Counter/gauge family -> {node label: value} (empty when absent)."""
+    fam = registry.get(name)
+    out: Dict[str, float] = {}
+    if fam is None:
+        return out
+    for labels, child in fam.samples():
+        out[labels.get("node", "")] = float(child.value)
+    return out
+
+
+def perf_section(
+    registry: Any = None,
+    cost: Optional[Dict[str, float]] = None,
+    extra: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """The structured ``perf`` block a bench JSON embeds.
+
+    Pulls compile/step telemetry out of the metrics registry (per-node
+    first-compile seconds, recompile counts, steady-state step time /
+    steps-per-second), attaches the caller's XLA ``cost_analysis`` result
+    (``flops``/``bytes_accessed`` — computed since PR 1 in
+    ``MeshSimulation.round_cost_analysis`` and ``JaxLearner.cost_analysis``
+    but never exported until now) and the device-trace windows captured by
+    this process. ``scripts/perf_diff.py`` compares two of these blocks
+    with noise-aware thresholds and exit-code semantics.
+    """
+    if registry is None:
+        from p2pfl_tpu.telemetry import REGISTRY as registry  # noqa: N811
+
+    compile_s = _gauge_by_node(registry, "p2pfl_learner_jit_compile_seconds")
+    recompiles = _gauge_by_node(registry, "p2pfl_learner_recompiles_total")
+    recompile_s = _gauge_by_node(registry, "p2pfl_learner_recompile_seconds")
+    step_s = _gauge_by_node(registry, "p2pfl_learner_step_seconds")
+    steps_per_s = _gauge_by_node(registry, "p2pfl_learner_steps_per_second")
+    section: Dict[str, Any] = {
+        "schema_version": PERF_SCHEMA_VERSION,
+        "compile": {
+            "first_compile_s": {k: round(v, 4) for k, v in compile_s.items()},
+            "recompiles_total": {k: int(v) for k, v in recompiles.items()},
+            "last_recompile_s": {k: round(v, 4) for k, v in recompile_s.items()},
+        },
+        "steady_state": {
+            "step_s": {k: round(v, 6) for k, v in step_s.items()},
+            "steps_per_s": {k: round(v, 2) for k, v in steps_per_s.items()},
+        },
+        "xla_cost": cost,
+        "device_traces": captured_device_traces(),
+    }
+    if extra:
+        section.update(extra)
+    return section

@@ -28,6 +28,7 @@ Sharding layout:
 
 from __future__ import annotations
 
+import contextlib
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -736,6 +737,7 @@ class MeshSimulation:
         checkpointer=None,
         checkpoint_every: int = 1,
         eval_every: int = 1,
+        profile_dir: Optional[str] = None,
     ) -> SimulationResult:
         """Execute ``rounds`` federated rounds on the mesh.
 
@@ -764,6 +766,11 @@ class MeshSimulation:
         test splits or deep models the per-round eval pass is pure overhead
         for throughput runs. ``SimulationResult.test_acc`` then holds only
         the evaluated rounds.
+
+        ``profile_dir`` (default ``Settings.PERF_TRACE_DIR``; empty
+        disables) captures the FIRST timed chunk as a windowed
+        ``jax.profiler`` device trace under that directory — post-warmup,
+        so the window shows steady-state per-op execution, not compile.
         """
         if self._closed:
             raise RuntimeError(
@@ -825,6 +832,11 @@ class MeshSimulation:
                     # deletes it) — rebuild the identical initial population.
                     self._reinit_population()
 
+        from p2pfl_tpu.management.profiler import device_trace_window
+
+        if profile_dir is None:
+            profile_dir = Settings.PERF_TRACE_DIR
+
         params_stack, opt_stack = self.params_stack, self.opt_stack
         c_stack, c_global = self.c_stack, self.c_global
         committees, test_loss, test_acc = [], [], []
@@ -832,11 +844,17 @@ class MeshSimulation:
         done = 0
         try:
             for i, chunk in enumerate(chunks):
-                params_stack, opt_stack, c_stack, c_global, comm, _tr, tl, ta = self._run_jit(
-                    params_stack, opt_stack, c_stack, c_global,
-                    data, jnp.int32(start + done), jnp.int32(start + rounds - 1),
-                    rounds=chunk, epochs=epochs, eval_every=eval_every,
+                window = (
+                    device_trace_window(profile_dir, label="mesh_round_chunk")
+                    if i == 0
+                    else contextlib.nullcontext()
                 )
+                with window:
+                    params_stack, opt_stack, c_stack, c_global, comm, _tr, tl, ta = self._run_jit(
+                        params_stack, opt_stack, c_stack, c_global,
+                        data, jnp.int32(start + done), jnp.int32(start + rounds - 1),
+                        rounds=chunk, epochs=epochs, eval_every=eval_every,
+                    )
                 committees.append(comm)
                 test_loss.append(tl)
                 test_acc.append(ta)

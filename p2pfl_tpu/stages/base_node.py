@@ -199,8 +199,14 @@ class TrainStage(Stage):
         if check_early_stop(node):
             return None
 
+        # Continuous profiling: with PERF_TRACE_DIR set, the first fit this
+        # process runs is captured as a windowed XLA device trace (capture-
+        # once + never-raising, so the hook is safe to leave enabled).
+        from p2pfl_tpu.management.profiler import device_trace_window
+
         with TRACER.span("fit", node=node.addr, round=state.round):
-            node.learner.fit()
+            with device_trace_window(Settings.PERF_TRACE_DIR, label="fit"):
+                node.learner.fit()
         if check_early_stop(node):
             return None
 

@@ -24,6 +24,14 @@ The federation observatory builds on both halves:
   derived straggler / suspect / link scores (``p2pfl_fed_*`` section),
 * :mod:`p2pfl_tpu.telemetry.flight_recorder` — the bounded postmortem
   event ring dumped to ``artifacts/flightrec_<node>.json`` on failure.
+
+The performance attribution plane builds on the tracer:
+
+* :mod:`p2pfl_tpu.telemetry.critical_path` — per-round critical paths
+  (gating node + span chain) over the federation span DAG, stage
+  wall-clock shares, and the train<->diffuse overlap report; merges
+  per-process trace exports with wall-clock anchors + heartbeat
+  clock-skew correction.
 """
 
 from p2pfl_tpu.telemetry.metrics import (  # noqa: F401
@@ -34,9 +42,13 @@ from p2pfl_tpu.telemetry.metrics import (  # noqa: F401
     REGISTRY,
 )
 from p2pfl_tpu.telemetry.tracing import TRACER, Tracer  # noqa: F401
+from p2pfl_tpu.telemetry.critical_path import (  # noqa: F401
+    CriticalPathAnalyzer,
+)
 
 __all__ = [
     "Counter",
+    "CriticalPathAnalyzer",
     "Gauge",
     "Histogram",
     "MetricsRegistry",

@@ -58,7 +58,6 @@ class FlightRecorder:
         self._events: deque = deque(maxlen=max(1, cap))
         self._lock = threading.Lock()
         self._dropped = _DROPPED.labels(addr)
-        self._epoch = time.time() - time.monotonic()  # mono -> wall mapping
 
     @property
     def capacity(self) -> int:
@@ -66,17 +65,35 @@ class FlightRecorder:
 
     def record(self, kind: str, **detail: Any) -> None:
         """Append one event. ``detail`` values must be JSON-able (strings /
-        numbers — callers pass addresses, rounds, byte counts)."""
-        ev = {"t": round(time.monotonic() + self._epoch, 6), "kind": kind}
+        numbers — callers pass addresses, rounds, byte counts).
+
+        Timestamps are stored on the MONOTONIC clock only; the mono->wall
+        mapping is computed when events are read (:meth:`events` /
+        :meth:`dump`), not frozen at construction — an NTP step mid-run
+        therefore shifts all reported wall times consistently instead of
+        splitting the ring across two clock eras.
+        """
+        ev = {"t_mono": round(time.monotonic(), 6), "kind": kind}
         ev.update(detail)
         with self._lock:
             if len(self._events) == self._events.maxlen:
                 self._dropped.inc()
             self._events.append(ev)
 
+    @staticmethod
+    def _mono_to_wall_epoch() -> float:
+        """CURRENT mono->wall mapping (wall seconds at monotonic 0)."""
+        return time.time() - time.monotonic()
+
     def events(self) -> List[Dict[str, Any]]:
+        """Ring contents, oldest first, with wall-clock ``t`` derived from
+        the stored monotonic stamp at READ time."""
+        epoch = self._mono_to_wall_epoch()
         with self._lock:
-            return [dict(e) for e in self._events]
+            raw = [dict(e) for e in self._events]
+        for e in raw:
+            e["t"] = round(e["t_mono"] + epoch, 6)
+        return raw
 
     def clear(self) -> None:
         with self._lock:
@@ -105,7 +122,13 @@ class FlightRecorder:
                     {
                         "node": self._addr,
                         "trigger": trigger,
+                        # Both clocks at dump time plus the mapping used for
+                        # the events' wall "t": a postmortem reader can both
+                        # line events up with other hosts' logs (wall) and
+                        # compute exact in-process gaps (mono, step-free).
                         "dumped_at": time.time(),
+                        "dumped_at_mono": time.monotonic(),
+                        "mono_to_wall_epoch": self._mono_to_wall_epoch(),
                         "dropped_before_ring": self._dropped.value,
                         "events": events,
                     },

@@ -125,9 +125,14 @@ class Tracer:
     """Bounded process-wide span buffer + span factory.
 
     All in-process nodes share one tracer (and one monotonic clock), so
-    cross-node timelines line up without clock-sync machinery; a real
-    multi-host deployment inherits whatever NTP skew the hosts have, which
-    the heartbeat clock-skew gauge surfaces.
+    cross-node timelines line up without clock-sync machinery. A real
+    multi-host deployment has one tracer PER PROCESS, each on its own
+    clock: every exported trace therefore carries a wall-clock epoch
+    anchor (:meth:`wall_epoch`), and
+    :mod:`p2pfl_tpu.telemetry.critical_path` merges per-process exports
+    onto one timeline, correcting residual NTP skew with the heartbeat
+    clock-skew gauge (``CommunicationProtocol.export_trace`` annotates
+    each dump with its node's per-peer skew snapshot).
     """
 
     def __init__(self, max_spans: Optional[int] = None) -> None:
@@ -141,7 +146,21 @@ class Tracer:
         self._spans: deque[Span] = deque(maxlen=max_spans)
         self._lock = threading.Lock()
         self._epoch = time.perf_counter()
+        # Wall clock at construction — kept for reference only; the export
+        # anchor is RECOMPUTED at export time (see wall_epoch) so an NTP
+        # step between construction and export cannot skew the mapping.
+        self._epoch_wall_at_init = time.time()
         self.dropped = 0  # spans evicted by the bound
+
+    def wall_epoch(self) -> float:
+        """Wall-clock time (epoch seconds) corresponding to span time 0.
+
+        ``span.start_s + wall_epoch()`` maps any span onto the wall clock.
+        Recomputed from the CURRENT wall clock on every call: the monotonic
+        span clock never steps, so anchoring through "now" reflects any NTP
+        corrections since construction instead of freezing the stale offset.
+        """
+        return time.time() - (time.perf_counter() - self._epoch)
 
     def new_trace_id(self) -> str:
         return new_id()
@@ -228,6 +247,13 @@ class Tracer:
         form). Nodes map to process rows via ``process_name`` metadata
         events; every span is a complete ("X") event with trace/span ids in
         ``args`` so Perfetto queries can join cross-node spans on trace id.
+
+        Events are sorted by ``(ts, pid, tid, name)`` so identical span sets
+        always export byte-identically, and the top-level ``metadata`` block
+        carries the wall-clock epoch anchor (``wall_epoch_s``: wall seconds
+        at span time 0, recomputed at export) — the key that lets
+        :mod:`p2pfl_tpu.telemetry.critical_path` merge traces exported by
+        DIFFERENT processes onto one timeline.
         """
         spans = self.spans()
         pids: Dict[str, int] = {}
@@ -251,6 +277,7 @@ class Tracer:
                     },
                 }
             )
+        events.sort(key=lambda e: (e["ts"], e["pid"], e["tid"], e["name"]))
         meta = [
             {
                 "name": "process_name",
@@ -260,7 +287,16 @@ class Tracer:
             }
             for node, pid in pids.items()
         ]
-        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+        return {
+            "traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "metadata": {
+                "wall_epoch_s": self.wall_epoch(),
+                "wall_epoch_at_init_s": self._epoch_wall_at_init,
+                "exported_at_s": time.time(),
+                "ts_unit": "us since tracer epoch (monotonic)",
+            },
+        }
 
 
 #: The process-wide tracer every subsystem records spans into.

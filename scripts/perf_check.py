@@ -1,0 +1,168 @@
+"""CI gate for the performance attribution plane: run a 3-node in-memory
+federated round with one seeded-slow node, assert that the critical-path
+analyzer produces a per-round path with an identified gating node (the slow
+node), that the structured perf section is populated, and that
+``scripts/perf_diff.py`` exits nonzero on an injected 2x regression (and
+zero on a self-diff). Fast, CPU-only, tier-1-safe — invoked by
+``make perf-check``.
+
+Exit 0 when every check passes; nonzero with a reason on stderr otherwise.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import json  # noqa: E402
+import subprocess  # noqa: E402
+import tempfile  # noqa: E402
+import time  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    import bench
+    from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+    from p2pfl_tpu.config import Settings
+    from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from p2pfl_tpu.management.profiler import perf_section
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.node import Node
+    from p2pfl_tpu.telemetry import REGISTRY, TRACER, CriticalPathAnalyzer
+    from p2pfl_tpu.utils.utils import set_test_settings, wait_convergence
+
+    set_test_settings()
+    Settings.RESOURCE_MONITOR_PERIOD = 0
+    Settings.LOG_LEVEL = "WARNING"
+    Settings.TRAIN_SET_SIZE = 3
+    Settings.AGGREGATION_STALL_PATIENCE = 60.0  # the fleet WAITS for the slow node
+    REGISTRY.reset()
+    TRACER.reset()
+
+    data = synthetic_mnist(n_train=3 * 128, n_test=64)
+    parts = data.generate_partitions(3, RandomIIDPartitionStrategy)
+    nodes = [Node(mlp_model(seed=i), parts[i], batch_size=32) for i in range(3)]
+    slow = nodes[1]
+    inner_fit = slow.learner.fit
+
+    def slow_fit(*a, **kw):
+        t0 = time.monotonic()
+        m = inner_fit(*a, **kw)
+        time.sleep(min(2.0 * (time.monotonic() - t0), 5.0) + 1.0)
+        return m
+
+    slow.learner.fit = slow_fit
+    for nd in nodes:
+        nd.start()
+    try:
+        for i in (1, 2):
+            nodes[i].connect(nodes[0].addr)
+        wait_convergence(nodes, 2, wait=15)
+        nodes[0].set_start_learning(rounds=1, epochs=1)
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if all(
+                not nd.learning_in_progress() and nd.learning_workflow is not None
+                for nd in nodes
+            ):
+                break
+            time.sleep(0.2)
+        else:
+            print("FAIL: 3-node round did not finish in 300s", file=sys.stderr)
+            return 1
+    finally:
+        for nd in nodes:
+            nd.stop()
+        InMemoryRegistry.reset()
+
+    analyzer = CriticalPathAnalyzer.from_tracer(TRACER)
+    if 0 not in analyzer.rounds():
+        print(f"FAIL: no round-0 spans (rounds={analyzer.rounds()})", file=sys.stderr)
+        return 1
+    path = analyzer.round_path(0)
+    if not path.hops:
+        print("FAIL: critical path is empty for round 0", file=sys.stderr)
+        return 1
+    if not path.gating_node:
+        print("FAIL: no gating node identified for round 0", file=sys.stderr)
+        return 1
+    if path.gating_node != slow.addr:
+        print(
+            f"FAIL: gating node {path.gating_node} is not the seeded slow "
+            f"node {slow.addr}; attribution {path.attributed_by_node}",
+            file=sys.stderr,
+        )
+        return 1
+
+    perf = perf_section(REGISTRY, cost=nodes[0].learner.cost_analysis())
+    if not perf["compile"]["first_compile_s"]:
+        print("FAIL: perf section has no compile events", file=sys.stderr)
+        return 1
+
+    # --- perf_diff exit-code semantics --------------------------------------
+    base = {
+        "metric": "perf_check_gate",
+        "value": round(path.wall_s, 4),
+        "unit": "s/round",
+        "meta": bench._bench_meta(seed=0, backend="cpu"),
+        "perf": perf,
+        "extra": {"mean_round_wall_s": round(path.wall_s, 4)},
+    }
+    regressed = json.loads(json.dumps(base))
+    regressed["value"] *= 2.0
+    regressed["extra"]["mean_round_wall_s"] *= 2.0
+    diff = os.path.join(REPO, "scripts", "perf_diff.py")
+    with tempfile.TemporaryDirectory() as td:
+        bp = os.path.join(td, "base.json")
+        rp = os.path.join(td, "regressed.json")
+        with open(bp, "w") as f:
+            json.dump(base, f)
+        with open(rp, "w") as f:
+            json.dump(regressed, f)
+        rc_self = subprocess.run(
+            [sys.executable, diff, bp, bp], capture_output=True, text=True
+        ).returncode
+        reg_run = subprocess.run(
+            [sys.executable, diff, bp, rp], capture_output=True, text=True
+        )
+        # Cross-schema refusal: a candidate on another schema must exit 3.
+        alien = json.loads(json.dumps(base))
+        alien["meta"]["schema_version"] = -1
+        ap = os.path.join(td, "alien.json")
+        with open(ap, "w") as f:
+            json.dump(alien, f)
+        rc_schema = subprocess.run(
+            [sys.executable, diff, bp, ap], capture_output=True, text=True
+        ).returncode
+    if rc_self != 0:
+        print(f"FAIL: perf_diff self-diff exited {rc_self}", file=sys.stderr)
+        return 1
+    if reg_run.returncode != 1:
+        print(
+            f"FAIL: perf_diff exited {reg_run.returncode} on a 2x regression "
+            f"(want 1): {reg_run.stderr[-500:]}",
+            file=sys.stderr,
+        )
+        return 1
+    if rc_schema != 3:
+        print(f"FAIL: perf_diff exited {rc_schema} on a schema mismatch (want 3)", file=sys.stderr)
+        return 1
+
+    print(
+        f"perf-check OK: gating node {path.gating_node} "
+        f"({path.attributed_by_node.get(path.gating_node, 0):.2f}s of "
+        f"{path.wall_s:.2f}s round), {len(path.hops)} hops, perf_diff "
+        "semantics verified"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,272 @@
+"""Compare two bench JSONs for performance regressions, with exit codes.
+
+The regression gate every perf PR is judged against: run an arm twice (or
+against a stored baseline), then
+
+    python scripts/perf_diff.py BASELINE.json CANDIDATE.json
+
+Exit codes:
+
+* ``0`` — no regression (improvements and in-noise changes both pass),
+* ``1`` — at least one compared metric regressed past its threshold,
+* ``2`` — usage / unreadable input,
+* ``3`` — schema refusal: the two files carry different ``meta`` /
+  ``perf`` schema versions (or a different metric name) and diffing them
+  would be comparing incomparable shapes.
+
+What gets compared (dotted paths; ``*`` fans out over dict keys):
+
+* lower-is-better timings — ``value`` (only when the arm's ``unit`` looks
+  time-like), ``extra.sec_per_round``, ``extra.mean_round_wall_s``,
+  ``extra.wall_s``, and every per-node steady-state step time under
+  ``perf.steady_state.step_s.*``;
+* count-like health signals — ``perf.compile.recompiles_total.*`` regresses
+  only when the candidate exceeds the baseline by more than
+  ``--count-slack`` (default 0: ANY new recompiles fail).
+
+Noise-awareness: a timing regresses only when
+``candidate > baseline * (1 + threshold)`` AND the absolute growth exceeds
+``--min-delta-s`` (default 1 ms) — double jitter on a microsecond metric is
+not a regression; baselines below the absolute floor are reported but never
+fail. When a baseline value is a LIST of samples, its mean and stddev are
+used and the threshold becomes ``max(rel, 2 * cv)`` — a naturally noisy
+metric earns a proportionally wider band. ``--threshold`` defaults to 0.25
+(the CPU-venue arms see ~10-15% run-to-run wobble; 2x regressions are what
+the gate exists to catch).
+
+Extra comparisons: repeat ``--key extra.some.path`` to add lower-is-better
+metrics. Output is one human-readable line per metric plus a JSON summary
+line on stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+DEFAULT_TIMING_KEYS = (
+    "extra.sec_per_round",
+    "extra.mean_round_wall_s",
+    "extra.wall_s",
+    "perf.steady_state.step_s.*",
+)
+DEFAULT_COUNT_KEYS = ("perf.compile.recompiles_total.*",)
+
+#: ``value`` is compared only when the arm's unit says lower-is-better time.
+_TIMEY_UNITS = ("s/round", "seconds", "s", "ms", "us/counter_increment")
+
+
+def _get_path(doc: Any, path: List[str]) -> List[Tuple[str, Any]]:
+    """Resolve a dotted path with ``*`` fan-out; returns (flat_key, value)."""
+    out: List[Tuple[str, Any]] = [("", doc)]
+    for part in path:
+        nxt: List[Tuple[str, Any]] = []
+        for prefix, node in out:
+            if not isinstance(node, dict):
+                continue
+            if part == "*":
+                for k, v in node.items():
+                    nxt.append((f"{prefix}.{k}".lstrip("."), v))
+            elif part in node:
+                nxt.append((f"{prefix}.{part}".lstrip("."), node[part]))
+        out = nxt
+    return out
+
+
+def _stats(v: Any) -> Optional[Tuple[float, float]]:
+    """(mean, std) of a numeric scalar or list; None when non-numeric."""
+    if isinstance(v, bool):
+        return None
+    if isinstance(v, (int, float)):
+        if math.isnan(v) or math.isinf(v):
+            return None
+        return float(v), 0.0
+    if isinstance(v, list) and v and all(
+        isinstance(x, (int, float)) and not isinstance(x, bool) for x in v
+    ):
+        m = sum(v) / len(v)
+        var = sum((x - m) ** 2 for x in v) / len(v)
+        return m, math.sqrt(var)
+    return None
+
+
+def _schema_of(doc: Dict[str, Any]) -> Tuple[Any, Any, Any]:
+    return (
+        (doc.get("meta") or {}).get("schema_version"),
+        (doc.get("perf") or {}).get("schema_version"),
+        doc.get("metric"),
+    )
+
+
+def compare(
+    base: Dict[str, Any],
+    cand: Dict[str, Any],
+    threshold: float = 0.25,
+    min_delta_s: float = 1e-3,
+    count_slack: int = 0,
+    extra_keys: Tuple[str, ...] = (),
+) -> Dict[str, Any]:
+    """Pure comparison (importable by tests / perf_check): returns the
+    summary dict; ``summary["regressions"]`` non-empty means exit 1."""
+    rows: List[Dict[str, Any]] = []
+    regressions: List[str] = []
+
+    timing_keys = list(DEFAULT_TIMING_KEYS) + list(extra_keys)
+    unit = str(base.get("unit") or "")
+    if any(unit == u or unit.endswith(u) for u in _TIMEY_UNITS):
+        timing_keys.insert(0, "value")
+
+    for key in timing_keys:
+        parts = key.split(".")
+        base_vals = dict(_get_path(base, parts))
+        cand_vals = dict(_get_path(cand, parts))
+        for flat, bv in sorted(base_vals.items()):
+            bs = _stats(bv)
+            cs = _stats(cand_vals.get(flat))
+            if bs is None or cs is None:
+                continue
+            bmean, bstd = bs
+            cmean, _ = cs
+            rel = threshold
+            if bmean > 0 and bstd > 0:
+                rel = max(threshold, 2.0 * bstd / bmean)  # noise-aware band
+            limit = bmean * (1.0 + rel)
+            delta = cmean - bmean
+            regressed = (
+                bmean >= 0
+                and cmean > limit
+                and delta > min_delta_s
+            )
+            rows.append(
+                {
+                    "key": flat,
+                    "kind": "timing",
+                    "baseline": bmean,
+                    "candidate": cmean,
+                    "allowed_rel": round(rel, 4),
+                    "regressed": regressed,
+                }
+            )
+            if regressed:
+                regressions.append(flat)
+
+    for key in DEFAULT_COUNT_KEYS:
+        parts = key.split(".")
+        base_vals = dict(_get_path(base, parts))
+        cand_vals = dict(_get_path(cand, parts))
+        for flat, cv in sorted(cand_vals.items()):
+            cs = _stats(cv)
+            if cs is None:
+                continue
+            bs = _stats(base_vals.get(flat, 0))
+            bcount = bs[0] if bs else 0.0
+            regressed = cs[0] > bcount + count_slack
+            rows.append(
+                {
+                    "key": flat,
+                    "kind": "count",
+                    "baseline": bcount,
+                    "candidate": cs[0],
+                    "allowed_slack": count_slack,
+                    "regressed": regressed,
+                }
+            )
+            if regressed:
+                regressions.append(flat)
+
+    return {
+        "compared": len(rows),
+        "rows": rows,
+        "regressions": regressions,
+        "threshold": threshold,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff two bench JSONs for perf regressions"
+    )
+    ap.add_argument("baseline")
+    ap.add_argument("candidate")
+    ap.add_argument(
+        "--threshold", type=float, default=0.25,
+        help="relative regression threshold for timings (default 0.25)",
+    )
+    ap.add_argument(
+        "--min-delta-s", type=float, default=1e-3,
+        help="absolute floor: timing growth below this never fails",
+    )
+    ap.add_argument(
+        "--count-slack", type=int, default=0,
+        help="allowed growth in count metrics (recompiles) before failing",
+    )
+    ap.add_argument(
+        "--key", action="append", default=[],
+        help="additional lower-is-better dotted path (repeatable, * fans out)",
+    )
+    ap.add_argument(
+        "--allow-metric-mismatch", action="store_true",
+        help="compare files whose top-level metric names differ",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.baseline) as f:
+            base = json.load(f)
+        with open(args.candidate) as f:
+            cand = json.load(f)
+    except Exception as e:  # noqa: BLE001
+        print(f"perf_diff: cannot read inputs: {e}", file=sys.stderr)
+        return 2
+    if not isinstance(base, dict) or not isinstance(cand, dict):
+        print("perf_diff: inputs must be bench JSON objects", file=sys.stderr)
+        return 2
+
+    b_meta, b_perf, b_metric = _schema_of(base)
+    c_meta, c_perf, c_metric = _schema_of(cand)
+    if b_meta != c_meta or b_perf != c_perf:
+        print(
+            f"perf_diff: SCHEMA REFUSAL — baseline meta/perf schema "
+            f"({b_meta}, {b_perf}) != candidate ({c_meta}, {c_perf}); "
+            "re-run both sides on one schema before diffing",
+            file=sys.stderr,
+        )
+        return 3
+    if b_metric != c_metric and not args.allow_metric_mismatch:
+        print(
+            f"perf_diff: SCHEMA REFUSAL — metric {b_metric!r} vs "
+            f"{c_metric!r} (pass --allow-metric-mismatch to override)",
+            file=sys.stderr,
+        )
+        return 3
+
+    summary = compare(
+        base, cand,
+        threshold=args.threshold,
+        min_delta_s=args.min_delta_s,
+        count_slack=args.count_slack,
+        extra_keys=tuple(args.key),
+    )
+    for row in summary["rows"]:
+        flag = "REGRESSED" if row["regressed"] else "ok"
+        print(
+            f"  {row['key']}: {row['baseline']:.6g} -> "
+            f"{row['candidate']:.6g}  [{row['kind']}] {flag}",
+            file=sys.stderr,
+        )
+    print(json.dumps(summary))
+    if summary["regressions"]:
+        print(
+            f"perf_diff: {len(summary['regressions'])} regression(s): "
+            f"{summary['regressions']}",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

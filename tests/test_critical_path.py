@@ -1,0 +1,457 @@
+"""Performance attribution plane: critical-path analyzer (synthetic DAGs,
+two-process merge with wall-clock anchors + skew correction), continuous
+profiling (perf section, recompile counter, device-trace windows), the
+flight-recorder clock fix, and perf_diff's exit-code semantics."""
+
+import importlib.util
+import json
+import os
+import time
+
+import pytest
+
+from p2pfl_tpu.telemetry import REGISTRY, TRACER
+from p2pfl_tpu.telemetry import tracing
+from p2pfl_tpu.telemetry.critical_path import (
+    CriticalPathAnalyzer,
+    Seg,
+    skew_from_registry,
+)
+from p2pfl_tpu.telemetry.tracing import Tracer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _seg(name, node, start, end, span_id="", parent_id="", rnd=0):
+    return Seg(
+        name=name, node=node, start_s=start, end_s=end,
+        span_id=span_id or f"{node}-{name}-{start}", parent_id=parent_id,
+        trace_id="t", round=rnd,
+    )
+
+
+# --- synthetic-DAG critical path --------------------------------------------
+
+
+def _straggler_round():
+    """Two trainers; B's fit is 5x A's, so A waits on B's partial. The
+    cross-node edge: A's recv is parented onto B's diffuse span."""
+    return [
+        _seg("fit", "A", 0.0, 1.0, span_id="a-fit"),
+        _seg("diffuse:partial_model", "A", 1.0, 1.3, span_id="a-diff"),
+        _seg("aggregation_wait", "A", 1.3, 5.5, span_id="a-wait"),
+        _seg("fit", "B", 0.0, 5.0, span_id="b-fit"),
+        _seg("diffuse:partial_model", "B", 5.0, 5.45, span_id="b-diff"),
+        _seg("recv:partial_model", "A", 5.4, 5.41, span_id="a-recv",
+             parent_id="b-diff"),
+    ]
+
+
+def test_straggler_gates_the_round():
+    a = CriticalPathAnalyzer(_straggler_round(), slack_s=0.5)
+    path = a.round_path(0)
+    assert path.gating_node == "B"
+    # B's slow fit dominates the attribution; A's post-arrival tail is tiny.
+    assert path.attributed_by_node["B"] == pytest.approx(5.4, abs=0.5)
+    names = [h.name for h in path.hops]
+    assert "fit" in names and "aggregation_wait" in names
+    # Path is ordered earliest-first and attribution is within the round.
+    assert path.hops[0].start_s <= path.hops[-1].start_s
+    assert 0.5 < path.coverage <= 1.01
+
+
+def test_wait_without_arrival_falls_back_to_predecessor():
+    segs = [
+        _seg("fit", "A", 0.0, 1.0, span_id="a-fit"),
+        _seg("aggregation_wait", "A", 1.0, 4.0, span_id="a-wait"),
+    ]
+    path = CriticalPathAnalyzer(segs, slack_s=0.5).round_path(0)
+    assert path.gating_node == "A"
+    assert [h.name for h in path.hops] == ["fit", "aggregation_wait"]
+    assert sum(h.attributed_s for h in path.hops) == pytest.approx(4.0, abs=0.01)
+
+
+def test_ack_cycle_does_not_truncate_the_walk():
+    """A diffuse wait resolved by an ack whose parent chain loops back onto
+    the diffuse span itself must fall through, not end the walk."""
+    segs = [
+        _seg("fit", "A", 0.0, 3.0, span_id="a-fit"),
+        _seg("diffuse:full_model", "A", 3.0, 4.0, span_id="a-diff"),
+        # Ack arrives on A, parented (via B's recv) onto A's own diffuse.
+        _seg("recv:full_model", "B", 3.2, 3.21, span_id="b-recv",
+             parent_id="a-diff"),
+        _seg("recv:models_ready", "A", 3.9, 3.91, span_id="a-ack",
+             parent_id="b-recv"),
+    ]
+    path = CriticalPathAnalyzer(segs, slack_s=0.5).round_path(0)
+    assert path.gating_node == "A"
+    # The walk reached the fit despite the cycle.
+    assert any(h.name == "fit" for h in path.hops)
+    assert path.attributed_by_node["A"] == pytest.approx(4.0, abs=0.2)
+
+
+def test_stage_shares_and_rounds():
+    a = CriticalPathAnalyzer(_straggler_round(), slack_s=0.5)
+    assert a.rounds() == [0]
+    shares = a.stage_shares(0)
+    assert shares["by_stage_s"]["fit"] == pytest.approx(6.0)
+    assert sum(shares["shares"].values()) == pytest.approx(1.0, abs=0.01)
+
+
+def test_overlap_report_serialized_vs_overlapped():
+    serialized = CriticalPathAnalyzer(
+        [
+            _seg("fit", "A", 0.0, 2.0),
+            _seg("diffuse:partial_model", "A", 2.0, 3.0),
+        ]
+    ).overlap_report()
+    assert serialized["train_diffuse_overlap_fraction"] == 0.0
+    assert serialized["serialized_diffuse_s"] == pytest.approx(1.0)
+
+    overlapped = CriticalPathAnalyzer(
+        [
+            _seg("fit", "A", 0.0, 2.0),
+            _seg("diffuse:partial_model", "A", 1.0, 2.0),  # fully under fit
+            _seg("fit", "B", 0.0, 1.0),
+            _seg("diffuse:partial_model", "B", 1.5, 2.5),  # under A's fit only
+        ]
+    ).overlap_report()
+    assert overlapped["train_diffuse_overlap_fraction"] == pytest.approx(0.5)
+    assert overlapped["diffuse_under_any_fit_fraction"] == pytest.approx(0.75)
+
+
+def test_report_counts_gating_nodes():
+    segs = _straggler_round() + [
+        _seg("fit", "A", 10.0, 11.0, span_id="a-fit-1", rnd=1),
+        _seg("fit", "B", 10.0, 15.0, span_id="b-fit-1", rnd=1),
+    ]
+    rep = CriticalPathAnalyzer(segs, slack_s=0.5).report()
+    assert rep["top_gating_node"] == "B"
+    assert rep["gating_node_counts"]["B"] == 2
+    assert rep["top_gating_fraction"] == 1.0
+    assert "overlap" in rep and "stage_shares" in rep
+
+
+# --- chrome-trace export: Perfetto contract + wall anchor ---------------------
+
+
+def test_chrome_trace_perfetto_fields_and_stable_ordering():
+    t = Tracer(max_spans=64)
+    before_wall = time.time()
+    with t.span("fit", node="mem://n0", round=2):
+        time.sleep(0.01)
+    with t.span("diffuse:partial_model", node="mem://n1", round=2):
+        pass
+    doc = t.export_chrome_trace()
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    metas = [e for e in doc["traceEvents"] if e.get("ph") == "M"]
+    assert len(spans) == 2 and len(metas) == 2
+    for ev in spans:
+        assert ev["ph"] == "X"
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+        for key in ("trace_id", "span_id", "parent_id", "round"):
+            assert key in ev["args"]
+    fit = next(e for e in spans if e["name"] == "fit")
+    assert fit["dur"] >= 10_000  # ts/dur are MICROseconds
+    # Wall anchor: ts + wall_epoch_s lands at the real recording time.
+    meta = doc["metadata"]
+    wall_start = fit["ts"] / 1e6 + meta["wall_epoch_s"]
+    assert abs(wall_start - before_wall) < 5.0
+    assert meta["exported_at_s"] >= meta["wall_epoch_at_init_s"] - 1.0
+    # Deterministic ordering: same spans export byte-identically, sorted.
+    doc2 = t.export_chrome_trace()
+    doc["metadata"].pop("wall_epoch_s"), doc["metadata"].pop("exported_at_s")
+    doc2["metadata"].pop("wall_epoch_s"), doc2["metadata"].pop("exported_at_s")
+    assert json.dumps(doc) == json.dumps(doc2)
+    ts_list = [e["ts"] for e in spans]
+    assert ts_list == sorted(ts_list)
+
+
+def _two_process_docs(offset_s: float):
+    """Fixture: a sender tracer ("process" A) and a receiver tracer (B)
+    linked through the wire context, exported separately; B's wall anchor
+    is then shifted by ``offset_s`` to simulate NTP skew."""
+    t_a, t_b = Tracer(max_spans=64), Tracer(max_spans=64)
+    with t_a.span("fit", node="procA", round=0):
+        time.sleep(0.05)
+    with t_b.span("aggregation_wait", node="procB", round=0):
+        with t_a.span("diffuse:partial_model", node="procA", round=0) as ctx:
+            wire = ctx.wire()
+            time.sleep(0.01)
+        with tracing.attach_wire(wire):
+            with t_b.span("recv:partial_model", node="procB", round=0):
+                time.sleep(0.005)
+        time.sleep(0.005)
+    doc_a, doc_b = t_a.export_chrome_trace(), t_b.export_chrome_trace()
+    doc_a["metadata"]["node"] = "procA"
+    doc_b["metadata"]["node"] = "procB"
+    doc_b["metadata"]["wall_epoch_s"] += offset_s
+    return doc_a, doc_b
+
+
+def test_two_process_merge_aligns_without_skew():
+    doc_a, doc_b = _two_process_docs(offset_s=0.0)
+    a = CriticalPathAnalyzer.from_chrome_traces([doc_a, doc_b], slack_s=0.5)
+    assert set(a.nodes()) == {"procA", "procB"}
+    path = a.round_path(0)
+    # B's wait resolves through the recv onto A's diffuse -> A's fit gates.
+    assert path.gating_node == "procA"
+    assert any(h.name == "fit" and h.node == "procA" for h in path.hops)
+
+
+def test_two_process_merge_corrects_measured_skew():
+    # B's clock is 5 s ahead; A measured that skew on B's heartbeats.
+    doc_a, doc_b = _two_process_docs(offset_s=5.0)
+    doc_a["metadata"]["peer_clock_skew_s"] = {"procB": -5.0}
+    merged = CriticalPathAnalyzer.from_chrome_traces([doc_a, doc_b], slack_s=0.5)
+    assert merged.round_path(0).gating_node == "procA"
+    # Explicit skew_s wins the same way.
+    doc_a["metadata"].pop("peer_clock_skew_s")
+    explicit = CriticalPathAnalyzer.from_chrome_traces(
+        [doc_a, doc_b], skew_s={"procB": -5.0}, slack_s=0.5
+    )
+    assert explicit.round_path(0).gating_node == "procA"
+    # Uncorrected, B's spans land 5 s in the future and the merged round
+    # timeline inflates by the skew — the correction is load-bearing.
+    broken = CriticalPathAnalyzer.from_chrome_traces(
+        [doc_a, doc_b], auto_skew=False, slack_s=0.5
+    )
+    assert broken.round_path(0).wall_s > 4.0
+    assert merged.round_path(0).wall_s < 2.0
+
+
+def test_skew_from_registry_reads_reference_rows():
+    g = REGISTRY.gauge(
+        "p2pfl_heartbeat_clock_skew_seconds",
+        "Receiver wall-clock minus the sender-stamped beat timestamp",
+        labels=("node", "peer"),
+    )
+    g.labels("mem://ref", "mem://peer1").set(0.25)
+    g.labels("mem://ref", "mem://peer2").set(-1.5)
+    g.labels("mem://other", "mem://peer1").set(99.0)
+    skews = skew_from_registry("mem://ref")
+    assert skews["mem://peer1"] == 0.25
+    assert skews["mem://peer2"] == -1.5
+    assert 99.0 not in skews.values()
+
+
+# --- continuous profiling -----------------------------------------------------
+
+
+def _tiny_learner(addr: str, batch_size: int = 16):
+    from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from p2pfl_tpu.learning.learner import JaxLearner
+    from p2pfl_tpu.models import mlp_model
+
+    data = synthetic_mnist(n_train=64, n_test=16)
+    part = data.generate_partitions(1, RandomIIDPartitionStrategy)[0]
+    return JaxLearner(
+        mlp_model(seed=0, hidden_sizes=(8,)), part,
+        self_addr=addr, batch_size=batch_size, seed=0,
+    )
+
+
+def test_recompile_counter_counts_shape_driven_retraces():
+    learner = _tiny_learner("mem://recompile-test")
+    fam = REGISTRY.get("p2pfl_learner_recompiles_total")
+    assert fam is not None
+
+    def count():
+        return sum(
+            c.value
+            for labels, c in fam.samples()
+            if labels.get("node") == "mem://recompile-test"
+        )
+
+    learner.fit()  # first compile: gauged, NOT counted as a recompile
+    learner.fit()  # cache hit: still no recompile
+    base = count()
+    assert base == 0
+    learner.batch_size = 8  # shape change -> silent retrace, now visible
+    learner.fit()
+    assert count() >= base + 1
+    comp = REGISTRY.get("p2pfl_learner_jit_compile_seconds")
+    assert any(
+        labels.get("node") == "mem://recompile-test" and c.value > 0
+        for labels, c in comp.samples()
+    )
+
+
+def test_learner_cost_analysis_reports_flops():
+    learner = _tiny_learner("mem://cost-test")
+    cost = learner.cost_analysis()
+    assert cost is not None
+    assert cost["flops_per_epoch"] > 0
+    assert cost["steps_per_epoch"] >= 1
+    assert cost["flops_per_step"] == pytest.approx(
+        cost["flops_per_epoch"] / cost["steps_per_epoch"]
+    )
+
+
+def test_perf_section_structure():
+    from p2pfl_tpu.management.profiler import PERF_SCHEMA_VERSION, perf_section
+
+    sec = perf_section(REGISTRY, cost={"flops_per_epoch": 1.0})
+    assert sec["schema_version"] == PERF_SCHEMA_VERSION
+    assert set(sec["compile"]) == {
+        "first_compile_s", "recompiles_total", "last_recompile_s"
+    }
+    assert set(sec["steady_state"]) == {"step_s", "steps_per_s"}
+    assert sec["xla_cost"] == {"flops_per_epoch": 1.0}
+    assert isinstance(sec["device_traces"], list)
+    json.dumps(sec)  # must be bench-JSON-embeddable
+
+
+def test_device_trace_window_noop_and_capture_once(tmp_path):
+    from p2pfl_tpu.management import profiler
+
+    with profiler.device_trace_window(None) as captured:
+        assert captured is None
+    with profiler.device_trace_window("", label="x") as captured:
+        assert captured is None
+    label = f"once-{time.time_ns()}"  # process-global registry: unique label
+    with profiler.device_trace_window(str(tmp_path), label=label) as captured:
+        assert captured is not None
+        import jax.numpy as jnp
+
+        (jnp.ones((4,)) * 2).block_until_ready()
+    assert os.path.isdir(captured)
+    assert captured in profiler.captured_device_traces()
+    with profiler.device_trace_window(str(tmp_path), label=label) as again:
+        assert again is None  # capture-once per label per process
+
+
+# --- flight recorder clocks ---------------------------------------------------
+
+
+def test_flight_recorder_maps_mono_to_wall_at_read_time(tmp_path):
+    from p2pfl_tpu.telemetry.flight_recorder import FlightRecorder
+
+    rec = FlightRecorder("mem://clock-test", capacity=8)
+    rec.record("tick", i=1)
+    ev = rec.events()[0]
+    assert abs(ev["t"] - time.time()) < 5.0  # wall, derived at read time
+    assert abs(ev["t_mono"] - time.monotonic()) < 5.0
+    path = rec.dump("test", directory=str(tmp_path))
+    assert path is not None
+    with open(path) as f:
+        doc = json.load(f)
+    # Both clocks + the mapping in the header; events carry both stamps.
+    assert {"dumped_at", "dumped_at_mono", "mono_to_wall_epoch"} <= set(doc)
+    assert doc["events"][0]["t"] == pytest.approx(
+        doc["events"][0]["t_mono"] + doc["mono_to_wall_epoch"], abs=1.0
+    )
+
+
+# --- protocol trace export ----------------------------------------------------
+
+
+def test_protocol_export_trace_annotates_node_and_skews(tmp_path):
+    from p2pfl_tpu.comm.memory.memory_protocol import InMemoryCommunicationProtocol
+
+    proto = InMemoryCommunicationProtocol("mem://trace-export-test")
+    try:
+        proto.heartbeater.beat("mem://peer", time.time() - 2.0)
+        path = proto.export_trace(str(tmp_path / "trace.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["metadata"]["node"] == "mem://trace-export-test"
+        skews = doc["metadata"]["peer_clock_skew_s"]
+        assert skews["mem://peer"] == pytest.approx(2.0, abs=1.0)
+        assert "wall_epoch_s" in doc["metadata"]
+    finally:
+        from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+
+        try:
+            proto.stop()
+        except Exception:
+            pass
+        InMemoryRegistry.reset()
+
+
+# --- perf_diff exit-code semantics --------------------------------------------
+
+
+def _perf_diff():
+    spec = importlib.util.spec_from_file_location(
+        "perf_diff", os.path.join(REPO, "scripts", "perf_diff.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _bench_doc(step=0.01, wall=2.0):
+    return {
+        "metric": "unit_test_arm",
+        "value": wall,
+        "unit": "s/round",
+        "meta": {"schema_version": 1, "git_sha": "x", "backend": "cpu", "seed": 0},
+        "perf": {
+            "schema_version": 1,
+            "compile": {"recompiles_total": {"n0": 0}},
+            "steady_state": {"step_s": {"n0": step}},
+        },
+        "extra": {"mean_round_wall_s": wall},
+    }
+
+
+def test_perf_diff_exit_codes(tmp_path):
+    pd = _perf_diff()
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(_bench_doc()))
+
+    same = tmp_path / "same.json"
+    same.write_text(json.dumps(_bench_doc(step=0.0105, wall=2.1)))  # in noise
+    assert pd.main([str(base), str(same)]) == 0
+
+    reg = tmp_path / "reg.json"
+    reg.write_text(json.dumps(_bench_doc(step=0.02, wall=4.0)))  # 2x
+    assert pd.main([str(base), str(reg)]) == 1
+
+    improved = tmp_path / "improved.json"
+    improved.write_text(json.dumps(_bench_doc(step=0.005, wall=1.0)))
+    assert pd.main([str(base), str(improved)]) == 0
+
+    alien_doc = _bench_doc()
+    alien_doc["meta"]["schema_version"] = 2
+    alien = tmp_path / "alien.json"
+    alien.write_text(json.dumps(alien_doc))
+    assert pd.main([str(base), str(alien)]) == 3
+
+    other_metric = _bench_doc()
+    other_metric["metric"] = "different_arm"
+    om = tmp_path / "om.json"
+    om.write_text(json.dumps(other_metric))
+    assert pd.main([str(base), str(om)]) == 3
+    assert pd.main([str(base), str(om), "--allow-metric-mismatch"]) == 0
+
+    assert pd.main([str(base), str(tmp_path / "missing.json")]) == 2
+
+
+def test_perf_diff_noise_aware_list_baselines(tmp_path):
+    pd = _perf_diff()
+    base_doc = _bench_doc()
+    # Noisy baseline samples: cv ~0.3 widens the band beyond the default.
+    base_doc["extra"]["mean_round_wall_s"] = [2.0, 1.4, 2.6]
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(base_doc))
+    cand = _bench_doc(wall=3.0)  # +50%: outside 0.25 but inside 2*cv (~0.49)...
+    cand["extra"]["mean_round_wall_s"] = 2.9
+    cp = tmp_path / "cand.json"
+    cp.write_text(json.dumps(cand))
+    summary = pd.compare(base_doc, cand)
+    row = next(
+        r for r in summary["rows"] if r["key"] == "extra.mean_round_wall_s"
+    )
+    assert row["allowed_rel"] > 0.25  # band widened by measured noise
+    assert not row["regressed"]
+
+
+def test_perf_diff_recompile_counts_regress(tmp_path):
+    pd = _perf_diff()
+    base_doc = _bench_doc()
+    cand_doc = _bench_doc()
+    cand_doc["perf"]["compile"]["recompiles_total"]["n0"] = 3
+    summary = pd.compare(base_doc, cand_doc)
+    assert "perf.compile.recompiles_total.n0" in summary["regressions"]

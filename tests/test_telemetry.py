@@ -300,6 +300,31 @@ def test_chrome_trace_export_shape():
     json.dumps(trace)  # Perfetto loads plain JSON — must serialize clean
 
 
+def test_chrome_trace_perfetto_required_fields_and_anchor():
+    """Perfetto contract: complete events with µs ts/dur, integer pid/tid,
+    ids in args; metadata carries the wall-clock epoch anchor that maps
+    span time onto the wall (the cross-process merge key)."""
+    TRACER.reset()
+    wall_before = time.time()
+    with TRACER.span("fit", node="mem://a", round=3):
+        time.sleep(0.005)
+    trace = TRACER.export_chrome_trace()
+    (ev,) = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+    assert ev["dur"] >= 5_000  # microseconds, not seconds/ms
+    assert ev["args"]["round"] == 3
+    for key in ("trace_id", "span_id", "parent_id"):
+        assert key in ev["args"]
+    meta = trace["metadata"]
+    assert abs(ev["ts"] / 1e6 + meta["wall_epoch_s"] - wall_before) < 5.0
+    # Stable ordering: events sorted by ts; re-export is identical modulo
+    # the recomputed anchor fields.
+    t2 = TRACER.export_chrome_trace()
+    assert [e["name"] for e in trace["traceEvents"]] == [
+        e["name"] for e in t2["traceEvents"]
+    ]
+
+
 def test_gossiper_tx_counters_mirrored_into_registry():
     """The ad-hoc gossip byte counters now live in the shared registry."""
     from p2pfl_tpu.comm.envelope import Envelope

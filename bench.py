@@ -1605,6 +1605,541 @@ def run_chaos_bench() -> None:
     _emit(out, seed=locals().get("seed"), backend="cpu")
 
 
+def run_async_bench() -> None:
+    """Subprocess-style mode ``--async``: elastic async federation acceptance.
+
+    Four arms over the real Node/gossip/aggregator stack (8-node in-memory
+    MNIST FedAvg, full-participation committees so the sync barrier is set
+    by the slowest trainer — the fair comparison):
+
+    * **straggler throughput** — one 5x-slow peer (fit stretched to 5x the
+      fast floor). Sync rounds block on it; async windows close on the
+      buffer fill target. Contract: fleet round/window throughput (completed
+      rounds-or-windows across all nodes per wall second) of async >= 3x
+      sync, at equal final accuracy (<= 0.5 pp delta on the fast nodes).
+    * **churn** — a seeded per-window join/leave trace from the chaos plane
+      (``CHAOS.plan_churn``; executed events counted as fault "churn"):
+      async finishes every window on all surviving original nodes and every
+      joiner (cold full-model catch-up bootstrap) contributes within 2
+      windows; the SAME trace under sync demonstrably stalls — joiners have
+      no entry path, win committee votes, and burn the vote timeout every
+      round (or rounds are abandoned outright within the wall budget).
+    * **Byzantine** — 2 signflip adversaries under async: admission control
+      screens every async contribution exactly as it screens sync partials;
+      honest accuracy holds 0.0 pp vs the clean async leg.
+
+    Results + the shared versioned meta block + structured perf section land
+    in ``artifacts/ASYNC_BENCH.json``.
+
+    Shape overrides: P2PFL_TPU_ASYNC_BENCH_NODES (default 8),
+    P2PFL_TPU_ASYNC_BENCH_ROUNDS (default 3), P2PFL_TPU_ASYNC_BENCH_SLOW
+    (default 5.0), P2PFL_TPU_ASYNC_BENCH_SEED (default 42).
+    """
+    out: dict = {}
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"  # protocol-stack bench: CPU venue
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from p2pfl_tpu.chaos import CHAOS
+        from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+        from p2pfl_tpu.config import Settings
+        from p2pfl_tpu.learning.dataset import (
+            RandomIIDPartitionStrategy,
+            synthetic_mnist,
+        )
+        from p2pfl_tpu.management.profiler import perf_section
+        from p2pfl_tpu.models import mlp_model
+        from p2pfl_tpu.node import Node
+        from p2pfl_tpu.telemetry import REGISTRY, TRACER
+        from p2pfl_tpu.utils.utils import set_test_settings, wait_convergence
+
+        n_nodes = int(os.environ.get("P2PFL_TPU_ASYNC_BENCH_NODES", "8"))
+        # 5 units amortize the one-time window-0 alignment ramp (nodes enter
+        # window 0 staggered by the init-model diffusion) over the steady
+        # state the contrast is about: steady async windows run at the fit
+        # floor + epsilon, sync rounds at the straggler floor + overhead.
+        rounds = int(os.environ.get("P2PFL_TPU_ASYNC_BENCH_ROUNDS", "5"))
+        slow_x = float(os.environ.get("P2PFL_TPU_ASYNC_BENCH_SLOW", "5.0"))
+        seed = int(os.environ.get("P2PFL_TPU_ASYNC_BENCH_SEED", "42"))
+        fast_floor_s = 4.0  # deterministic fit floor; straggler = slow_x * this
+
+        set_test_settings()
+        Settings.RESOURCE_MONITOR_PERIOD = 0
+        Settings.LOG_LEVEL = "WARNING"
+        # Full participation: every node trains every round, so the sync
+        # barrier is set by the slowest trainer in EVERY round (not only the
+        # rounds that elect it) — apples-to-apples with async, where every
+        # node trains every window.
+        Settings.TRAIN_SET_SIZE = n_nodes
+        Settings.ASYNC_WINDOW_TIMEOUT = 20.0
+        # Inline fits: the shared learner executor sizes itself from
+        # cpu_count (2 workers on the 1-core CI box) and would serialize the
+        # sleep-floor fits in pairs — pacing BOTH schedulers with pool
+        # capacity instead of the straggle being measured. The floors are
+        # sleeps; inline fits on the stage threads overlap them fully.
+        Settings.EXECUTOR_MAX_WORKERS = 0
+
+        def stretch_fit(node, floor_s: float) -> None:
+            orig = node.learner.fit
+
+            def fit(*a, **kw):
+                t0 = time.monotonic()
+                r = orig(*a, **kw)
+                extra = floor_s - (time.monotonic() - t0)
+                if extra > 0:
+                    time.sleep(extra)
+                return r
+
+            node.learner.fit = fit
+
+        # One SHARED apply_fn across every leg's fleet (per-node params still
+        # differ via build_copy) + a one-time pre-warm of the train/eval XLA
+        # programs on a throwaway learner: on a contended 1-core host, 8
+        # identity-distinct compiles serialized inside window/round 0 would
+        # drown the straggle being measured (same rationale and pattern as
+        # the critical-path bench). Fits are tiny (128 samples -> 4 steps);
+        # the deterministic sleep FLOOR carries the slowdown contrast.
+        # Small MLP: the bench measures SCHEDULING (barrier vs buffered
+        # windows), and on a 1-core host the async all-to-all contribution
+        # decode+screen cost scales with param bytes — a full-size model
+        # would measure serialization throughput instead of the barrier.
+        hidden = (128,)
+        template = mlp_model(seed=0, hidden_sizes=hidden)
+        _phase("async bench: pre-warming the shared XLA programs")
+        from p2pfl_tpu.learning.learner import JaxLearner
+
+        warm_data = synthetic_mnist(n_train=128, n_test=128)
+        warm_parts = warm_data.generate_partitions(1, RandomIIDPartitionStrategy)
+        warm = JaxLearner(
+            template.build_copy(), warm_parts[0], self_addr="mem://warmup",
+            batch_size=32, seed=0,
+        )
+        warm.set_epochs(1)
+        warm.fit()
+        warm.evaluate()
+        del warm
+
+        def build_fed(n, extra_parts=0, slow_idx=None):
+            data = synthetic_mnist(n_train=128 * (n + extra_parts), n_test=128)
+            parts = data.generate_partitions(n + extra_parts, RandomIIDPartitionStrategy)
+            nodes = [
+                Node(
+                    template.build_copy(
+                        params=mlp_model(seed=i, hidden_sizes=hidden).get_parameters()
+                    ),
+                    parts[i], batch_size=32,
+                )
+                for i in range(n)
+            ]
+            for i, nd in enumerate(nodes):
+                stretch_fit(
+                    nd,
+                    fast_floor_s * slow_x if i == slow_idx else fast_floor_s,
+                )
+                nd.start()
+            for i in range(1, n):
+                nodes[i].connect(nodes[0].addr)
+            wait_convergence(nodes, n - 1, wait=30)
+            return nodes, parts
+
+        def teardown(nodes):
+            for nd in nodes:
+                try:
+                    nd.stop()
+                except Exception:  # noqa: BLE001
+                    pass
+            InMemoryRegistry.reset()
+
+        def finished(nd, target, stage):
+            return (
+                not nd.learning_in_progress()
+                and nd.learning_workflow is not None
+                and nd.learning_workflow.history.count(stage) >= target
+            )
+
+        # --- arm 1: one 5x-slow peer, sync vs async -------------------------
+        def straggler_leg(mode: str) -> dict:
+            REGISTRY.reset()
+            TRACER.reset()
+            CHAOS.reset()
+            nodes, _ = build_fed(n_nodes, slow_idx=n_nodes - 1)
+            slow = nodes[-1]
+            fast = nodes[:-1]
+            stage = (
+                "AsyncWindowFinishedStage" if mode == "async" else "RoundFinishedStage"
+            )
+            try:
+                t0 = time.monotonic()
+                nodes[0].set_start_learning(rounds=rounds, epochs=1, mode=mode)
+                waiting_on = fast if mode == "async" else nodes
+                deadline = time.time() + 600
+                while time.time() < deadline:
+                    if all(finished(nd, rounds, stage) for nd in waiting_on):
+                        break
+                    time.sleep(0.2)
+                else:
+                    raise TimeoutError(
+                        f"{mode} straggler leg did not finish: "
+                        f"{ {nd.addr: nd.learning_workflow.history.count(stage) for nd in waiting_on if nd.learning_workflow} }"
+                    )
+                wall = time.monotonic() - t0
+                completed = sum(
+                    nd.learning_workflow.history.count(stage)
+                    for nd in nodes
+                    if nd.learning_workflow is not None
+                )
+                if mode == "async":
+                    # The straggler keeps its remaining windows on its own
+                    # time — the fleet is NOT waiting on it. Stop it so the
+                    # leg tears down promptly.
+                    nodes[0].set_stop_learning()
+                accs = [nd.learner.evaluate().get("test_acc", 0.0) for nd in fast]
+                return {
+                    "wall_s": round(wall, 2),
+                    "completed_units": completed,
+                    "throughput_units_per_s": round(completed / wall, 4),
+                    "final_test_acc_mean_fast": round(sum(accs) / len(accs), 4),
+                    "slow_peer": slow.addr,
+                }
+            finally:
+                teardown(nodes)
+
+        _phase(f"async bench: sync leg ({n_nodes} nodes, 1 x{slow_x} straggler)")
+        sync_leg = straggler_leg("sync")
+        _phase(f"sync leg done: {json.dumps(sync_leg)}")
+        _phase(f"async bench: async leg ({n_nodes} nodes, 1 x{slow_x} straggler)")
+        async_leg = straggler_leg("async")
+        _phase(f"async leg done: {json.dumps(async_leg)}")
+
+        throughput_x = round(
+            async_leg["throughput_units_per_s"] / sync_leg["throughput_units_per_s"],
+            2,
+        )
+        acc_delta_pp = round(
+            100.0
+            * (
+                sync_leg["final_test_acc_mean_fast"]
+                - async_leg["final_test_acc_mean_fast"]
+            ),
+            2,
+        )
+        if throughput_x < 3.0:
+            raise AssertionError(
+                f"async throughput only {throughput_x}x sync (need >= 3x): "
+                f"async {async_leg}, sync {sync_leg}"
+            )
+        if abs(acc_delta_pp) > 0.5:
+            raise AssertionError(
+                f"async accuracy delta {acc_delta_pp}pp exceeds 0.5pp "
+                f"(sync {sync_leg['final_test_acc_mean_fast']}, "
+                f"async {async_leg['final_test_acc_mean_fast']})"
+            )
+
+        # --- arm 2: seeded churn trace, async finishes / sync stalls --------
+        # Fixed 4-unit trace: long enough for 3 leaves + 3 joins, short
+        # enough that the sync leg's vote-timeout-burning rounds stay inside
+        # the wall budget.
+        churn_rounds = int(os.environ.get("P2PFL_TPU_ASYNC_BENCH_CHURN_ROUNDS", "4"))
+        # Joins stop 2 windows before the end: the contract is "contributes
+        # within 2 windows of joining", which needs that much runway — a
+        # join at the final window has no experiment left to contribute to.
+        n_joiners = max(1, churn_rounds - 2)
+
+        def churn_leg(mode: str, budget_s: float) -> dict:
+            REGISTRY.reset()
+            TRACER.reset()
+            CHAOS.reset()
+            nodes, parts = build_fed(n_nodes, extra_parts=n_joiners)
+            by_addr = {nd.addr: nd for nd in nodes}
+            # Victims: non-initiator originals; joiners are cold nodes.
+            trace = CHAOS.plan_churn(
+                churn_rounds,
+                [nd.addr for nd in nodes[1:]],
+                [f"joiner-{i}" for i in range(n_joiners)],
+                seed=seed,
+            )
+            joiners: dict = {}
+            crashed: list = []
+            pending = list(trace)
+            stage = (
+                "AsyncWindowFinishedStage" if mode == "async" else "RoundFinishedStage"
+            )
+            join_windows: dict = {}
+            try:
+                t0 = time.monotonic()
+                nodes[0].set_start_learning(rounds=churn_rounds, epochs=1, mode=mode)
+                deadline = time.monotonic() + budget_s
+                while time.monotonic() < deadline:
+                    w = nodes[0].state.round
+                    if w is not None:
+                        due = [e for e in pending if e.when <= w]
+                        for ev in due:
+                            pending.remove(ev)
+                            if ev.kind == "leave":
+                                victim = by_addr.get(ev.node)
+                                if victim is not None and victim not in crashed:
+                                    victim.crash()
+                                    crashed.append(victim)
+                                    CHAOS.churn(ev.node, "leave")
+                            else:  # join
+                                j = Node(
+                                    template.build_copy(
+                                        params=mlp_model(
+                                            seed=100 + len(joiners),
+                                            hidden_sizes=hidden,
+                                        ).get_parameters()
+                                    ),
+                                    parts[n_nodes + len(joiners)],
+                                    batch_size=32,
+                                )
+                                stretch_fit(j, fast_floor_s)
+                                j.start()
+                                j.connect(nodes[0].addr)
+                                if mode == "async":
+                                    # Elastic membership: first-class join.
+                                    time.sleep(0.3)
+                                    j.request_async_join()
+                                # Sync has NO join path: the node is a live
+                                # neighbor (it wins votes!) but can never
+                                # enter the experiment.
+                                joiners[ev.node] = j
+                                join_windows[ev.node] = w
+                                CHAOS.churn(j.addr, "join")
+                    survivors = [nd for nd in nodes if nd not in crashed]
+                    watch = survivors + (
+                        list(joiners.values()) if mode == "async" else []
+                    )
+                    if not pending and all(
+                        not nd.learning_in_progress()
+                        and nd.learning_workflow is not None
+                        for nd in watch
+                    ):
+                        break
+                    time.sleep(0.2)
+                wall = time.monotonic() - t0
+                survivors = [nd for nd in nodes if nd not in crashed]
+                completed = {
+                    nd.addr: (
+                        nd.learning_workflow.history.count(stage)
+                        if nd.learning_workflow
+                        else 0
+                    )
+                    for nd in survivors
+                }
+                all_done = not pending and all(
+                    c >= churn_rounds for c in completed.values()
+                )
+                joiner_first_fold = {}
+                for sym, j in joiners.items():
+                    first = nodes[0].async_agg.seen_contributors.get(j.addr) if nodes[0].async_agg else None
+                    joiner_first_fold[j.addr] = {
+                        "joined_at": join_windows.get(sym),
+                        "first_folded_window": first,
+                    }
+                # The sync stall signature: joiners are live neighbors, so
+                # they win committee votes — but they never received the
+                # kickoff and can never cast a ballot, so every election
+                # after the first join burns the full VOTE_TIMEOUT.
+                vote_rtt_max = 0.0
+                vote_timeout_spans = 0
+                if mode != "async":
+                    for s in TRACER.spans():
+                        if s.name == "vote_rtt":
+                            vote_rtt_max = max(vote_rtt_max, s.dur_s)
+                            if s.dur_s >= Settings.VOTE_TIMEOUT - 0.5:
+                                vote_timeout_spans += 1
+                faults = CHAOS.fault_counts()
+                if mode != "async":
+                    # make teardown quick: abort whatever is still limping
+                    try:
+                        nodes[0].set_stop_learning()
+                    except Exception:  # noqa: BLE001
+                        pass
+                return {
+                    "wall_s": round(wall, 2),
+                    "completed_by_survivor": completed,
+                    "all_survivors_finished": all_done,
+                    "mean_unit_wall_s": round(
+                        wall / max(1, min(completed.values() or [1])), 2
+                    ),
+                    "crashed": [nd.addr for nd in crashed],
+                    "joiners": joiner_first_fold,
+                    "churn_faults": faults.get("churn", 0),
+                    "injected_faults": faults,
+                    "vote_rtt_max_s": round(vote_rtt_max, 2),
+                    "vote_timeout_rounds": vote_timeout_spans,
+                }
+            finally:
+                teardown(list(nodes) + list(joiners.values()))
+
+        _phase("async bench: churn arm (async leg)")
+        churn_async = churn_leg("async", budget_s=300.0)
+        _phase(f"churn async done: {json.dumps(churn_async)}")
+        _phase("async bench: churn arm (sync leg, same seeded trace)")
+        churn_sync = churn_leg("sync", budget_s=300.0)
+        _phase(f"churn sync done: {json.dumps(churn_sync)}")
+
+        if not churn_async["all_survivors_finished"]:
+            raise AssertionError(
+                f"async churn leg did not finish all windows: {churn_async}"
+            )
+        for addr, info in churn_async["joiners"].items():
+            first, joined = info["first_folded_window"], info["joined_at"]
+            if first is None or joined is None or first - joined > 2:
+                raise AssertionError(
+                    f"joiner {addr} did not contribute within 2 windows: {info}"
+                )
+        # The SAME trace must demonstrably stall (or abandon) sync rounds.
+        # PR 3's death callbacks make leaves survivable even in sync — the
+        # stall the barrier cannot escape is the JOIN side: a joiner is a
+        # live neighbor (it wins committee votes) with no entry path into
+        # the experiment, so every election after the first join burns the
+        # full VOTE_TIMEOUT, the joiner never contributes a sample, and the
+        # per-round wall stretches well past the async per-window wall.
+        sync_abandoned = not churn_sync["all_survivors_finished"]
+        stall_ratio = round(
+            churn_sync["mean_unit_wall_s"]
+            / max(1e-9, churn_async["mean_unit_wall_s"]),
+            2,
+        )
+        sync_joiners_dark = all(
+            info["first_folded_window"] is None
+            for info in churn_sync["joiners"].values()
+        )
+        if not sync_abandoned:
+            if churn_sync["vote_timeout_rounds"] == 0:
+                raise AssertionError(
+                    "sync churn leg finished without a single vote-timeout "
+                    f"round — the trace did not stall the barrier: {churn_sync}"
+                )
+            if not sync_joiners_dark:
+                raise AssertionError(
+                    f"sync mode integrated a joiner it has no path for: {churn_sync}"
+                )
+            if stall_ratio < 2.0:
+                raise AssertionError(
+                    f"sync churn rounds only {stall_ratio}x async windows "
+                    f"(expected >= 2x): sync {churn_sync}, async {churn_async}"
+                )
+
+        # --- arm 3: Byzantine signflip under async --------------------------
+        byz_rounds = 3  # accuracy saturates by 3 windows; keep the arm short
+
+        def byzantine_leg(n_adversaries: int) -> dict:
+            REGISTRY.reset()
+            TRACER.reset()
+            CHAOS.reset()
+            nodes, _ = build_fed(n_nodes)
+            adversaries = [nd.addr for nd in nodes[-n_adversaries:]] if n_adversaries else []
+            for addr in adversaries:
+                CHAOS.set_byzantine(addr, "signflip")
+            honest = [nd for nd in nodes if nd.addr not in adversaries]
+            try:
+                t0 = time.monotonic()
+                nodes[0].set_start_learning(rounds=byz_rounds, epochs=1, mode="async")
+                deadline = time.time() + 300
+                while time.time() < deadline:
+                    if all(
+                        finished(nd, byz_rounds, "AsyncWindowFinishedStage")
+                        for nd in honest
+                    ):
+                        break
+                    time.sleep(0.2)
+                else:
+                    raise TimeoutError("async byzantine leg did not finish")
+                wall = time.monotonic() - t0
+                nodes[0].set_stop_learning()
+                accs = [nd.learner.evaluate().get("test_acc", 0.0) for nd in honest]
+                rej = REGISTRY.get("p2pfl_updates_rejected_total")
+                rejections = (
+                    sum(c.value for _, c in rej.samples()) if rej is not None else 0
+                )
+                return {
+                    "wall_s": round(wall, 2),
+                    "final_test_acc_mean_honest": round(sum(accs) / len(accs), 4),
+                    "adversaries": adversaries,
+                    "rejections_total": int(rejections),
+                }
+            finally:
+                CHAOS.reset()
+                teardown(nodes)
+
+        _phase("async bench: byzantine arm (clean async baseline)")
+        byz_clean = byzantine_leg(0)
+        _phase(f"clean baseline done: {json.dumps(byz_clean)}")
+        _phase("async bench: byzantine arm (2 signflip adversaries)")
+        byz = byzantine_leg(2)
+        _phase(f"byzantine leg done: {json.dumps(byz)}")
+
+        byz_delta_pp = round(
+            100.0
+            * (
+                byz_clean["final_test_acc_mean_honest"]
+                - byz["final_test_acc_mean_honest"]
+            ),
+            2,
+        )
+        if byz_delta_pp > 0.0:
+            raise AssertionError(
+                f"async Byzantine arm lost {byz_delta_pp}pp "
+                f"(clean {byz_clean}, signflip {byz})"
+            )
+        if byz["rejections_total"] == 0:
+            raise AssertionError(
+                "admission control rejected nothing under async signflip — "
+                "contributions are not being screened"
+            )
+
+        perf = perf_section(REGISTRY)
+        out = {
+            "metric": f"async_vs_sync_throughput_{n_nodes}node_1x{slow_x:g}_straggler",
+            "value": throughput_x,
+            "unit": "x_fleet_round_window_throughput",
+            "vs_baseline": None,
+            "meta": _bench_meta(seed=seed, backend="cpu"),
+            "perf": perf,
+            "extra": {
+                "nodes": n_nodes,
+                "rounds_or_windows": rounds,
+                "seed": seed,
+                "slowdown_x": slow_x,
+                "fast_fit_floor_s": fast_floor_s,
+                "acc_delta_pp": acc_delta_pp,
+                "sync": sync_leg,
+                "async": async_leg,
+                "churn": {
+                    "trace_rounds": churn_rounds,
+                    "async": churn_async,
+                    "sync": churn_sync,
+                    "sync_stalled_or_abandoned": bool(
+                        sync_abandoned or churn_sync["vote_timeout_rounds"] > 0
+                    ),
+                    "sync_vote_timeout_rounds": churn_sync["vote_timeout_rounds"],
+                    "sync_joiners_never_contributed": bool(sync_joiners_dark),
+                    "sync_vs_async_unit_wall_x": stall_ratio,
+                },
+                "byzantine": {
+                    "clean": byz_clean,
+                    "signflip": byz,
+                    "acc_delta_pp": byz_delta_pp,
+                },
+                "note": "throughput = completed rounds (sync) or windows "
+                "(async) across the whole fleet per wall second; full-"
+                "participation committees so the sync barrier is set by the "
+                "straggler every round; async windows close on the buffer "
+                "fill target (ASYNC_BUFFER_K) with staleness-weighted folds",
+            },
+        }
+        os.makedirs("artifacts", exist_ok=True)
+        with open(os.path.join("artifacts", "ASYNC_BENCH.json"), "w") as f:
+            json.dump(out, f, indent=1)
+    except Exception as e:  # noqa: BLE001
+        traceback.print_exc(file=sys.stderr)
+        out["error"] = f"{type(e).__name__}: {e}"
+    _emit(out, seed=locals().get("seed"), backend="cpu")
+
+
 def run_byzantine_bench() -> None:
     """Subprocess-style mode ``--byzantine``: Byzantine defense acceptance.
 
@@ -3098,6 +3633,8 @@ if __name__ == "__main__":
         run_chaos_bench()
     elif "--byzantine" in sys.argv:
         run_byzantine_bench()
+    elif "--async" in sys.argv:
+        run_async_bench()
     elif "--attn" in sys.argv:
         run_attn_bench()
     elif "--lm-mfu" in sys.argv:

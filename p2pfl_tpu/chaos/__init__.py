@@ -6,7 +6,8 @@ from p2pfl_tpu.chaos.plane import (  # noqa: F401
     BYZANTINE_ATTACKS,
     CHAOS,
     ChaosPlane,
+    ChurnEvent,
     Decision,
 )
 
-__all__ = ["BYZANTINE_ATTACKS", "CHAOS", "ChaosPlane", "Decision"]
+__all__ = ["BYZANTINE_ATTACKS", "CHAOS", "ChaosPlane", "ChurnEvent", "Decision"]

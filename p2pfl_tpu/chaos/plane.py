@@ -85,6 +85,18 @@ BYZANTINE_ATTACKS = ("signflip", "scaled", "nan", "inflate")
 
 
 @dataclass(frozen=True)
+class ChurnEvent:
+    """One scheduled membership change: at round/window ``when``, ``node``
+    performs ``kind`` ("leave" — abrupt death via :meth:`Node.crash`; or
+    "join" — a cold node enters, in async mode via the full-model catch-up
+    bootstrap)."""
+
+    when: int
+    kind: str  # "leave" | "join"
+    node: str
+
+
+@dataclass(frozen=True)
 class _Byzantine:
     attack: str
     scale: float = 10.0
@@ -172,6 +184,52 @@ class ChaosPlane:
         """{addr: attack} view of the current adversary set."""
         with self._lock:
             return {a: b.attack for a, b in self._byzantine.items()}
+
+    def plan_churn(
+        self,
+        rounds: int,
+        leave_pool: Sequence[str],
+        join_pool: Sequence[str],
+        *,
+        seed: Optional[int] = None,
+        leaves_per_round: int = 1,
+        joins_per_round: int = 1,
+        start: int = 1,
+    ) -> Tuple["ChurnEvent", ...]:
+        """Seeded per-round membership-churn trace (elastic-federation
+        acceptance; reusable by sync benches to show what the barrier does
+        under the same trace).
+
+        Deterministic: the schedule is a pure function of ``(seed, pools,
+        shape)`` — leave victims are drawn without replacement from
+        ``leave_pool`` with a dedicated ``random.Random(f"{seed}|churn")``
+        stream; joiners enter in ``join_pool`` order. Executing an event is
+        the DRIVER's job (crash the node / start + connect + join the new
+        one); the driver reports each executed event via :meth:`churn` so it
+        lands in ``p2pfl_chaos_faults_total{fault="churn"}`` and the
+        determinism-assertion table like every other injected fault.
+        """
+        rng = random.Random(f"{seed if seed is not None else Settings.CHAOS_SEED}|churn")
+        leavers = list(leave_pool)
+        joiners = list(join_pool)
+        events = []
+        for r in range(max(1, start), rounds):
+            for _ in range(leaves_per_round):
+                if leavers:
+                    victim = leavers.pop(rng.randrange(len(leavers)))
+                    events.append(ChurnEvent(r, "leave", victim))
+            for _ in range(joins_per_round):
+                if joiners:
+                    events.append(ChurnEvent(r, "join", joiners.pop(0)))
+        return tuple(events)
+
+    def churn(self, addr: str, kind: str) -> None:
+        """Count one EXECUTED churn event (``kind`` is "join" | "leave" |
+        "rejoin" — recorded for the log line; the fault counter buckets them
+        all under ``fault="churn"``)."""
+        with self._lock:
+            self._count(addr, "churn")
+        log.warning("chaos: churn event %s %s", kind, addr)
 
     def set_slow(self, addr: str, extra_delay_s: float) -> None:
         """Straggler: every send involving ``addr`` stalls ``extra_delay_s``."""

@@ -17,6 +17,7 @@ from typing import TYPE_CHECKING, Any, List
 
 from p2pfl_tpu.comm.commands.command import Command
 from p2pfl_tpu.comm.delta import DELTA_META_KEY
+from p2pfl_tpu.config import Settings
 from p2pfl_tpu.exceptions import DeltaAnchorError
 from p2pfl_tpu.telemetry import TRACER, tracing
 
@@ -39,7 +40,10 @@ class StartLearningCommand(Command):
 
     def execute(self, source: str, round: int, *args: str, **kwargs: Any) -> None:
         rounds, epochs = int(args[0]), int(args[1])
-        self._node.start_learning_thread(rounds, epochs)
+        # Third arg (absent on older peers) selects the scheduler: "sync"
+        # rounds (default) or "async" elastic windows (stages/async_node.py).
+        mode = args[2] if len(args) > 2 else "sync"
+        self._node.start_learning_thread(rounds, epochs, mode=mode)
 
 
 class StopLearningCommand(Command):
@@ -335,3 +339,221 @@ class FullModelCommand(Command):
                 state.aggregated_model_event.set()
         except Exception:
             log.exception("full_model from %s failed", source)
+
+
+class AsyncContributionCommand(Command):
+    """Fold a peer's async contribution into the buffered aggregator.
+
+    The envelope ``round`` is the WINDOW the sender trained against; the
+    receiver computes the lag against its own window at fold time. Every
+    contribution passes the same wire path as sync partial models — delta
+    decode (against the multi-window anchor history), admission screening,
+    sample-count clamping — before it can weigh an aggregate, and the
+    observatory's suspect score gates admission on top (detect→act: a peer
+    the fleet attributes rejections to stops being folded at all)."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "async_model"
+
+    def execute(self, source: str, round: int, *args: str, **kwargs: Any) -> None:
+        node = self._node
+        state = node.state
+        agg = node.async_agg
+        if state.round is None or state.fed_mode != "async" or agg is None:
+            return  # not in an async session (mixed-mode peers tolerate)
+        gate = Settings.ASYNC_SUSPECT_GATE
+        if gate > 0:
+            try:
+                suspicion = node.protocol.observatory.suspect_score(source)
+            except Exception:  # noqa: BLE001
+                suspicion = 0.0
+            if suspicion >= gate:
+                agg.drop(source, "suspect")
+                node.protocol.flight_recorder.record(
+                    "async_drop", peer=source, reason="suspect", round=round
+                )
+                return
+        weights: bytes = kwargs["weights"]
+        contributors: List[str] = list(kwargs.get("contributors", [])) or [source]
+        num_samples: int = state.admission.clamp_num_samples(
+            int(kwargs.get("num_samples", 1)), source
+        )
+        try:
+            arrays, meta = state.wire.decode_frame(weights)
+        except DeltaAnchorError as exc:
+            # Anchored beyond the history window (sender lags or leads too
+            # far): drop — it keeps emitting every window, a later frame
+            # will land inside the history.
+            agg.drop(source, "anchor")
+            log.debug("async contribution from %s dropped: %s", source, exc)
+            return
+        except Exception as exc:  # corrupt/truncated frame
+            log.debug("async contribution from %s undecodable: %s", source, exc)
+            state.admission.record("corrupt", source, "async_model")
+            return
+        if state.admission.screen(
+            arrays, node.learner.get_model(), source=source, cmd="async_model"
+        ):
+            return
+        wire_ctx = meta.get(tracing.TRACE_META_KEY, "") or tracing.current_wire()
+        with TRACER.recv_span(
+            "apply:async_model", node.addr, wire_ctx, source=source, round=round
+        ):
+            model = node.learner.get_model().build_copy(
+                params=arrays, contributors=contributors, num_samples=num_samples
+            )
+            agg.fold(model, round, source)
+
+
+class AsyncDoneCommand(Command):
+    """A peer completed all of its async windows. The window fill target
+    stops counting it (it will produce no further contributions) and any
+    in-flight window wait re-evaluates immediately — without this, the last
+    nodes standing would burn ``ASYNC_WINDOW_TIMEOUT`` per remaining window
+    waiting on peers that already went home."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "async_done"
+
+    def execute(self, source: str, round: int, *args: str, **kwargs: Any) -> None:
+        node = self._node
+        node.state.async_done_peers.add(source)
+        if node.async_agg is not None:
+            node.async_agg.notify()
+
+
+class AsyncJoinCommand(Command):
+    """A peer wants to enter the running async experiment.
+
+    Every member that receives the (TTL-gossiped) join request replies with
+    the session parameters (``async_welcome``) plus a DENSE full-model
+    catch-up frame (``async_catchup``) — the joiner keeps the first of each,
+    the rest are idempotent no-ops. Sync experiments ignore joins: elastic
+    membership is exactly what the sync barrier cannot offer."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "async_join"
+
+    def execute(self, source: str, round: int, *args: str, **kwargs: Any) -> None:
+        node = self._node
+        state = node.state
+        if state.round is None or state.fed_mode != "async" or source == node.addr:
+            return
+        w = state.round or 0
+        node.protocol.flight_recorder.record("membership", event="join_request", peer=source)
+        try:
+            node.protocol.send(
+                source,
+                node.protocol.build_msg(
+                    AsyncWelcomeCommand.get_name(),
+                    args=[str(state.total_rounds or 0), str(state.epochs)],
+                    round=w,
+                ),
+                create_connection=True,
+                raise_error=False,
+                remove_on_error=False,
+            )
+            model = node.learner.get_model()
+            env = node.protocol.build_weights(
+                AsyncCatchupCommand.get_name(),
+                w,
+                model.encode_parameters(),  # always dense: the joiner holds no anchor
+                model.contributors or [node.addr],
+                model.get_num_samples(),
+            )
+            node.protocol.send(
+                source, env, create_connection=True,
+                raise_error=False, remove_on_error=False,
+            )
+        except Exception:  # noqa: BLE001 — a failed welcome must not hurt us
+            log.exception("async_join reply to %s failed", source)
+
+
+class AsyncWelcomeCommand(Command):
+    """Session parameters for a joiner: total windows + epochs in ``args``,
+    the sender's current window in ``round``. The joiner's experiment starts
+    fast-forwarded to that window; duplicate welcomes no-op."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "async_welcome"
+
+    def execute(self, source: str, round: int, *args: str, **kwargs: Any) -> None:
+        node = self._node
+        if node.learning_in_progress():
+            return
+        total = int(args[0])
+        epochs = int(args[1]) if len(args) > 1 else 1
+        if total <= 0 or int(round) >= total:
+            return  # session is over (or malformed) — nothing to join
+        log.info(
+            "%s: joining async experiment at window %s/%s (welcomed by %s)",
+            node.addr, round, total, source,
+        )
+        node.start_learning_thread(
+            total, epochs, mode="async", start_round=int(round)
+        )
+
+
+class AsyncCatchupCommand(Command):
+    """Dense full-model bootstrap for a cold joiner: adopt the weights,
+    resync the sparse-delta anchor to the sender's window (residual-dropping
+    :meth:`DeltaWireCodec.resync` — the rejoin path built in PR 3), and mark
+    the model initialized so :class:`AsyncStartStage` proceeds. A node that
+    already holds an initialized model ignores catch-ups — rejoining live
+    nodes converge through the normal staleness-weighted folds instead."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "async_catchup"
+
+    def execute(self, source: str, round: int, *args: str, **kwargs: Any) -> None:
+        from p2pfl_tpu.models.model_handle import decode_wire_frame
+
+        node = self._node
+        state = node.state
+        if state.model_initialized_event.is_set():
+            return
+        weights: bytes = kwargs["weights"]
+        try:
+            arrays, meta = decode_wire_frame(weights)
+        except Exception as exc:
+            log.debug("async_catchup from %s undecodable: %s", source, exc)
+            state.admission.record("corrupt", source, "async_catchup")
+            return
+        # Structure + finiteness screening; no norm bound — a joiner's local
+        # random init is arbitrarily far from the trained federation model
+        # (same rationale as full_model adoption, comm/admission.py).
+        if state.admission.screen(
+            arrays, node.learner.get_model(),
+            source=source, cmd="async_catchup", check_norm=False,
+        ):
+            return
+        try:
+            node.learner.get_model().apply_frame(arrays, meta)
+            state.wire.resync(node.learner.get_model().get_parameters(), int(round))
+            state.last_full_model_round = max(state.last_full_model_round, int(round))
+            state.model_initialized_event.set()
+            node.protocol.flight_recorder.record(
+                "membership", event="catchup", peer=source, window=int(round)
+            )
+        except Exception:
+            log.exception("async_catchup from %s failed", source)

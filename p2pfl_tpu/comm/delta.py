@@ -115,6 +115,15 @@ class DeltaWireCodec:
         self._anchor_round: int = -1
         self._anchor_crc: int = 0
         self._residual: Optional[List[Any]] = None  # float32 flat, jax arrays
+        # Anchor HISTORY for elastic async federation: windows advance per
+        # node, so a lagging peer's sparse frame may be anchored several
+        # windows back — keep the last ``anchor_history`` anchors (round ->
+        # (flat leaves, shapes, crc)) so those frames still decode. Sync mode
+        # keeps the default depth 1 (one round, one anchor — the pre-async
+        # behavior, byte for byte). The async scheduler raises it to
+        # ``Settings.ASYNC_ANCHOR_HISTORY``.
+        self.anchor_history: int = 1
+        self._history: Dict[int, Tuple[List[np.ndarray], List[tuple], int]] = {}
         # wire accounting (encode side): frames/bytes by (sparse|dense)
         self.sparse_frames = 0
         self.dense_fallback_frames = 0
@@ -132,10 +141,22 @@ class DeltaWireCodec:
                 or [f.size for f in flat] != [int(np.prod(s, dtype=np.int64)) for s in self._shapes]
             ):
                 self._residual = None
+            # Retire the outgoing anchor into the history ring (async keeps
+            # several so lagging peers' frames decode; depth 1 keeps none).
+            if self._anchor is not None and self._anchor_round != int(round):
+                self._history[self._anchor_round] = (
+                    self._anchor, self._shapes, self._anchor_crc
+                )
             self._anchor = flat
             self._shapes = shapes
             self._anchor_round = int(round)
             self._anchor_crc = _leaf_crc(flat)
+            self._history.pop(self._anchor_round, None)
+            # Trim: current + (anchor_history - 1) most recent retired rounds.
+            excess = len(self._history) - max(0, self.anchor_history - 1)
+            if excess > 0:
+                for r in sorted(self._history)[:excess]:
+                    del self._history[r]
 
     @property
     def anchor_round(self) -> int:
@@ -148,9 +169,12 @@ class DeltaWireCodec:
         one-round-boundary advance, where residuals carry over — this DROPS
         the error-feedback residuals: they accumulated against a model
         generation the federation has moved past, and replaying them against
-        the resynced anchor would inject stale mass into the next frames."""
+        the resynced anchor would inject stale mass into the next frames.
+        The anchor history is dropped too — retired anchors from before the
+        divergence would decode in-flight frames into the wrong generation."""
         with self._lock:
             self._residual = None
+            self._history.clear()
         self.set_anchor(leaves, round)
 
     def reset(self) -> None:
@@ -160,6 +184,7 @@ class DeltaWireCodec:
             self._anchor_round = -1
             self._anchor_crc = 0
             self._residual = None
+            self._history.clear()
 
     # --- encode -------------------------------------------------------------
 
@@ -288,22 +313,29 @@ class DeltaWireCodec:
             raise DecodingParamsError(f"malformed delta frame metadata: {exc}") from exc
 
         with self._lock:
-            if self._anchor is None or self._anchor_round != frame_round:
+            if self._anchor is not None and self._anchor_round == frame_round:
+                anchor, shapes, crc = self._anchor, self._shapes, self._anchor_crc
+            elif frame_round in self._history:
+                # Async lagging peer: the frame is anchored a few windows
+                # back — decode against the retired anchor of that window.
+                anchor, shapes, crc = self._history[frame_round]
+            else:
                 raise DeltaAnchorError(
                     f"no anchor for round {frame_round} "
-                    f"(local anchor round: {self._anchor_round})"
+                    f"(local anchor round: {self._anchor_round}, "
+                    f"history: {sorted(self._history)})"
                 )
-            if frame_crc and frame_crc != self._anchor_crc:
+            if frame_crc and frame_crc != crc:
                 # Expected at fp-noise level in live federations (module
                 # docstring); loud only for observability of true divergence.
                 log.debug(
                     "(%s) delta frame anchor fingerprint differs "
                     "(round %s, theirs %08x vs ours %08x) — applying anyway",
                     self._addr, frame_round, frame_crc & 0xFFFFFFFF,
-                    self._anchor_crc & 0xFFFFFFFF,
+                    crc & 0xFFFFFFFF,
                 )
             try:
-                return self._reconstruct(arrays, spec), meta
+                return self._reconstruct(arrays, spec, anchor, shapes), meta
             except DecodingParamsError:
                 raise
             except Exception as exc:
@@ -312,16 +344,20 @@ class DeltaWireCodec:
                 ) from exc
 
     def _reconstruct(
-        self, arrays: Sequence[np.ndarray], spec: Sequence[Dict[str, Any]]
+        self,
+        arrays: Sequence[np.ndarray],
+        spec: Sequence[Dict[str, Any]],
+        anchor: List[np.ndarray],
+        shapes: List[tuple],
     ) -> List[np.ndarray]:
         """anchor + scatter(delta) per leaf (caller holds the lock)."""
         import jax.numpy as jnp
 
         from p2pfl_tpu.ops.aggregation import sparse_delta_apply
 
-        if len(spec) != len(self._anchor):
+        if len(spec) != len(anchor):
             raise DecodingParamsError(
-                f"delta frame has {len(spec)} tensors, model has {len(self._anchor)}"
+                f"delta frame has {len(spec)} tensors, model has {len(anchor)}"
             )
         expected = sum(int(s.get("parts", 1)) for s in spec)
         if expected != len(arrays):
@@ -341,18 +377,18 @@ class DeltaWireCodec:
             packed, vals = arrays[pos], arrays[pos + 1]
             pos += 2
             shape = tuple(s["shape"])
-            if shape != self._shapes[i]:
+            if shape != shapes[i]:
                 raise DecodingParamsError(
-                    f"delta tensor {i} shape {shape} != model {self._shapes[i]}"
+                    f"delta tensor {i} shape {shape} != model {shapes[i]}"
                 )
             idx = decode_sparse_indices(np.asarray(packed), s["index_codec"])
-            size = self._anchor[i].size
+            size = anchor[i].size
             if idx.size != np.asarray(vals).size:
                 raise DecodingParamsError("sparse index/values length mismatch")
             if idx.size and (int(idx[-1]) >= size or int(idx[0]) < 0):
                 raise DecodingParamsError("sparse index out of tensor bounds")
             dense = sparse_delta_apply(
-                jnp.asarray(self._anchor[i]),
+                jnp.asarray(anchor[i]),
                 jnp.asarray(idx, jnp.int32),
                 jnp.asarray(np.asarray(vals).astype(np.float32)),
             )

@@ -88,8 +88,10 @@ class CommunicationProtocol:
         # Federation observatory + flight recorder (telemetry/): the
         # observatory assembles peers' heartbeat-piggybacked health digests
         # into a fleet view; the recorder keeps the postmortem event ring.
-        self.observatory = Observatory(self._addr)
         self.flight_recorder = FlightRecorder(self._addr)
+        # The observatory records membership transitions (join/rejoin/leave)
+        # into the flight recorder — churn is postmortem-worthy.
+        self.observatory = Observatory(self._addr, recorder=self.flight_recorder)
         # Digest source: returns this node's HealthDigest for the next beat.
         # The default sees only the registry; Node swaps in a state-aware
         # provider (round/stage); None disables emission entirely (the node

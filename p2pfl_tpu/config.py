@@ -157,6 +157,45 @@ class Settings:
             "('bf16', 'float32')"
         )
 
+    # --- elastic async federation (stages/async_node.py) --------------------
+    # Buffered asynchronous aggregation in the Papaya/FedBuff style (arxiv
+    # 2111.04877): no vote barrier, no fleet-wide aggregation deadline. Each
+    # node runs WINDOWS instead of rounds: train locally, broadcast the
+    # contribution, fold whatever arrived (staleness-weighted), advance. All
+    # values validated at load with the WIRE_COMPRESSION fail-fast pattern.
+    #
+    # Window fill target: close the window once this many distinct
+    # contributors (self included) have been folded. The effective target is
+    # min(ASYNC_BUFFER_K, live non-deprioritized participants + 1), so peer
+    # deaths shrink it instead of stalling the window.
+    ASYNC_BUFFER_K: int = _env_int("ASYNC_BUFFER_K", 3, 1, 4096)
+    # Hard cap on one window's wait for the fill target; on expiry the window
+    # closes with whatever arrived (own contribution at minimum).
+    ASYNC_WINDOW_TIMEOUT: float = _env_float("ASYNC_WINDOW_TIMEOUT", 30.0, 0.1, 3600.0)
+    # Staleness decay exponent: a contribution that trained against window
+    # w-l is weighted num_samples * (1+l)^-alpha (polynomial staleness
+    # discounting, Papaya §4). 0 disables the discount — every contribution
+    # weighs its plain sample count, which makes a zero-staleness window
+    # bit-exact FedAvg.
+    ASYNC_STALENESS_ALPHA: float = _env_float("ASYNC_STALENESS_ALPHA", 0.5, 0.0, 16.0)
+    # Contributions lagging more than this many windows are dropped (counted
+    # p2pfl_async_dropped_total{reason="stale_limit"}) instead of folded —
+    # beyond it the origin model generation is too far gone to help.
+    ASYNC_MAX_STALENESS: int = _env_int("ASYNC_MAX_STALENESS", 10, 0, 1 << 20)
+    # Sparse-delta anchor history under async: windows advance per node, so a
+    # lagging peer's frame may be anchored several windows back — the codec
+    # keeps this many recent anchors to decode it (sync uses 1: one round,
+    # one anchor).
+    ASYNC_ANCHOR_HISTORY: int = _env_int("ASYNC_ANCHOR_HISTORY", 4, 1, 64)
+    # Observatory-driven participation (closes PR 5's detect->act loop):
+    # peers whose fleet suspect score reaches the gate are not solicited and
+    # their contributions are dropped (reason="suspect"); peers whose
+    # straggler score reaches the gate are deprioritized — still folded when
+    # they arrive, but the window fill target never waits on them. 0 disables
+    # the respective gate.
+    ASYNC_SUSPECT_GATE: float = _env_float("ASYNC_SUSPECT_GATE", 1.0, 0.0, 1e9)
+    ASYNC_STRAGGLER_GATE: float = _env_float("ASYNC_STRAGGLER_GATE", 2.0, 0.0, 1e9)
+
     # --- learning round -----------------------------------------------------
     TRAIN_SET_SIZE: int = _env_override("TRAIN_SET_SIZE", 4)
     VOTE_TIMEOUT: float = _env_override("VOTE_TIMEOUT", 60.0)

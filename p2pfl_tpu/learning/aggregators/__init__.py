@@ -1,5 +1,9 @@
 """Aggregators: round-scoped accumulators wrapping the jitted kernels."""
 
+from p2pfl_tpu.learning.aggregators.async_buffer import (  # noqa: F401
+    AsyncBufferedAggregator,
+    staleness_weight,
+)
 from p2pfl_tpu.learning.aggregators.base import Aggregator  # noqa: F401
 from p2pfl_tpu.learning.aggregators.fedavg import FedAvg  # noqa: F401
 from p2pfl_tpu.learning.aggregators.fedmedian import FedMedian  # noqa: F401
@@ -12,6 +16,7 @@ from p2pfl_tpu.learning.aggregators.robust import (  # noqa: F401
 from p2pfl_tpu.learning.aggregators.scaffold import Scaffold  # noqa: F401
 
 __all__ = [
-    "Aggregator", "FedAvg", "FedMedian", "GeometricMedian", "Krum",
-    "MultiKrum", "TrimmedMean", "Scaffold",
+    "Aggregator", "AsyncBufferedAggregator", "FedAvg", "FedMedian",
+    "GeometricMedian", "Krum", "MultiKrum", "TrimmedMean", "Scaffold",
+    "staleness_weight",
 ]

@@ -20,6 +20,11 @@ import time
 from typing import List, Optional, Type
 
 from p2pfl_tpu.comm.commands.impl import (
+    AsyncCatchupCommand,
+    AsyncContributionCommand,
+    AsyncDoneCommand,
+    AsyncJoinCommand,
+    AsyncWelcomeCommand,
     FullModelCommand,
     InitModelCommand,
     MetricsCommand,
@@ -41,7 +46,7 @@ from p2pfl_tpu.learning.learner import JaxLearner, Learner
 from p2pfl_tpu.management.logger import logger
 from p2pfl_tpu.models.model_handle import ModelHandle
 from p2pfl_tpu.node_state import NodeState
-from p2pfl_tpu.stages.workflow import LearningWorkflow
+from p2pfl_tpu.stages.workflow import LearningWorkflow, scheduler_start_stage
 from p2pfl_tpu.telemetry import TRACER, tracing
 
 
@@ -96,6 +101,10 @@ class Node:
         self._workflow: Optional[LearningWorkflow] = None
         self._learning_thread: Optional[threading.Thread] = None
         self._running = False
+        # Buffered async aggregator (elastic async mode only): built per
+        # experiment by start_learning_thread, fed by AsyncContributionCommand
+        # on transport threads, drained by AsyncWindowStage.
+        self.async_agg = None
         # Fired (with this node) after each round completes; used by e.g.
         # checkpoint.attach_node_checkpointing.
         self.round_end_hooks: List = []
@@ -133,6 +142,12 @@ class Node:
                 InitModelCommand(self),
                 PartialModelCommand(self),
                 FullModelCommand(self),
+                # Elastic async federation (stages/async_node.py).
+                AsyncContributionCommand(self),
+                AsyncJoinCommand(self),
+                AsyncWelcomeCommand(self),
+                AsyncCatchupCommand(self),
+                AsyncDoneCommand(self),
             ]
         )
 
@@ -191,6 +206,8 @@ class Node:
             return
         self.learner.interrupt_fit()
         self.aggregator.clear()
+        if self.async_agg is not None:
+            self.async_agg.clear()
         self.state.experiment = None  # stage machine exits via early-stop
         self.state.votes_ready_event.set()
         self.state.aggregated_model_event.set()
@@ -211,9 +228,21 @@ class Node:
 
     # --- learning control (reference node.py:333-397) -----------------------
 
-    def set_start_learning(self, rounds: int = 1, epochs: int = 1) -> None:
+    def set_start_learning(
+        self, rounds: int = 1, epochs: int = 1, mode: str = "sync"
+    ) -> None:
+        """Kick off a federation-wide learning session.
+
+        ``mode`` selects the scheduler every node runs: ``"sync"`` — the
+        barrier round machine (vote → train → aggregate → gossip); or
+        ``"async"`` — elastic windows with buffered staleness-weighted
+        aggregation and first-class mid-experiment join/leave
+        (stages/async_node.py). ``rounds`` counts windows in async mode.
+        """
         if rounds < 1:
             raise ZeroRoundsException("rounds must be >= 1")
+        if mode not in ("sync", "async"):
+            raise ValueError(f"mode must be 'sync' or 'async', got {mode!r}")
         if self.learning_in_progress():
             raise LearningRunningException("learning already in progress")
         # Mint the federation-wide trace id: the kickoff broadcasts run
@@ -226,7 +255,8 @@ class Node:
             # Kick off peers first, then ourselves (reference node.py:359-370).
             self.protocol.broadcast(
                 self.protocol.build_msg(
-                    StartLearningCommand.get_name(), args=[str(rounds), str(epochs)]
+                    StartLearningCommand.get_name(),
+                    args=[str(rounds), str(epochs), mode],
                 )
             )
             # The initiator's weights seed the federation: mark our model
@@ -238,7 +268,7 @@ class Node:
             self.protocol.broadcast(
                 self.protocol.build_msg(ModelInitializedCommand.get_name())
             )
-            self.start_learning_thread(rounds, epochs)
+            self.start_learning_thread(rounds, epochs, mode=mode)
         # The kickoff must survive message loss: start_learning is a single
         # fire-once control frame, and in a star topology there is no second
         # path that can re-deliver it — one dropped frame leaves an alive
@@ -248,12 +278,12 @@ class Node:
         # missing the first frame still joins during round 0's vote window.
         threading.Thread(
             target=self._rebroadcast_kickoff,
-            args=(rounds, epochs),
+            args=(rounds, epochs, mode),
             name=f"kickoff-{self.addr}",
             daemon=True,
         ).start()
 
-    def _rebroadcast_kickoff(self, rounds: int, epochs: int) -> None:
+    def _rebroadcast_kickoff(self, rounds: int, epochs: int, mode: str = "sync") -> None:
         for _ in range(2):
             time.sleep(max(0.25, Settings.HEARTBEAT_PERIOD))
             if self.state.experiment is None or not self._running:
@@ -262,7 +292,7 @@ class Node:
                 self.protocol.broadcast(
                     self.protocol.build_msg(
                         StartLearningCommand.get_name(),
-                        args=[str(rounds), str(epochs)],
+                        args=[str(rounds), str(epochs), mode],
                     )
                 )
             except Exception:  # protocol stopping — nothing to re-deliver to
@@ -272,9 +302,19 @@ class Node:
         self.protocol.broadcast(self.protocol.build_msg(StopLearningCommand.get_name()))
         self.stop_learning_locally()
 
-    def start_learning_thread(self, rounds: int, epochs: int) -> None:
+    def start_learning_thread(
+        self,
+        rounds: int,
+        epochs: int,
+        mode: str = "sync",
+        start_round: int = 0,
+    ) -> None:
         """Spawn the stage machine on a daemon thread (idempotent per
-        session; also the handler body of the start_learning command)."""
+        session; also the handler body of the start_learning command).
+
+        ``mode`` picks the scheduler over the shared stage machine
+        (``scheduler_start_stage``); ``start_round`` fast-forwards a
+        mid-experiment async joiner to the window its welcome reported."""
         with self.state.start_thread_lock:
             if self.learning_in_progress():
                 return
@@ -284,9 +324,24 @@ class Node:
             # span (direct API use) it stays None -> fresh local trace.
             self.state.trace_id = tracing.current_trace_id()
             self.state.set_experiment(f"experiment-{self.addr}", rounds)
+            if start_round > 0:
+                self.state.experiment.round = int(start_round)
+            self.state.fed_mode = mode
+            self.state.epochs = int(epochs)
+            if mode == "async":
+                from p2pfl_tpu.learning.aggregators import AsyncBufferedAggregator
+
+                # Linear rules use the staleness-weighted kernel; non-linear
+                # (robust) rules see the buffered individuals, same as sync.
+                rule = (
+                    None
+                    if isinstance(self.aggregator, FedAvg)
+                    else self.aggregator.aggregate
+                )
+                self.async_agg = AsyncBufferedAggregator(self.addr, rule)
             logger.experiment_started(self.addr, self.state.experiment)
             self.learner.set_epochs(epochs)
-            self._workflow = LearningWorkflow()
+            self._workflow = LearningWorkflow(scheduler_start_stage(mode))
             self._learning_thread = threading.Thread(
                 target=self._workflow.run,
                 kwargs={"node": self},
@@ -295,11 +350,23 @@ class Node:
             )
             self._learning_thread.start()
 
+    def request_async_join(self) -> None:
+        """Ask a running elastic async federation to take this node in:
+        broadcast a (TTL-gossiped) join request; any member replies with the
+        session parameters and a dense full-model catch-up. Call after
+        :meth:`connect`-ing to at least one member. Idempotent — duplicate
+        welcomes no-op once learning is in progress."""
+        self.protocol.broadcast(
+            self.protocol.build_msg(AsyncJoinCommand.get_name())
+        )
+
     def stop_learning_locally(self) -> None:
         """Abort the in-progress session (reference stop semantics: clear
         experiment state; stages observe it via check_early_stop)."""
         self.learner.interrupt_fit()
         self.aggregator.clear()
+        if self.async_agg is not None:
+            self.async_agg.clear()  # also wakes any in-flight window wait
         self.state.experiment = None
         self.state.train_set = []
         self.state.votes_ready_event.set()
@@ -339,6 +406,11 @@ class Node:
         state = self.state
         if state.experiment is None:
             return
+        if self.async_agg is not None:
+            # Async windows have no per-peer expectation — but the fill
+            # target counts live membership, so wake the window wait to
+            # re-evaluate it without the dead peer.
+            self.async_agg.notify()
         in_train_set = addr in state.train_set
         if in_train_set:
             # Rebind (don't mutate): stages iterate the current binding.

@@ -66,6 +66,19 @@ class NodeState:
         # gossiped to the fleet in the node's health digest so peers can see
         # WHERE a stalled node is stuck, not just that it lags.
         self.current_stage: str = ""
+        # Scheduler of the running experiment: "sync" (barrier rounds) or
+        # "async" (elastic windows, stages/async_node.py). Set by
+        # Node.start_learning_thread; meaningful only while an experiment is
+        # in progress.
+        self.fed_mode: str = "sync"
+        # Epochs per round/window — kept so a mid-experiment joiner can be
+        # welcomed with the session's parameters (AsyncJoinCommand).
+        self.epochs: int = 1
+        # Async peers that announced they finished their windows
+        # (async_done): the window fill target stops counting them — a
+        # finished peer produces no more contributions, and waiting on one
+        # would burn the window timeout (the last-node-standing case).
+        self.async_done_peers: set = set()
 
         # Learning info (populated by commands / stages).
         self.models_aggregated: Dict[str, List[str]] = {}
@@ -104,6 +117,7 @@ class NodeState:
     def set_experiment(self, exp_name: str, total_rounds: int) -> None:
         """Start (or restart) an experiment and flip status to Learning."""
         self.status = "Learning"
+        self.async_done_peers = set()
         self.experiment = Experiment(exp_name=exp_name, total_rounds=total_rounds)
 
     def increase_round(self) -> None:

@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from p2pfl_tpu.utils.compat import shape_dtype_struct
+
 try:  # pltpu is importable on CPU builds too; guard for safety
     from jax.experimental.pallas import tpu as pltpu
 except ImportError:  # pragma: no cover
@@ -589,9 +591,9 @@ def flash_chunk_update(
         functools.partial(_flash_carry_kernel, causal=causal),
         grid_spec=grid_spec,
         out_shape=(
-            jax.ShapeDtypeStruct((b, h, sq, 128), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((b, h, sq, 128), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((b, h, sq, d), jnp.float32, vma=vma),
+            shape_dtype_struct((b, h, sq, 128), jnp.float32, vma=vma),
+            shape_dtype_struct((b, h, sq, 128), jnp.float32, vma=vma),
+            shape_dtype_struct((b, h, sq, d), jnp.float32, vma=vma),
         ),
         interpret=interpret,
     )(offs, qt, kt, vt, m, l, acc)

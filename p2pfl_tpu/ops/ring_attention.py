@@ -30,6 +30,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from p2pfl_tpu.utils.compat import HAS_NATIVE_SHARD_MAP, pvary
 from p2pfl_tpu.ops.attention import (
     blockwise_update,
     finalize_carry,
@@ -66,7 +67,7 @@ def _ring_blockwise(q, k, v, axis_name, causal, block_k):
     # so the scan's carry types line up under shard_map's vma checking.
     carry0 = (
         jax.tree.map(
-            lambda x: jax.lax.pcast(x, (axis_name,), to="varying"), init_carry(q.shape)
+            lambda x: pvary(x, axis_name), init_carry(q.shape)
         ),
         k,
         v,
@@ -90,7 +91,7 @@ def _ring_flash(q, k, v, axis_name, causal, block_k):
 
     # Kernel ("BHSD") layout once per call; kv chunks rotate pre-transposed.
     qt = jnp.moveaxis(q, 2, 1)
-    var = lambda x: jax.lax.pcast(x, (axis_name,), to="varying")  # noqa: E731
+    var = lambda x: pvary(x, axis_name)  # noqa: E731
     m0 = var(jnp.full((b, h, s_local, 128), -jnp.inf, jnp.float32))
     l0 = var(jnp.zeros((b, h, s_local, 128), jnp.float32))
     acc0 = var(jnp.zeros((b, h, s_local, d), jnp.float32))
@@ -118,7 +119,16 @@ def _ring_flash(q, k, v, axis_name, causal, block_k):
         return ((m, l, acc), kc, vc, origin), None
 
     carry0 = ((m0, l0, acc0), jnp.moveaxis(k, 2, 1), jnp.moveaxis(v, 2, 1), idx)
-    ((m, l, acc), _, _, _), _ = jax.lax.scan(step, carry0, None, length=n)
+    if HAS_NATIVE_SHARD_MAP:
+        ((m, l, acc), _, _, _), _ = jax.lax.scan(step, carry0, None, length=n)
+    else:
+        # Old-jax fallback: an interpreted pallas_call inside lax.scan under
+        # shard_map trips SPMD lowering (PartitionId is unimplemented for the
+        # host partitioner). n is a trace-time constant, so unroll the ring.
+        carry = carry0
+        for _ in range(n):
+            carry, _ = step(carry, None)
+        (m, l, acc), _, _, _ = carry
     out = (acc / jnp.maximum(l[..., :1], 1e-30)).astype(q.dtype)
     return jnp.moveaxis(out, 1, 2)
 

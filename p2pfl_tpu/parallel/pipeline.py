@@ -30,6 +30,8 @@ from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from p2pfl_tpu.utils.compat import shard_map
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -108,7 +110,7 @@ def pipeline_apply(
     ``mesh[axis_name]``. Stage parameters must be stage-stacked (leading
     axis S on every leaf)."""
     body = pipeline_spmd(block_fn, n_microbatches, axis_name)
-    fn = jax.shard_map(
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axis_name), P()),

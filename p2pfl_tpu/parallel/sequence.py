@@ -16,6 +16,8 @@ from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from p2pfl_tpu.utils.compat import shard_map
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -45,7 +47,7 @@ def sequence_parallel_attention(
         and next(iter(mesh.devices.flat)).platform != "tpu"
     )
     spec = P(None, seq_axis, None, None)
-    return jax.shard_map(
+    return shard_map(
         partial(
             ring_attention, axis_name=seq_axis, causal=causal,
             block_k=block_k, impl=impl,
@@ -72,7 +74,7 @@ def sequence_parallel_apply(
     """
     tok_spec = P(batch_axis, seq_axis)
     out_spec = P(batch_axis, seq_axis, None)
-    return jax.shard_map(
+    return shard_map(
         model_apply,
         mesh=mesh,
         in_specs=(P(), tok_spec),
@@ -122,7 +124,7 @@ def sequence_parallel_lm_loss(
         return loss_sum / jnp.maximum(count, 1.0)
 
     tok_spec = P(batch_axis, seq_axis)
-    return jax.shard_map(
+    return shard_map(
         local_loss,
         mesh=mesh,
         in_specs=(P(), tok_spec),

@@ -34,6 +34,75 @@ if TYPE_CHECKING:  # pragma: no cover
 log = logging.getLogger("p2pfl_tpu")
 
 
+def establish_initial_model(node: "Node") -> bool:
+    """Shared session bootstrap for BOTH schedulers (sync rounds and async
+    windows): wait until this node holds an initialized model, let heartbeat
+    membership converge, snapshot the round-0 delta anchor, and diffuse the
+    initial weights to uninitialized direct neighbors. Returns False when
+    learning was stopped mid-bootstrap.
+
+    The initiator set the event in ``set_start_learning``; everyone else
+    adopts the initiator's weights via InitModelCommand (which announces for
+    us). Mirrors the reference's model_initialized_lock wait
+    (start_learning_stage.py:44-113) — a shared round-0 starting model is
+    required for SCAFFOLD and for meaningful FedAvg round counts.
+    """
+    state = node.state
+    deadline = time.time() + Settings.VOTE_TIMEOUT
+    while not state.model_initialized_event.wait(timeout=0.5):
+        if check_early_stop(node):
+            return False
+        if time.time() >= deadline:
+            log.warning(
+                "%s: init-model wait timed out — proceeding with local weights",
+                node.addr,
+            )
+            state.model_initialized_event.set()
+            node.protocol.broadcast(
+                node.protocol.build_msg(ModelInitializedCommand.get_name())
+            )
+            break
+    # Let heartbeats propagate membership before voting
+    # (reference start_learning_stage.py:78-84).
+    time.sleep(Settings.WAIT_HEARTBEATS_CONVERGENCE)
+
+    # Diffuse initial weights to direct neighbors that haven't announced
+    # an initialized model yet (reference :86-113).
+    def candidates() -> List[str]:
+        return [
+            n
+            for n in node.protocol.get_neighbors(only_direct=True)
+            if n not in state.nei_status
+        ]
+
+    # The model doesn't change during this stage — serialize once, not
+    # per candidate per gossip tick.
+    model = node.learner.get_model()
+    # Round-0 anchor for the sparse delta wire path: every node holds the
+    # initiator's weights at this point (own for the initiator, adopted
+    # via InitModelCommand otherwise), so deltas anchored here reconstruct
+    # on every peer. Init frames themselves always ship dense — their
+    # receivers have no anchor yet by definition.
+    state.wire.set_anchor(model.get_parameters(), state.round or 0)
+    payload = model.encode_parameters()
+    env = node.protocol.build_weights(
+        InitModelCommand.get_name(),
+        state.round or 0,
+        payload,
+        model.contributors or [node.addr],
+        model.get_num_samples(),
+    )
+
+    with TRACER.span("diffuse:init_model", node=node.addr, round=state.round):
+        node.protocol.gossip_weights(
+            early_stopping_fn=lambda: check_early_stop(node),
+            get_candidates_fn=candidates,
+            status_fn=lambda: sorted(candidates()),
+            model_fn=lambda nei: env,
+        )
+    return not check_early_stop(node)
+
+
 class StartLearningStage(Stage):
     """Set up the experiment, announce/diffuse the initial model
     (reference stages/base_node/start_learning_stage.py:35-113)."""
@@ -42,66 +111,7 @@ class StartLearningStage(Stage):
 
     @staticmethod
     def execute(node: "Node") -> Optional[Type[Stage]]:
-        state = node.state
-        # Wait until this node holds an initialized model: the initiator set
-        # the event in set_start_learning; everyone else adopts the
-        # initiator's weights via InitModelCommand (which announces for us).
-        # Mirrors the reference's model_initialized_lock wait
-        # (start_learning_stage.py:44-84) — a shared round-0 starting model
-        # is required for SCAFFOLD and for meaningful FedAvg round counts.
-        deadline = time.time() + Settings.VOTE_TIMEOUT
-        while not state.model_initialized_event.wait(timeout=0.5):
-            if check_early_stop(node):
-                return None
-            if time.time() >= deadline:
-                log.warning(
-                    "%s: init-model wait timed out — proceeding with local weights",
-                    node.addr,
-                )
-                state.model_initialized_event.set()
-                node.protocol.broadcast(
-                    node.protocol.build_msg(ModelInitializedCommand.get_name())
-                )
-                break
-        # Let heartbeats propagate membership before voting
-        # (reference start_learning_stage.py:78-84).
-        time.sleep(Settings.WAIT_HEARTBEATS_CONVERGENCE)
-
-        # Diffuse initial weights to direct neighbors that haven't announced
-        # an initialized model yet (reference :86-113).
-        def candidates() -> List[str]:
-            return [
-                n
-                for n in node.protocol.get_neighbors(only_direct=True)
-                if n not in state.nei_status
-            ]
-
-        # The model doesn't change during this stage — serialize once, not
-        # per candidate per gossip tick.
-        model = node.learner.get_model()
-        # Round-0 anchor for the sparse delta wire path: every node holds the
-        # initiator's weights at this point (own for the initiator, adopted
-        # via InitModelCommand otherwise), so deltas anchored here reconstruct
-        # on every peer. Init frames themselves always ship dense — their
-        # receivers have no anchor yet by definition.
-        state.wire.set_anchor(model.get_parameters(), state.round or 0)
-        payload = model.encode_parameters()
-        env = node.protocol.build_weights(
-            InitModelCommand.get_name(),
-            state.round or 0,
-            payload,
-            model.contributors or [node.addr],
-            model.get_num_samples(),
-        )
-
-        with TRACER.span("diffuse:init_model", node=node.addr, round=state.round):
-            node.protocol.gossip_weights(
-                early_stopping_fn=lambda: check_early_stop(node),
-                get_candidates_fn=candidates,
-                status_fn=lambda: sorted(candidates()),
-                model_fn=lambda nei: env,
-            )
-        if check_early_stop(node):
+        if not establish_initial_model(node):
             return None
         return VoteTrainSetStage
 

@@ -31,12 +31,30 @@ _STAGE_DURATION = REGISTRY.histogram(
 )
 
 
+def scheduler_start_stage(mode: str) -> Type[Stage]:
+    """Entry stage of a scheduler over the shared stage machine.
+
+    Two schedulers exist (the ROADMAP refactor note): ``"sync"`` — barrier
+    rounds (vote → train → aggregate → gossip, stages/base_node.py) — and
+    ``"async"`` — elastic buffered windows with staleness weighting
+    (stages/async_node.py). Both drive the same :class:`LearningWorkflow`
+    while-loop; a scheduler is nothing but its start stage plus the
+    transition graph its stages return."""
+    if mode == "sync":
+        from p2pfl_tpu.stages.base_node import StartLearningStage
+
+        return StartLearningStage
+    if mode == "async":
+        from p2pfl_tpu.stages.async_node import AsyncStartStage
+
+        return AsyncStartStage
+    raise ValueError(f"unknown scheduler mode {mode!r} (expected 'sync' or 'async')")
+
+
 class LearningWorkflow:
     def __init__(self, start_stage: Optional[Type[Stage]] = None) -> None:
         if start_stage is None:
-            from p2pfl_tpu.stages.base_node import StartLearningStage
-
-            start_stage = StartLearningStage
+            start_stage = scheduler_start_stage("sync")
         self.start_stage = start_stage
         self.history: List[str] = []
 

@@ -67,6 +67,12 @@ class HealthDigest:
     round: int = -1  # -1: no experiment in progress
     total_rounds: int = -1
     stage: str = ""
+    # Scheduler ("sync" | "async"; "" when idle or from an older peer). In
+    # async mode ``round`` counts WINDOWS and ``staleness`` is the mean
+    # window lag folded in the node's last aggregation — the fleet sees who
+    # is consuming fresh contributions and who is surviving on stale ones.
+    mode: str = ""
+    staleness: float = 0.0
     # Learner.
     steps_per_s: float = 0.0
     jit_compile_s: float = 0.0
@@ -114,6 +120,7 @@ def decode(payload: str) -> Optional["HealthDigest"]:
         dig.version = DIGEST_VERSION
     for name, kind in (
         ("ts", float), ("round", int), ("total_rounds", int), ("stage", str),
+        ("mode", str), ("staleness", float),
         ("steps_per_s", float), ("jit_compile_s", float),
         ("tx_bytes", float), ("rx_bytes", float), ("queue_depth", float),
         ("agg_waits", int), ("agg_wait_s", float), ("contributors", float),
@@ -204,6 +211,8 @@ def collect(addr: str, state: Any = None) -> HealthDigest:
             t = getattr(state, "total_rounds", None)
             dig.total_rounds = -1 if t is None else int(t)
             dig.stage = str(getattr(state, "current_stage", "") or "")
+            if getattr(state, "experiment", None) is not None:
+                dig.mode = str(getattr(state, "fed_mode", "") or "")
         dig.steps_per_s = _gauge_value("p2pfl_learner_steps_per_second", addr)
         dig.jit_compile_s = _gauge_value("p2pfl_learner_jit_compile_seconds", addr)
         dig.tx_bytes = float(_series_sum("p2pfl_gossip_tx_bytes_total", addr))
@@ -226,6 +235,7 @@ def collect(addr: str, state: Any = None) -> HealthDigest:
         # "?" is the unattributed bucket (direct API calls) — not a peer.
         by_source.pop("?", None)
         dig.rejected_by_source = by_source
+        dig.staleness = _gauge_value("p2pfl_async_staleness", addr)
         dig.faults_seen = float(_series_sum("p2pfl_chaos_faults_total", addr))
         dig.mem_bytes = device_mem_bytes()
     except Exception:  # noqa: BLE001

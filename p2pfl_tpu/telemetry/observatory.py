@@ -39,8 +39,13 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from collections import deque
+
 from p2pfl_tpu.telemetry.digest import HealthDigest
 from p2pfl_tpu.telemetry.metrics import REGISTRY
+
+#: Membership churn tail kept (and snapshotted) per observatory.
+MEMBERSHIP_EVENTS = 64
 
 _PEER_ROUND = REGISTRY.gauge(
     "p2pfl_fed_peer_round",
@@ -94,7 +99,7 @@ class Observatory:
     thread asks (bench pollers, ``fed_top`` writers, tests).
     """
 
-    def __init__(self, addr: str) -> None:
+    def __init__(self, addr: str, recorder: Optional[Any] = None) -> None:
         self._addr = addr
         self._lock = threading.Lock()
         #: peer -> (digest, local-monotonic arrival time)
@@ -102,7 +107,28 @@ class Observatory:
         #: peer -> (round, local-monotonic time the peer's digests FIRST
         #: reported that round) — the round-entry lateness base.
         self._entries: Dict[str, Tuple[int, float]] = {}
+        #: membership churn tail: the last MEMBERSHIP_EVENTS join/rejoin/
+        #: leave transitions this observatory witnessed (first digest from an
+        #: unknown peer = join; after a forget = rejoin; forget = leave) —
+        #: surfaced in the snapshot so ``fed_top`` shows churn live.
+        self._membership: deque = deque(maxlen=MEMBERSHIP_EVENTS)
+        self._ever_seen: set = set()
+        #: optional flight recorder — membership transitions are postmortem-
+        #: worthy events (Node/protocol wire the per-node recorder in).
+        self.recorder = recorder
         self._peers_known = _PEERS_KNOWN.labels(addr)
+
+    def _membership_event(self, event: str, peer: str) -> None:
+        # caller holds the lock
+        self._membership.append(
+            {"event": event, "peer": peer, "ts": round(time.time(), 3)}
+        )
+        rec = self.recorder
+        if rec is not None:
+            try:
+                rec.record("membership", event=event, peer=peer)
+            except Exception:  # noqa: BLE001 — observability must not raise
+                pass
 
     # --- ingest --------------------------------------------------------------
 
@@ -117,6 +143,11 @@ class Observatory:
             # by sender timestamp when both carry one.
             if prev is not None and dig.ts and prev[0].ts and dig.ts < prev[0].ts:
                 return False
+            if prev is None and dig.node != self._addr:
+                self._membership_event(
+                    "rejoin" if dig.node in self._ever_seen else "join", dig.node
+                )
+            self._ever_seen.add(dig.node)
             self._peers[dig.node] = (dig, now)
             entry = self._entries.get(dig.node)
             if entry is None or entry[0] != dig.round:
@@ -129,8 +160,10 @@ class Observatory:
     def forget(self, peer: str) -> None:
         """Drop a peer's entry (heartbeat sweep declared it dead)."""
         with self._lock:
-            self._peers.pop(peer, None)
+            known = self._peers.pop(peer, None) is not None
             self._entries.pop(peer, None)
+            if known:
+                self._membership_event("leave", peer)
         self._refresh()
 
     # --- derived health ------------------------------------------------------
@@ -239,6 +272,17 @@ class Observatory:
                     score += abs(child.value)
         return score
 
+    def suspect_score(self, peer: str) -> float:
+        """Fleet-attributed Byzantine suspicion for ``peer``: the sum of
+        admission rejections every live digest attributes to frames it sent.
+        Unlike :meth:`scores`, this answers for ANY address — an adversary
+        that poisons the model plane while never reporting digests of its
+        own must still be gateable (async participation control)."""
+        total = 0.0
+        for d, _ in self._live():
+            total += float(d.rejected_by_source.get(peer, 0.0))
+        return total
+
     def top(self, metric: str) -> Optional[str]:
         """Peer (never self) with the highest nonzero ``metric`` score —
         ``"straggler"`` | ``"suspect"`` | ``"link"``. None when no peer
@@ -277,6 +321,8 @@ class Observatory:
                 "round": d.round,
                 "total_rounds": d.total_rounds,
                 "stage": d.stage,
+                "mode": d.mode,
+                "staleness": d.staleness,
                 "steps_per_s": d.steps_per_s,
                 "jit_compile_s": d.jit_compile_s,
                 "tx_bytes": d.tx_bytes,
@@ -292,10 +338,13 @@ class Observatory:
                 "scores": scores.get(d.node, {}),
             }
             peers[d.node] = entry
+        with self._lock:
+            membership = list(self._membership)
         return {
             "observer": self._addr,
             "written_at": time.time(),
             "peers": peers,
+            "membership_events": membership,
             "top_straggler": self.top("straggler"),
             "top_suspect": self.top("suspect"),
         }
@@ -314,6 +363,8 @@ class Observatory:
         with self._lock:
             self._peers.clear()
             self._entries.clear()
+            self._membership.clear()
+            self._ever_seen.clear()
         self._peers_known.set(0)
 
 

@@ -1,0 +1,66 @@
+"""JAX version compatibility shims.
+
+The kernels and tests target the current ``jax.shard_map`` API (``check_vma``
+varying-mesh-axis checking, ``ShapeDtypeStruct(vma=...)``); older jax releases
+(< 0.5) only ship ``jax.experimental.shard_map.shard_map`` with the
+``check_rep`` spelling and reject the ``vma`` kwarg. These helpers pick the
+available spelling at import so every caller — ring attention, sequence/
+pipeline parallelism, the attention tests — runs unchanged on both.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable
+
+import jax
+
+HAS_NATIVE_SHARD_MAP = hasattr(jax, "shard_map")
+
+_SDS_HAS_VMA = "vma" in inspect.signature(jax.ShapeDtypeStruct.__init__).parameters
+
+
+def shard_map(
+    f: Callable,
+    mesh: Any,
+    in_specs: Any,
+    out_specs: Any,
+    check_vma: bool = True,
+) -> Callable:
+    """``jax.shard_map`` with the new-API signature on every jax version.
+
+    On older releases this maps ``check_vma`` onto the experimental API's
+    ``check_rep`` — same semantics (disable per-output replication/varying
+    checking, required for interpreted Pallas paths that can't trace
+    varying-axis values through a kernel call).
+    """
+    if HAS_NATIVE_SHARD_MAP:
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma
+    )
+
+
+def pvary(x: Any, axis_name: str) -> Any:
+    """Mark ``x`` as varying over ``axis_name`` (new-API ``jax.lax.pcast`` /
+    mid-API ``jax.lax.pvary``). On versions without varying-mesh-axis types
+    this is the identity — the old ``check_rep`` tracker needs no cast."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, (axis_name,))
+    return x
+
+
+def shape_dtype_struct(shape: Any, dtype: Any, vma: Any = None) -> jax.ShapeDtypeStruct:
+    """``jax.ShapeDtypeStruct`` accepting ``vma`` only where jax does."""
+    if _SDS_HAS_VMA:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+__all__ = ["HAS_NATIVE_SHARD_MAP", "pvary", "shard_map", "shape_dtype_struct"]

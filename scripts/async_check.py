@@ -1,0 +1,166 @@
+"""CI gate: 3-node elastic async federation with one 3x-slow peer — async
+windows must complete ahead of the sync barrier under the same shape, and a
+node that joins MID-RUN (cold, via the full-model catch-up bootstrap) must be
+contributing within 2 windows. Fast, CPU-only, tier-1-safe — invoked by
+``make async-check``.
+
+Exit 0 when every check passes; nonzero with a reason on stderr otherwise.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import time  # noqa: E402
+
+WINDOWS = 2
+FIT_FLOOR_S = 1.5  # fast peers; the straggler fits at 3x this
+SLOW_X = 3.0
+#: Per-leg wall budget. The sync leg with the straggler takes about
+#: WINDOWS x (3x fit + vote/gossip overhead); a regression that re-introduces
+#: a barrier into async blows the comparison below, not this cap.
+LEG_BUDGET_S = 90.0
+
+
+def _stretch(node, floor_s):
+    orig = node.learner.fit
+
+    def fit(*a, **kw):
+        t0 = time.monotonic()
+        r = orig(*a, **kw)
+        extra = floor_s - (time.monotonic() - t0)
+        if extra > 0:
+            time.sleep(extra)
+        return r
+
+    node.learner.fit = fit
+
+
+def main() -> int:
+    from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+    from p2pfl_tpu.config import Settings
+    from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.node import Node
+    from p2pfl_tpu.telemetry import REGISTRY
+    from p2pfl_tpu.utils.utils import set_test_settings, wait_convergence
+
+    set_test_settings()
+    Settings.RESOURCE_MONITOR_PERIOD = 0
+    Settings.LOG_LEVEL = "WARNING"
+    Settings.TRAIN_SET_SIZE = 3  # full committee: the straggler always gates sync
+    Settings.ASYNC_WINDOW_TIMEOUT = 15.0
+    Settings.EXECUTOR_MAX_WORKERS = 0  # inline fits: sleep floors must overlap
+
+    n = 3
+    data = synthetic_mnist(n_train=128 * (n + 1), n_test=64)
+    parts = data.generate_partitions(n + 1, RandomIIDPartitionStrategy)
+
+    def run_leg(mode):
+        REGISTRY.reset()
+        nodes = [Node(mlp_model(seed=i), parts[i], batch_size=32) for i in range(n)]
+        for i, nd in enumerate(nodes):
+            _stretch(nd, FIT_FLOOR_S * (SLOW_X if i == n - 1 else 1.0))
+            nd.start()
+        joiner = None
+        stage = "AsyncWindowFinishedStage" if mode == "async" else "RoundFinishedStage"
+        try:
+            for i in range(1, n):
+                nodes[i].connect(nodes[0].addr)
+            wait_convergence(nodes, n - 1, wait=15)
+            fast = nodes[:-1] if mode == "async" else nodes
+            t0 = time.monotonic()
+            nodes[0].set_start_learning(rounds=WINDOWS, epochs=1, mode=mode)
+
+            join_window = None
+            deadline = time.monotonic() + LEG_BUDGET_S
+            while time.monotonic() < deadline:
+                if (
+                    mode == "async"
+                    and joiner is None
+                    and (nodes[0].state.round or 0) >= 1
+                ):
+                    joiner = Node(mlp_model(seed=9), parts[n], batch_size=32)
+                    _stretch(joiner, FIT_FLOOR_S)
+                    joiner.start()
+                    joiner.connect(nodes[0].addr)
+                    time.sleep(0.3)
+                    joiner.request_async_join()
+                    join_window = nodes[0].state.round or 0
+                    print(f"joiner entered at window {join_window}", file=sys.stderr)
+                if all(
+                    not nd.learning_in_progress()
+                    and nd.learning_workflow is not None
+                    and nd.learning_workflow.history.count(stage) >= WINDOWS
+                    for nd in fast
+                ):
+                    break
+                time.sleep(0.1)
+            else:
+                print(f"FAIL: {mode} leg did not finish in {LEG_BUDGET_S}s", file=sys.stderr)
+                return None
+            wall = time.monotonic() - t0
+            first_fold = (
+                nodes[0].async_agg.seen_contributors.get(joiner.addr)
+                if mode == "async" and joiner is not None and nodes[0].async_agg
+                else None
+            )
+            if mode == "async":
+                nodes[0].set_stop_learning()  # release the straggler's tail windows
+            return {
+                "wall": wall,
+                "join_window": join_window,
+                "first_fold": first_fold,
+                "joiner": joiner.addr if joiner else None,
+            }
+        finally:
+            for nd in nodes:
+                nd.stop()
+            if joiner is not None:
+                joiner.stop()
+            InMemoryRegistry.reset()
+
+    sync = run_leg("sync")
+    if sync is None:
+        return 1
+    print(f"sync leg: {WINDOWS} rounds in {sync['wall']:.1f}s", file=sys.stderr)
+
+    asy = run_leg("async")
+    if asy is None:
+        return 1
+    print(f"async leg: {WINDOWS} windows in {asy['wall']:.1f}s", file=sys.stderr)
+
+    if asy["wall"] >= sync["wall"]:
+        print(
+            f"FAIL: async windows ({asy['wall']:.1f}s) did not complete ahead "
+            f"of sync rounds ({sync['wall']:.1f}s) with a {SLOW_X:g}x straggler",
+            file=sys.stderr,
+        )
+        return 1
+    if asy["first_fold"] is None:
+        print("FAIL: mid-run joiner never contributed", file=sys.stderr)
+        return 1
+    lag = asy["first_fold"] - (asy["join_window"] or 0)
+    if lag > 2:
+        print(
+            f"FAIL: joiner first contributed {lag} windows after joining "
+            f"(joined w{asy['join_window']}, folded w{asy['first_fold']})",
+            file=sys.stderr,
+        )
+        return 1
+
+    print(
+        f"async-check OK: async {WINDOWS} windows in {asy['wall']:.1f}s vs sync "
+        f"{sync['wall']:.1f}s with a {SLOW_X:g}x straggler; mid-run joiner "
+        f"{asy['joiner']} contributed within {max(0, lag)} window(s)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

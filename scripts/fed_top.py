@@ -10,10 +10,13 @@ federation_snapshot.json``) as a continuously-refreshing table:
     python scripts/fed_top.py artifacts/federation_snapshot.json --interval 1
     python scripts/fed_top.py --once                  # one frame, no ANSI
 
-Columns: peer, reported round/total, stage, steps/s, TX/RX MiB, straggler /
-suspect / link scores (sorted worst-straggler first), digest age. The top
-straggler and top suspect are called out under the table. Stdlib-only — no
-curses, no dependencies — so it runs anywhere the repo does.
+Columns: peer, reported round/total (``w``-prefixed for async windows),
+stage, steps/s, TX/RX MiB, async staleness (mean folded window lag),
+straggler / suspect / link scores (sorted worst-straggler first), digest
+age. The top straggler and top suspect are called out under the table,
+followed by the live membership-churn tail (join/rejoin/leave events from
+the observatory). Stdlib-only — no curses, no dependencies — so it runs
+anywhere the repo does.
 """
 
 from __future__ import annotations
@@ -52,7 +55,7 @@ def render(snap: Dict[str, Any], color: bool = True) -> str:
     top_suspect = snap.get("top_suspect")
     header = (
         f"{'PEER':<23} {'ROUND':>7} {'STAGE':<22} {'STEP/S':>8} "
-        f"{'TX MiB':>8} {'RX MiB':>8} {'STRAG':>7} {'SUSP':>7} "
+        f"{'TX MiB':>8} {'RX MiB':>8} {'STALE':>6} {'STRAG':>7} {'SUSP':>7} "
         f"{'LINK':>6} {'AGE s':>6}"
     )
     lines = [
@@ -72,10 +75,15 @@ def render(snap: Dict[str, Any], color: bool = True) -> str:
         rnd = p.get("round", -1)
         total = p.get("total_rounds", -1)
         round_s = f"{rnd}/{total}" if rnd >= 0 and total >= 0 else ("-" if rnd < 0 else str(rnd))
+        if p.get("mode") == "async":  # windows, not barrier rounds
+            round_s = f"w{round_s}"
+        stale = p.get("staleness", 0.0)
         row = (
             f"{_short(addr):<23} {round_s:>7} {p.get('stage') or '-':<22.22} "
             f"{p.get('steps_per_s', 0.0):>8.1f} {_mib(p.get('tx_bytes', 0.0)):>8} "
-            f"{_mib(p.get('rx_bytes', 0.0)):>8} {s.get('straggler', 0.0):>7.2f} "
+            f"{_mib(p.get('rx_bytes', 0.0)):>8} "
+            f"{(f'{stale:.1f}' if stale else '-'):>6} "
+            f"{s.get('straggler', 0.0):>7.2f} "
             f"{s.get('suspect', 0.0):>7.1f} {s.get('link', 0.0):>6.1f} "
             f"{s.get('age_s', 0.0):>6.1f}"
         )
@@ -88,6 +96,19 @@ def render(snap: Dict[str, Any], color: bool = True) -> str:
     lines.append(
         f"top straggler: {top_straggler or '-'}    top suspect: {top_suspect or '-'}"
     )
+    churn = snap.get("membership_events") or []
+    if churn:
+        tail = churn[-5:]
+        lines.append(paint(_BOLD, f"membership churn ({len(churn)} events):"))
+        for ev in reversed(tail):
+            age = max(0.0, time.time() - float(ev.get("ts", 0.0)))
+            lines.append(
+                paint(
+                    _DIM,
+                    f"  {ev.get('event', '?'):<7} {_short(str(ev.get('peer', '?')))} "
+                    f"({age:.0f}s ago)",
+                )
+            )
     written = snap.get("written_at")
     if written:
         lines.append(

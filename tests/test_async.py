@@ -1,0 +1,473 @@
+"""Elastic async federation: staleness-weighted buffered aggregation, the
+window scheduler, elastic membership (join/rejoin under the sparse wire),
+churn traces, and the observatory-driven participation gate.
+
+Strategy mirrors the chaos/Byzantine test planes: pure-math units first
+(staleness weights, bit-exact FedAvg equivalence, anchor history), then the
+command handlers against crafted frames, then small in-memory federations
+through the real Node/gossip/aggregator stack.
+"""
+
+import threading
+import time
+from typing import Any
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.config import Settings
+
+
+# --- staleness-weight math ----------------------------------------------------
+
+
+def test_staleness_weight_identity_and_monotonicity():
+    from p2pfl_tpu.learning.aggregators import staleness_weight
+
+    # lag 0 weighs exactly 1.0 for EVERY alpha — the bit-exact-FedAvg hinge.
+    for alpha in (0.0, 0.25, 0.5, 1.0, 4.0):
+        assert staleness_weight(0, alpha) == 1.0
+    # monotone non-increasing in lag; strictly decreasing when alpha > 0
+    for alpha in (0.25, 0.5, 1.0):
+        ws = [staleness_weight(lag, alpha) for lag in range(8)]
+        assert all(a > b for a, b in zip(ws, ws[1:]))
+    # alpha = 0 disables the discount entirely
+    assert [staleness_weight(lag, 0.0) for lag in range(5)] == [1.0] * 5
+    # negative lag (a faster peer's contribution) is clamped to fresh
+    assert staleness_weight(-3, 1.0) == 1.0
+
+
+def _handles(n=3, dim=5, samples=(10, 20, 30)):
+    from p2pfl_tpu.models.model_handle import ModelHandle
+
+    rng = np.random.default_rng(0)
+    out = []
+    for i in range(n):
+        params = [rng.normal(size=(dim,)).astype(np.float32),
+                  rng.normal(size=(dim, 2)).astype(np.float32)]
+        out.append(
+            ModelHandle(params, contributors=[f"n{i}"], num_samples=samples[i])
+        )
+    return out
+
+
+def test_zero_staleness_window_is_bit_exact_fedavg():
+    from p2pfl_tpu.learning.aggregators import FedAvg
+    from p2pfl_tpu.learning.aggregators.async_buffer import AsyncBufferedAggregator
+
+    models = _handles()
+    ref = FedAvg().aggregate(list(models))
+    out = AsyncBufferedAggregator.aggregate_weighted(list(models), [0, 0, 0])
+    for a, b in zip(out.get_parameters(), ref.get_parameters()):
+        # bit-exact: same kernel, same weights — not just allclose
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert out.contributors == ref.contributors
+    assert out.get_num_samples() == ref.get_num_samples()
+
+
+def test_stale_contribution_weighs_less():
+    from p2pfl_tpu.learning.aggregators.async_buffer import AsyncBufferedAggregator
+
+    models = _handles(n=2, samples=(10, 10))
+    fresh_only = models[0].get_parameters()
+    even = AsyncBufferedAggregator.aggregate_weighted(list(models), [0, 0], alpha=1.0)
+    discounted = AsyncBufferedAggregator.aggregate_weighted(
+        list(models), [0, 9], alpha=1.0
+    )
+    # Discounting the second model must pull the aggregate TOWARD the fresh one.
+    def dist(agg):
+        return sum(
+            float(np.linalg.norm(np.asarray(a) - np.asarray(f)))
+            for a, f in zip(agg.get_parameters(), fresh_only)
+        )
+
+    assert dist(discounted) < dist(even)
+
+
+# --- buffered window mechanics ------------------------------------------------
+
+
+def test_window_completes_on_own_contribution_when_all_trainers_dead():
+    from p2pfl_tpu.learning.aggregators.async_buffer import AsyncBufferedAggregator
+
+    agg = AsyncBufferedAggregator("me")
+    agg.open_window(0)
+    own = _handles(n=1, samples=(10,))[0]
+    agg.fold(own, 0, "me")
+    t0 = time.monotonic()
+    # Target re-evaluates to 1 (everyone else is dead) -> immediate close.
+    out = agg.wait_window(lambda: 1, timeout=30.0)
+    assert time.monotonic() - t0 < 1.0
+    assert out is not None and out.get_num_samples() == 10
+
+
+def test_window_target_shrinks_live_via_notify():
+    from p2pfl_tpu.learning.aggregators.async_buffer import AsyncBufferedAggregator
+
+    agg = AsyncBufferedAggregator("me")
+    agg.open_window(0)
+    agg.fold(_handles(n=1)[0], 0, "me")
+    target = {"n": 2}
+    done = threading.Event()
+    result = {}
+
+    def waiter():
+        result["model"] = agg.wait_window(lambda: target["n"], timeout=20.0)
+        done.set()
+
+    threading.Thread(target=waiter, daemon=True).start()
+    time.sleep(0.6)
+    assert not done.is_set()  # still waiting on the (dead) second contributor
+    target["n"] = 1  # the death callback's effect...
+    agg.notify()  # ...and its wake
+    assert done.wait(timeout=2.0)
+    assert result["model"] is not None
+
+
+def test_stale_limit_drops_contribution():
+    from p2pfl_tpu.learning.aggregators.async_buffer import AsyncBufferedAggregator
+
+    with Settings.overridden(ASYNC_MAX_STALENESS=2):
+        agg = AsyncBufferedAggregator("me")
+        agg.open_window(10)
+        ok = agg.fold(_handles(n=1)[0], 7, "laggard")  # lag 3 > 2
+        assert not ok
+        assert agg.fill() == 0
+        assert agg.fold(_handles(n=1)[0], 8, "laggard")  # lag 2 == limit
+
+
+def test_window_early_stop_returns_none():
+    from p2pfl_tpu.learning.aggregators.async_buffer import AsyncBufferedAggregator
+
+    agg = AsyncBufferedAggregator("me")
+    agg.open_window(0)
+    assert agg.wait_window(lambda: 5, timeout=10.0, early_stop_fn=lambda: True) is None
+
+
+# --- sparse-delta anchor history ---------------------------------------------
+
+
+def test_anchor_history_decodes_lagging_sparse_frames():
+    from p2pfl_tpu.comm.delta import DeltaWireCodec
+    from p2pfl_tpu.exceptions import DeltaAnchorError
+    from p2pfl_tpu.models import mlp_model
+
+    with Settings.overridden(WIRE_COMPRESSION="topk"):
+        model = mlp_model(seed=0, hidden_sizes=(8,))
+        model.contributors = ["s"]
+        params = model.get_parameters()
+
+        # The lagging SENDER is anchored at window 1.
+        sender = DeltaWireCodec("sender")
+        sender.set_anchor(params, 1)
+        perturbed = model.build_copy(
+            params=[np.asarray(p) + 0.01 for p in params],
+            contributors=["s"], num_samples=1,
+        )
+        frame_w1 = sender.encode_model(perturbed, 1)
+        assert frame_w1 is not None
+
+        # The receiver advanced through windows 1..3 with history depth 3.
+        recv = DeltaWireCodec("recv")
+        recv.anchor_history = 3
+        for w in (1, 2, 3):
+            recv.set_anchor(params, w)
+        arrays, meta = recv.decode_frame(frame_w1)  # decodes via the history
+        assert len(arrays) == len(params)
+
+        # Depth-1 (sync) behavior rejects the same lagging frame.
+        sync_recv = DeltaWireCodec("sync-recv")
+        for w in (1, 2, 3):
+            sync_recv.set_anchor(params, w)
+        with pytest.raises(DeltaAnchorError):
+            sync_recv.decode_frame(frame_w1)
+
+        # Eviction: a frame anchored before the kept history rejects too.
+        deep = DeltaWireCodec("deep")
+        deep.anchor_history = 2
+        for w in (1, 2, 3, 4):
+            deep.set_anchor(params, w)
+        with pytest.raises(DeltaAnchorError):
+            deep.decode_frame(frame_w1)
+
+        # resync (the rejoin path) drops the history with the residuals.
+        recv.resync(params, 9)
+        with pytest.raises(DeltaAnchorError):
+            recv.decode_frame(frame_w1)
+
+
+# --- churn trace --------------------------------------------------------------
+
+
+def test_plan_churn_deterministic_and_counted():
+    from p2pfl_tpu.chaos import CHAOS, ChaosPlane
+
+    leavers = [f"n{i}" for i in range(6)]
+    joiners = [f"j{i}" for i in range(3)]
+    a = ChaosPlane().plan_churn(5, leavers, joiners, seed=7)
+    b = ChaosPlane().plan_churn(5, leavers, joiners, seed=7)
+    assert a == b
+    c = ChaosPlane().plan_churn(5, leavers, joiners, seed=8)
+    assert a != c
+    # one leave + one join per round from round 1 (joiners run out at 3)
+    assert sum(1 for e in a if e.kind == "leave") == 4
+    assert sum(1 for e in a if e.kind == "join") == 3
+    assert all(e.when >= 1 for e in a)
+    # executed events land in the shared fault table under "churn"
+    CHAOS.reset()
+    try:
+        CHAOS.churn("n0", "leave")
+        CHAOS.churn("j0", "join")
+        assert CHAOS.fault_counts().get("churn") == 2
+    finally:
+        CHAOS.reset()
+
+
+# --- command handlers ---------------------------------------------------------
+
+
+def _node_pair():
+    from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.node import Node
+
+    data = synthetic_mnist(n_train=128, n_test=32)
+    parts = data.generate_partitions(1, RandomIIDPartitionStrategy)
+    return Node(mlp_model(seed=0), parts[0], batch_size=32)
+
+
+def test_async_contribution_ignored_outside_async_session():
+    from p2pfl_tpu.comm.commands.impl import AsyncContributionCommand
+
+    with Settings.overridden(EXECUTOR_MAX_WORKERS=0):
+        node = _node_pair()
+        payload = node.learner.get_model().encode_parameters()
+        # No experiment at all, then a SYNC experiment: both must no-op.
+        AsyncContributionCommand(node).execute("peer", 0, weights=payload)
+        node.state.set_experiment("sync-exp", 3)
+        node.state.fed_mode = "sync"
+        AsyncContributionCommand(node).execute("peer", 0, weights=payload)
+        assert node.async_agg is None
+
+
+def test_async_contribution_folds_and_screens():
+    from p2pfl_tpu.comm.commands.impl import AsyncContributionCommand
+    from p2pfl_tpu.learning.aggregators.async_buffer import AsyncBufferedAggregator
+
+    with Settings.overridden(EXECUTOR_MAX_WORKERS=0):
+        node = _node_pair()
+        node.state.set_experiment("async-exp", 3)
+        node.state.fed_mode = "async"
+        node.async_agg = AsyncBufferedAggregator(node.addr)
+        node.async_agg.open_window(1)
+        payload = node.learner.get_model().encode_parameters()
+        AsyncContributionCommand(node).execute(
+            "peer", 1, weights=payload, contributors=["peer"], num_samples=17
+        )
+        assert node.async_agg.fill() == 1
+        assert node.async_agg.seen_contributors.get("peer") == 1
+        # A corrupt frame is a counted rejection, not an exception storm.
+        AsyncContributionCommand(node).execute("peer2", 1, weights=b"garbage")
+        assert node.async_agg.fill() == 1
+
+
+def test_suspect_gate_blocks_contribution():
+    from p2pfl_tpu.comm.commands.impl import AsyncContributionCommand
+    from p2pfl_tpu.learning.aggregators.async_buffer import AsyncBufferedAggregator
+    from p2pfl_tpu.telemetry import REGISTRY
+    from p2pfl_tpu.telemetry.digest import HealthDigest
+
+    with Settings.overridden(EXECUTOR_MAX_WORKERS=0, ASYNC_SUSPECT_GATE=1.0):
+        node = _node_pair()
+        node.state.set_experiment("async-exp", 3)
+        node.state.fed_mode = "async"
+        node.async_agg = AsyncBufferedAggregator(node.addr)
+        node.async_agg.open_window(0)
+        # The fleet attributes admission rejections to "evil" via digests —
+        # note "evil" itself reports NO digest; the gate must still fire.
+        node.observatory.ingest(
+            HealthDigest(
+                node="reporter", ts=time.time(),
+                rejected_by_source={"evil": 5.0},
+            )
+        )
+        assert node.observatory.suspect_score("evil") == 5.0
+        payload = node.learner.get_model().encode_parameters()
+        AsyncContributionCommand(node).execute(
+            "evil", 0, weights=payload, contributors=["evil"], num_samples=1
+        )
+        assert node.async_agg.fill() == 0  # gated before decode
+        fam = REGISTRY.get("p2pfl_async_dropped_total")
+        dropped = {
+            labels["reason"]: child.value
+            for labels, child in fam.samples()
+            if labels.get("node") == node.addr
+        }
+        assert dropped.get("suspect", 0) >= 1
+
+
+def test_async_done_removes_peer_from_fill_target():
+    from p2pfl_tpu.comm.commands.impl import AsyncDoneCommand
+    from p2pfl_tpu.learning.aggregators.async_buffer import AsyncBufferedAggregator
+    from p2pfl_tpu.stages.async_node import select_participants
+
+    with Settings.overridden(EXECUTOR_MAX_WORKERS=0):
+        node = _node_pair()
+        node.state.set_experiment("async-exp", 3)
+        node.state.fed_mode = "async"
+        node.async_agg = AsyncBufferedAggregator(node.addr)
+
+        node.protocol.get_neighbors = lambda only_direct=False: ["p1", "p2"]
+        solicit, countable = select_participants(node)
+        assert solicit == ["p1", "p2"] and countable == ["p1", "p2"]
+        AsyncDoneCommand(node).execute("p1", 3)
+        solicit, countable = select_participants(node)
+        # A finished peer produces nothing further: never shipped to,
+        # never waited on.
+        assert solicit == ["p2"] and countable == ["p2"]
+        # ...and a fresh experiment forgets the done set.
+        node.state.set_experiment("async-exp-2", 3)
+        assert node.state.async_done_peers == set()
+
+
+def test_start_learning_command_mode_backcompat():
+    from p2pfl_tpu.comm.commands.impl import StartLearningCommand
+
+    calls = []
+
+    class FakeNode:
+        def start_learning_thread(self, rounds, epochs, mode="sync"):
+            calls.append((rounds, epochs, mode))
+
+    cmd = StartLearningCommand(FakeNode())
+    cmd.execute("src", 0, "3", "2")  # old two-arg frame
+    cmd.execute("src", 0, "3", "2", "async")
+    assert calls == [(3, 2, "sync"), (3, 2, "async")]
+
+
+def test_scheduler_registry():
+    from p2pfl_tpu.stages.async_node import AsyncStartStage
+    from p2pfl_tpu.stages.base_node import StartLearningStage
+    from p2pfl_tpu.stages.workflow import scheduler_start_stage
+
+    assert scheduler_start_stage("sync") is StartLearningStage
+    assert scheduler_start_stage("async") is AsyncStartStage
+    with pytest.raises(ValueError):
+        scheduler_start_stage("semi-sync")
+
+
+# --- observability ------------------------------------------------------------
+
+
+def test_digest_carries_mode_and_staleness():
+    from p2pfl_tpu.telemetry.digest import HealthDigest, decode
+
+    dig = HealthDigest(node="n1", mode="async", staleness=1.5, round=4)
+    back = decode(dig.encode())
+    assert back.mode == "async" and back.staleness == 1.5 and back.round == 4
+    # absent fields (older peer) degrade to defaults, not failures
+    old = decode('{"node": "n2", "round": 1, "v": 1}')
+    assert old is not None and old.mode == "" and old.staleness == 0.0
+
+
+def test_observatory_membership_events():
+    from p2pfl_tpu.telemetry.digest import HealthDigest
+    from p2pfl_tpu.telemetry.observatory import Observatory
+
+    class Rec:
+        def __init__(self):
+            self.events = []
+
+        def record(self, kind, **detail):
+            self.events.append((kind, detail))
+
+    rec = Rec()
+    obs = Observatory("me", recorder=rec)
+    obs.ingest(HealthDigest(node="p1", ts=time.time()))
+    obs.forget("p1")
+    obs.ingest(HealthDigest(node="p1", ts=time.time() + 1))
+    snap = obs.snapshot()
+    kinds = [e["event"] for e in snap["membership_events"] if e["peer"] == "p1"]
+    assert kinds == ["join", "leave", "rejoin"]
+    recorded = [d["event"] for k, d in rec.events if k == "membership"]
+    assert recorded == ["join", "leave", "rejoin"]
+
+
+# --- e2e: mid-run join under the sparse wire ---------------------------------
+
+
+def _wait(cond, timeout=60.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def test_async_join_bootstraps_and_decodes_sparse_wire():
+    """A cold node joins a running async federation mid-experiment over the
+    topk sparse wire: the dense catch-up + anchor resync must leave it able
+    to decode peers' sparse frames, and its contributions must be folded by
+    the established nodes within 2 windows of the join."""
+    from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+    from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.node import Node
+
+    n, windows = 2, 4
+    with Settings.overridden(
+        WIRE_COMPRESSION="topk", ASYNC_WINDOW_TIMEOUT=8.0, LOG_LEVEL="WARNING"
+    ):
+        data = synthetic_mnist(n_train=128 * (n + 1), n_test=32)
+        parts = data.generate_partitions(n + 1, RandomIIDPartitionStrategy)
+        nodes = [Node(mlp_model(seed=i), parts[i], batch_size=32) for i in range(n)]
+        for nd in nodes:
+            # pace the windows so the join lands mid-run
+            orig = nd.learner.fit
+
+            def slow_fit(orig=orig):
+                time.sleep(0.8)
+                return orig()
+
+            nd.learner.fit = slow_fit
+            nd.start()
+        joiner = None
+        try:
+            nodes[1].connect(nodes[0].addr)
+            assert _wait(lambda: len(nodes[0].get_neighbors()) == 1, 10)
+            nodes[0].set_start_learning(rounds=windows, epochs=1, mode="async")
+            assert _wait(lambda: (nodes[0].state.round or 0) >= 1, 30)
+
+            joiner = Node(mlp_model(seed=9), parts[n], batch_size=32)
+            joiner.start()
+            joiner.connect(nodes[0].addr)
+            time.sleep(0.3)
+            joiner.request_async_join()
+            join_window = nodes[0].state.round or 0
+
+            alln = nodes + [joiner]
+            assert _wait(
+                lambda: all(
+                    not nd.learning_in_progress()
+                    and nd.learning_workflow is not None
+                    for nd in alln
+                ),
+                90,
+            ), {nd.addr: (nd.learning_workflow.history if nd.learning_workflow else None) for nd in alln}
+            # the joiner ran real windows
+            jh = joiner.learning_workflow.history
+            assert jh.count("AsyncWindowFinishedStage") >= 1, jh
+            # sparse frames were actually on the wire...
+            assert nodes[0].state.wire.sparse_frames > 0
+            # ...and the established nodes folded the joiner soon after entry
+            for nd in nodes:
+                first = nd.async_agg.seen_contributors.get(joiner.addr)
+                assert first is not None, nd.async_agg.seen_contributors
+                assert first - join_window <= 2, (first, join_window)
+        finally:
+            for nd in nodes:
+                nd.stop()
+            if joiner is not None:
+                joiner.stop()
+            InMemoryRegistry.reset()

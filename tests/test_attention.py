@@ -8,6 +8,8 @@ ppermute path is exercised (sharding semantics identical to TPU ICI).
 
 import jax
 import jax.numpy as jnp
+
+from p2pfl_tpu.utils.compat import shard_map
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
@@ -94,7 +96,7 @@ def test_flash_grads_match_dense():
 
 def _ring_fn(mesh, causal, n_shards):
     spec = P(None, "seq", None, None)
-    return jax.shard_map(
+    return shard_map(
         lambda q, k, v: ring_attention(q, k, v, "seq", causal=causal, block_k=8),
         mesh=mesh,
         in_specs=(spec, spec, spec),
@@ -182,7 +184,7 @@ def test_ring_flash_impl_matches_dense(causal, n_shards):
     mesh = Mesh(np.array(jax.devices()[:n_shards]), ("seq",))
     q, k, v = _qkv(seed=5)
     spec = P(None, "seq", None, None)
-    ring = jax.shard_map(
+    ring = shard_map(
         lambda q, k, v: ring_attention(
             q, k, v, "seq", causal=causal, block_k=8, impl="flash"
         ),
@@ -200,7 +202,7 @@ def test_ring_flash_grads_match_dense():
     mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
     q, k, v = _qkv(seed=6)
     spec = P(None, "seq", None, None)
-    ring = jax.shard_map(
+    ring = shard_map(
         lambda q, k, v: ring_attention(q, k, v, "seq", causal=True, block_k=8, impl="flash"),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,

@@ -1,6 +1,6 @@
 # Convenience targets; everything also runs as the plain commands shown.
 
-.PHONY: test test-fast bench dryrun proto-check api-docs telemetry-check chaos-check byzantine-check observatory-check perf-check async-check
+.PHONY: test test-fast bench dryrun proto-check api-docs telemetry-check chaos-check byzantine-check observatory-check perf-check async-check fleetobs-check
 
 test:            ## full suite on the virtual 8-device CPU mesh (~30 min, 1 core)
 	python -m pytest tests/ -q
@@ -34,6 +34,9 @@ perf-check:      ## 3-node gate: critical path produced, slow node gates it, per
 
 async-check:     ## 3-node gate: async windows beat sync rounds with a 3x straggler; mid-run join contributes within 2 windows (CPU-only)
 	JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/async_check.py
+
+fleetobs-check:  ## 3-node gate: staleness sketches propagate on beats, window attribution flags a 3x-slow peer, v1-digest peer tolerated (CPU-only)
+	JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/fleetobs_check.py
 
 api-docs:        ## regenerate docs/api.md from the live package
 	PYTHONPATH=. python scripts/gen_api_docs.py

@@ -2692,6 +2692,393 @@ def run_observatory_bench() -> None:
     _emit(out, seed=locals().get("seed"), backend="cpu")
 
 
+def run_fleetobs_bench() -> None:
+    """Subprocess-style mode ``--fleetobs``: sketch-native fleet
+    observability acceptance run, two arms.
+
+    **Fused-mesh arm** (8 → 512 → 10k virtual nodes): a MeshSimulation per
+    fleet size with seeded 5x-slow device tiers (``node_speed``), 3 rounds
+    each. The 10k run's jit-computed fleet summary is folded into sketches
+    host-side and written as a fed_top-renderable observatory snapshot
+    (``artifacts/federation_snapshot.json``); every seeded straggler must
+    appear in the top-N straggler table. At each size the arm also measures
+    (a) the encoded bytes of a v2 health digest summarizing the whole
+    fleet's step-time/staleness distributions and (b) the estimated memory
+    of an observatory ingesting one digest per node — both must grow
+    SUBLINEARLY (digest bytes flat-to-logarithmic, per-node observatory
+    memory strictly shrinking as the population outgrows OBS_MAX_TRACKED).
+
+    **Async-attribution arm** (8 real nodes, ``mode="async"``): one seeded
+    5x-slow contributor, 5 windows. The window-level critical path must
+    attribute the slow contributor as gating in >= 4/5 windows, and the
+    digest-carried staleness sketch p90 of a fast observer must match its
+    buffer's exact measured staleness p90 within sketch error.
+
+    Shape overrides: P2PFL_TPU_FLEETOBS_SIZES (comma list, default
+    "8,512,10000"), P2PFL_TPU_FLEETOBS_WINDOWS (default 5),
+    P2PFL_TPU_FLEETOBS_SLOW_X (default 5.0).
+    """
+    out: dict = {}
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"  # protocol/scale bench: CPU venue
+        import numpy as np
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from p2pfl_tpu.config import Settings
+        from p2pfl_tpu.models import mlp_model
+        from p2pfl_tpu.parallel.simulation import MeshSimulation
+        from p2pfl_tpu.telemetry import REGISTRY, TRACER
+        from p2pfl_tpu.telemetry import digest as digest_mod
+        from p2pfl_tpu.telemetry.observatory import Observatory
+        from p2pfl_tpu.telemetry.sketches import (
+            DistinctEstimator,
+            QuantileSketch,
+            SKETCHES,
+        )
+
+        sizes = [
+            int(s)
+            for s in os.environ.get(
+                "P2PFL_TPU_FLEETOBS_SIZES", "8,512,10000"
+            ).split(",")
+        ]
+        windows = int(os.environ.get("P2PFL_TPU_FLEETOBS_WINDOWS", "5"))
+        slow_x = float(os.environ.get("P2PFL_TPU_FLEETOBS_SLOW_X", "5.0"))
+        seed = 42
+        top_n = 16
+        REGISTRY.reset()
+        TRACER.reset()
+        SKETCHES.reset()
+
+        # --- arm A: fused-mesh fleet observability at scale ------------------
+        rng = np.random.default_rng(seed)
+        scale_points = []
+        snap_path = os.path.join("artifacts", "federation_snapshot.json")
+        for n in sizes:
+            n_stragglers = max(1, min(12, n // 64))
+            straggler_idx = sorted(
+                rng.choice(n, size=n_stragglers, replace=False).tolist()
+            )
+            speed = np.ones(n, np.float32)
+            speed[straggler_idx] = slow_x
+            _phase(
+                f"fleetobs mesh arm: n={n}, {n_stragglers} seeded "
+                f"{slow_x:g}x stragglers"
+            )
+            samples, feat, classes = 16, 16, 4
+            x = rng.normal(size=(n, samples, feat)).astype(np.float32)
+            y = rng.integers(0, classes, size=(n, samples)).astype(np.int32)
+            mask = np.ones((n, samples), np.float32)
+            model = mlp_model(
+                input_shape=(feat,), hidden_sizes=(8,), out_channels=classes,
+                seed=seed,
+            )
+            sim = MeshSimulation(
+                model, (x, y, mask), test_data=(x[0], y[0]),
+                train_set_size=min(64, n), batch_size=8, seed=seed,
+                node_speed=speed,
+            )
+            res = sim.run(rounds=3, warmup=False)
+            snap = sim.fleet_snapshot(
+                res, top_n=top_n, path=snap_path if n == max(sizes) else None
+            )
+            top_names = list(snap["peers"])
+            seeded_names = [f"vnode/{i:05d}" for i in straggler_idx]
+            missing = [s for s in seeded_names if s not in top_names]
+            health = sim.fleet_health(res)
+            sim.close()
+
+            # Digest bytes: a v2 digest whose sketches summarize the WHOLE
+            # fleet's distributions (the observatory's merged view re-
+            # gossiped) — the wire cost that must stay flat-to-log in n.
+            sk_steps = QuantileSketch(
+                rel_err=Settings.SKETCH_REL_ERR, max_bins=Settings.SKETCH_MAX_BINS
+            )
+            sk_steps.add_many(health["step_time"])
+            sk_lag = QuantileSketch(
+                rel_err=Settings.SKETCH_REL_ERR, max_bins=Settings.SKETCH_MAX_BINS
+            )
+            sk_lag.add_many(health["round_lag"])
+            est = DistinctEstimator()
+            for i in range(n):
+                est.add(f"vnode/{i:05d}")
+            fleet_dig = digest_mod.HealthDigest(
+                node="fleet-summary", ts=time.time(), round=3,
+                sketches={
+                    "step_time": sk_steps.to_wire(
+                        max_bins=digest_mod.DIGEST_SKETCH_BINS
+                    ),
+                    "staleness": sk_lag.to_wire(
+                        max_bins=digest_mod.DIGEST_SKETCH_BINS
+                    ),
+                    "__distinct__": est.to_wire(),
+                },
+            )
+            digest_bytes = len(fleet_dig.encode())
+            if digest_bytes > digest_mod.MAX_DIGEST_BYTES:
+                raise AssertionError(
+                    f"fleet digest at n={n} is {digest_bytes}B > "
+                    f"MAX_DIGEST_BYTES — the sketch bound failed"
+                )
+
+            # Observatory memory: ingest one (small, sketch-bearing) digest
+            # per virtual node; beyond OBS_MAX_TRACKED the overflow folds
+            # into merged sketches, so memory must plateau.
+            prev_refresh = Settings.OBS_REFRESH_MIN_S
+            Settings.OBS_REFRESH_MIN_S = 1.0
+            try:
+                obs = Observatory(f"bench-obs-{n}")
+                peer_sketch = QuantileSketch(rel_err=Settings.SKETCH_REL_ERR)
+                peer_sketch.add(0.01)
+                peer_wire = peer_sketch.to_wire()
+                now_ts = time.time()
+                for i in range(n):
+                    obs.ingest(
+                        digest_mod.HealthDigest(
+                            node=f"vnode/{i:05d}", ts=now_ts, round=3,
+                            steps_per_s=float(1.0 / max(1e-6, health["step_time"][i])),
+                            sketches={"staleness": peer_wire},
+                        )
+                    )
+                obs_mem = obs.estimated_memory_bytes()
+                fleet_view = obs.fleet_quantiles()
+            finally:
+                Settings.OBS_REFRESH_MIN_S = prev_refresh
+            scale_points.append(
+                {
+                    "fleet_size": n,
+                    "rounds": res.rounds,
+                    "sec_per_round": round(res.seconds_per_round, 6),
+                    "seeded_stragglers": seeded_names,
+                    "top_n": top_names,
+                    "stragglers_missing_from_top": missing,
+                    "digest_bytes": digest_bytes,
+                    "obs_memory_bytes": obs_mem,
+                    "obs_memory_bytes_per_node": round(obs_mem / n, 2),
+                    "obs_fleet_staleness_count": fleet_view.get(
+                        "staleness", {}
+                    ).get("count", 0),
+                }
+            )
+            _phase(
+                f"  n={n}: digest {digest_bytes}B, obs mem {obs_mem}B "
+                f"({obs_mem / n:.0f}B/node), top-{top_n} misses: {missing}"
+            )
+
+        big = scale_points[-1]
+        small = scale_points[0]
+        if big["stragglers_missing_from_top"]:
+            raise AssertionError(
+                f"seeded stragglers missing from the {big['fleet_size']}-node "
+                f"top-{top_n}: {big['stragglers_missing_from_top']}"
+            )
+        size_ratio = big["fleet_size"] / small["fleet_size"]
+        if big["digest_bytes"] > small["digest_bytes"] * 4:
+            raise AssertionError(
+                f"digest bytes grew {big['digest_bytes'] / small['digest_bytes']:.1f}x "
+                f"over a {size_ratio:.0f}x fleet — not flat-to-logarithmic"
+            )
+        # The sublinear-memory claim: total observatory memory PLATEAUS at
+        # ~the tracking cap's worth of digests (overflow folds into fixed-
+        # size sketches), so past the cap it must stay within 1.5x of
+        # cap * per-digest cost no matter how large the fleet grows.
+        if big["fleet_size"] > Settings.OBS_MAX_TRACKED:
+            plateau = (
+                small["obs_memory_bytes_per_node"]
+                * Settings.OBS_MAX_TRACKED
+                * 1.5
+            )
+            if big["obs_memory_bytes"] > plateau:
+                raise AssertionError(
+                    f"observatory memory {big['obs_memory_bytes']}B at "
+                    f"n={big['fleet_size']} exceeds the tracking-cap plateau "
+                    f"({plateau:.0f}B) — overflow folding is not bounding it"
+                )
+
+        # --- arm B: async window attribution over the real wire ---------------
+        from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+        from p2pfl_tpu.learning.dataset import (
+            RandomIIDPartitionStrategy,
+            synthetic_mnist,
+        )
+        from p2pfl_tpu.node import Node
+        from p2pfl_tpu.telemetry.critical_path import CriticalPathAnalyzer
+        from p2pfl_tpu.utils.utils import set_test_settings, wait_convergence
+
+        n_nodes = 8
+        fit_floor = 0.6
+        set_test_settings()
+        Settings.RESOURCE_MONITOR_PERIOD = 0
+        Settings.LOG_LEVEL = "WARNING"
+        Settings.EXECUTOR_MAX_WORKERS = 0  # inline fits: sleep floors overlap
+        Settings.ASYNC_BUFFER_K = n_nodes // 2
+        Settings.ASYNC_WINDOW_TIMEOUT = 20.0
+        REGISTRY.reset()
+        TRACER.reset()
+        SKETCHES.reset()
+        _phase(
+            f"fleetobs async arm: {n_nodes} nodes, {windows} windows, one "
+            f"{slow_x:g}x-slow contributor"
+        )
+        data = synthetic_mnist(n_train=128 * n_nodes, n_test=64)
+        parts = data.generate_partitions(n_nodes, RandomIIDPartitionStrategy)
+        # Shared apply_fn + throwaway-learner prewarm (the --async bench
+        # pattern): serialized per-node XLA compiles inside window 0 would
+        # drown the seeded slowdown the attribution assertions measure.
+        from p2pfl_tpu.learning.learner import JaxLearner
+
+        template = mlp_model(seed=0)
+        warm = JaxLearner(
+            template.build_copy(), parts[0], self_addr="mem://warmup",
+            batch_size=32, seed=0,
+        )
+        warm.set_epochs(1)
+        warm.fit()
+        warm.evaluate()
+        del warm
+        SKETCHES.reset()  # the warmup learner's step times are not a node's
+        nodes = [
+            Node(
+                template.build_copy(params=mlp_model(seed=i).get_parameters()),
+                parts[i], batch_size=32,
+            )
+            for i in range(n_nodes)
+        ]
+        slow = nodes[-1]
+
+        def stretch(node, floor_s):
+            orig = node.learner.fit
+
+            def fit(*a, **kw):
+                t0 = time.monotonic()
+                r = orig(*a, **kw)
+                extra = floor_s - (time.monotonic() - t0)
+                if extra > 0:
+                    time.sleep(extra)
+                return r
+
+            node.learner.fit = fit
+
+        for i, nd in enumerate(nodes):
+            stretch(nd, fit_floor * (slow_x if nd is slow else 1.0))
+        try:
+            for nd in nodes:
+                nd.start()
+            for i in range(1, n_nodes):
+                nodes[i].connect(nodes[0].addr)
+            wait_convergence(nodes, n_nodes - 1, wait=30)
+            t0 = time.monotonic()
+            nodes[0].set_start_learning(rounds=windows, epochs=1, mode="async")
+            deadline = time.monotonic() + 600
+            while time.monotonic() < deadline:
+                if all(
+                    not nd.learning_in_progress()
+                    and nd.learning_workflow is not None
+                    and nd.learning_workflow.history.count(
+                        "AsyncWindowFinishedStage"
+                    )
+                    >= windows
+                    for nd in nodes
+                ):
+                    break
+                time.sleep(0.25)
+            else:
+                raise TimeoutError("async arm did not finish")
+            async_wall = time.monotonic() - t0
+
+            # Window-level attribution from the shared in-process tracer.
+            analyzer = CriticalPathAnalyzer.from_tracer(TRACER)
+            wreport = analyzer.window_report()
+            gated = sum(
+                1
+                for w in range(windows)
+                if wreport["windows"].get(str(w), {}).get("gating_contributor")
+                == slow.addr
+            )
+
+            # Digest-carried staleness p90 vs the buffer's exact measure, on
+            # a fast observer that folded the slow peer's stale frames.
+            observer = nodes[0]
+            exact_lags = sorted(observer.async_agg.lag_log)
+            dig = digest_mod.collect(observer.addr)
+            sk = dig.sketch("staleness")
+            if sk is None or not exact_lags:
+                raise AssertionError(
+                    "staleness sketch missing from the digest "
+                    f"(sketch={sk}, lags={len(exact_lags)})"
+                )
+            # Same nearest-rank (floor) convention as the sketch's walk.
+            exact_p90 = float(exact_lags[int(0.9 * (len(exact_lags) - 1))])
+            sketch_p90 = sk.quantile(0.9)
+            tol = max(0.5, 2.0 * sk.rel_err * max(1.0, exact_p90))
+            digest_bytes_total = sum(
+                c.value
+                for lbl, c in REGISTRY.get("p2pfl_digest_bytes_total").samples()
+            )
+        finally:
+            for nd in nodes:
+                try:
+                    nd.stop()
+                except Exception:
+                    pass
+            InMemoryRegistry.reset()
+
+        if gated < windows - 1:
+            raise AssertionError(
+                f"slow contributor gated only {gated}/{windows} windows "
+                f"(report: {wreport['gating_counts']})"
+            )
+        if abs(sketch_p90 - exact_p90) > tol:
+            raise AssertionError(
+                f"digest staleness p90 {sketch_p90:.3f} vs exact "
+                f"{exact_p90:.3f} exceeds sketch tolerance {tol:.3f}"
+            )
+
+        out = {
+            "metric": "fleetobs_sublinear_observability",
+            "value": big["digest_bytes"] / small["digest_bytes"],
+            "unit": "digest_bytes_growth_8_to_10k",
+            "vs_baseline": None,
+            "extra": {
+                "scale_points": scale_points,
+                "federation_snapshot": snap_path,
+                "top_n": top_n,
+                "async": {
+                    "nodes": n_nodes,
+                    "windows": windows,
+                    "slow_x": slow_x,
+                    "slow_contributor": slow.addr,
+                    "wall_s": round(async_wall, 2),
+                    "gated_windows": gated,
+                    "close_reason_counts": wreport["close_reason_counts"],
+                    "mean_staleness_discount": wreport["mean_staleness_discount"],
+                    "wait_wall_s_total": wreport["wait_wall_s_total"],
+                    "staleness_p90_exact": exact_p90,
+                    "staleness_p90_sketch": round(sketch_p90, 4),
+                    "sketch_tolerance": round(tol, 4),
+                    "digest_bytes_total_emitted": digest_bytes_total,
+                },
+                "note": "digest bytes and per-node observatory memory are "
+                "measured at each fleet size; the snapshot renders via "
+                "scripts/fed_top.py",
+            },
+        }
+        out["meta"] = _bench_meta(seed=seed, backend="cpu")
+        os.makedirs("artifacts", exist_ok=True)
+        with open(os.path.join("artifacts", "FLEETOBS_BENCH.json"), "w") as f:
+            json.dump(out, f, indent=1)
+        _phase(
+            f"fleetobs bench done: digest {small['digest_bytes']}B -> "
+            f"{big['digest_bytes']}B over {size_ratio:.0f}x fleet; slow peer "
+            f"gated {gated}/{windows} windows"
+        )
+    except Exception as e:  # noqa: BLE001
+        traceback.print_exc(file=sys.stderr)
+        out["error"] = f"{type(e).__name__}: {e}"
+    _emit(out, seed=locals().get("seed"), backend="cpu")
+
+
 def run_critical_path_bench() -> None:
     """Subprocess-style mode ``--critical-path``: performance-attribution
     acceptance run.
@@ -3627,6 +4014,8 @@ if __name__ == "__main__":
         run_telemetry_bench()
     elif "--observatory" in sys.argv:
         run_observatory_bench()
+    elif "--fleetobs" in sys.argv:
+        run_fleetobs_bench()
     elif "--critical-path" in sys.argv:
         run_critical_path_bench()
     elif "--chaos" in sys.argv:

@@ -58,6 +58,13 @@ _PEER_WRITTEN_OFF = REGISTRY.counter(
     "Neighbors removed after a send failed all its retry attempts",
     labels=("node",),
 )
+_DIGEST_BYTES = REGISTRY.counter(
+    "p2pfl_digest_bytes_total",
+    "Health-digest payload bytes emitted onto heartbeats (per beat) — the "
+    "observability plane's wire cost, which must stay flat-to-logarithmic "
+    "as the fleet grows (sketches, not per-peer scalars)",
+    labels=("node",),
+)
 
 
 def running(fn: Callable) -> Callable:
@@ -147,7 +154,9 @@ class CommunicationProtocol:
         if dig is None:
             return None
         self.observatory.ingest(dig)
-        return dig.encode()
+        wire = dig.encode()
+        _DIGEST_BYTES.labels(self._addr).inc(len(wire))
+        return wire
 
     def _ingest_digest(self, env: Envelope) -> None:
         dig = digest_mod.decode(env.digest)

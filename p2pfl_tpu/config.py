@@ -229,6 +229,30 @@ class Settings:
     # wire-compatible — absent digests are tolerated by every receiver.
     DIGEST_ENABLED: bool = _env_override("DIGEST_ENABLED", True)
     DIGEST_EVERY_BEATS: int = _env_int("DIGEST_EVERY_BEATS", 1, 1, 1000)
+    # Sketch-native observability (telemetry/sketches.py): digests v2 carry
+    # mergeable relative-error quantile sketches (step-time, staleness,
+    # update-norm, agg-wait) instead of raw scalars only, so fleet quantiles
+    # compose from gossip at any population. SKETCH_REL_ERR bounds the
+    # relative error of every quantile estimate; SKETCH_MAX_BINS caps one
+    # sketch's in-memory buckets (lowest buckets collapse past it — upper
+    # quantiles keep the guarantee).
+    SKETCH_REL_ERR: float = _env_float("SKETCH_REL_ERR", 0.02, 0.001, 0.5)
+    SKETCH_MAX_BINS: int = _env_int("SKETCH_MAX_BINS", 128, 16, 4096)
+    # Observatory memory bounds (the observatory must stay sublinear in
+    # population): peers whose last digest is older than OBS_PEER_TTL
+    # seconds are EVICTED outright — dropped from the per-peer table, the
+    # round-entry book, and every scoring statistic (a crashed peer must not
+    # skew straggler z-scores forever), counted p2pfl_fed_evicted_total.
+    # 0 disables eviction. Beyond OBS_MAX_TRACKED live peers, new peers'
+    # digests fold into merged fleet sketches + a bounded worst-straggler
+    # candidate table instead of growing the per-peer dict.
+    OBS_PEER_TTL: float = _env_float("OBS_PEER_TTL", 120.0, 0.0, 86400.0)
+    OBS_MAX_TRACKED: int = _env_int("OBS_MAX_TRACKED", 512, 8, 1 << 20)
+    # Minimum seconds between Prometheus-gauge refreshes of the derived
+    # fleet scores (each refresh is O(live peers); at population scale a
+    # per-beat refresh would be quadratic). 0 = refresh on every ingest
+    # (the n<=8 test-friendly default).
+    OBS_REFRESH_MIN_S: float = _env_float("OBS_REFRESH_MIN_S", 0.0, 0.0, 60.0)
     # Flight recorder (telemetry/flight_recorder.py): bounded per-node ring
     # of structured events, dumped to artifacts/flightrec_<node>.json on
     # crash / aggregation-stall / workflow failure.

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
@@ -35,6 +36,7 @@ from p2pfl_tpu.config import Settings
 from p2pfl_tpu.models.model_handle import ModelHandle
 from p2pfl_tpu.ops import aggregation as agg_ops
 from p2pfl_tpu.telemetry import REGISTRY
+from p2pfl_tpu.telemetry.sketches import SKETCHES
 
 _FOLDED = REGISTRY.counter(
     "p2pfl_async_contributions_total",
@@ -61,6 +63,12 @@ _WINDOW_FILL = REGISTRY.gauge(
     "p2pfl_async_window_fill",
     "Distinct contributors aggregated in the last window",
     labels=("node",),
+)
+_WINDOW_CLOSE = REGISTRY.counter(
+    "p2pfl_async_window_close_total",
+    "Async windows closed, by reason (fill: target met; shrink: a live-"
+    "shrunk target met after membership loss; timeout: deadline expired)",
+    labels=("node", "reason"),
 )
 
 
@@ -102,6 +110,14 @@ class AsyncBufferedAggregator:
         #: async-check "joiner contributed within N windows" probe).
         self.seen_contributors: Dict[str, int] = {}  # sender -> first window
         self._last_mean_lag = 0.0
+        #: exact lags of every contribution aggregated (bounded) — the
+        #: ground truth the digest's staleness SKETCH is validated against.
+        self.lag_log: deque = deque(maxlen=4096)
+        #: why the last window closed ("fill" | "shrink" | "timeout") and
+        #: how full it was — stamped onto the window_close marker span so
+        #: the critical-path analyzer can break windows down by reason.
+        self.last_close_reason = ""
+        self.last_fill = 0
 
     # --- window lifecycle ----------------------------------------------------
 
@@ -172,19 +188,30 @@ class AsyncBufferedAggregator:
         """
         timeout = Settings.ASYNC_WINDOW_TIMEOUT if timeout is None else timeout
         deadline = time.monotonic() + timeout
+        initial_target = max(1, int(target_fn()))
         while True:
             if early_stop_fn is not None and early_stop_fn():
                 return None
+            target = max(1, int(target_fn()))
             with self._lock:
                 have = len(self._buffer)
-            if have > 0 and (
-                have >= max(1, int(target_fn())) or time.monotonic() >= deadline
-            ):
+            if have > 0 and have >= target:
+                # Close-reason attribution: a target met only because it
+                # SHRANK below its window-open value is a membership story,
+                # not a throughput one — the window report separates them.
+                self.last_close_reason = (
+                    "shrink" if target < initial_target and have < initial_target
+                    else "fill"
+                )
+                break
+            if have > 0 and time.monotonic() >= deadline:
+                self.last_close_reason = "timeout"
                 break
             # have == 0 past the deadline: keep a short grace loop (the own
             # contribution is still being produced) rather than raising.
             self._event.clear()
             self._event.wait(timeout=0.25)
+        _WINDOW_CLOSE.labels(self.addr, self.last_close_reason).inc()
         return self._aggregate_drained()
 
     def _aggregate_drained(self) -> ModelHandle:
@@ -196,9 +223,19 @@ class AsyncBufferedAggregator:
         models = [m for m, _ in drained]
         lags = [lag for _, lag in drained]
         self._last_mean_lag = sum(lags) / len(lags)
+        self.last_fill = len(models)
         _STALENESS.labels(self.addr).set(self._last_mean_lag)
         _WINDOW_FILL.labels(self.addr).set(len(models))
         _WINDOWS.labels(self.addr).inc()
+        # Per-contribution staleness DISTRIBUTION (not just the mean): the
+        # digest's staleness sketch is what lets any observer read this
+        # node's staleness p90 off the gossip wire.
+        for lag in lags:
+            self.lag_log.append(int(lag))
+            SKETCHES.observe("staleness", self.addr, float(lag))
+        for m in models:
+            for contributor in m.contributors:
+                SKETCHES.distinct_add(self.addr, contributor)
         if self.rule is not None:
             return self.rule(models)
         return self.aggregate_weighted(models, lags)

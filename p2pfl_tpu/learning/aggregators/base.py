@@ -29,6 +29,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 from p2pfl_tpu.config import Settings
 from p2pfl_tpu.models.model_handle import ModelHandle
 from p2pfl_tpu.telemetry import REGISTRY
+from p2pfl_tpu.telemetry.sketches import SKETCHES
 
 log = logging.getLogger("p2pfl_tpu")
 
@@ -222,7 +223,9 @@ class Aggregator:
                         except Exception:  # a hook bug must not break the round
                             log.exception("(%s) on_stall hook failed", self.node_addr)
                     break
-        _AGG_WAIT.labels(self.node_addr).observe(time.perf_counter() - t0)
+        wait_s = time.perf_counter() - t0
+        _AGG_WAIT.labels(self.node_addr).observe(wait_s)
+        SKETCHES.observe("agg_wait", self.node_addr, wait_s)
         with self._lock:
             if not self._models:
                 raise RuntimeError("no models to aggregate")
@@ -234,6 +237,8 @@ class Aggregator:
             _AGG_CONTRIBUTORS.labels(self.node_addr).set(
                 len(self.get_aggregated_models())
             )
+            for contributor in self.get_aggregated_models():
+                SKETCHES.distinct_add(self.node_addr, contributor)
             return self.aggregate(list(self._models))
 
     def get_partial_model(self, except_nodes: Sequence[str]) -> Optional[ModelHandle]:

@@ -37,6 +37,7 @@ import optax
 from p2pfl_tpu.learning.dataset.dataset import FederatedDataset
 from p2pfl_tpu.models.model_handle import ModelHandle
 from p2pfl_tpu.telemetry import REGISTRY
+from p2pfl_tpu.telemetry.sketches import SKETCHES
 
 Pytree = Any
 
@@ -546,6 +547,13 @@ class JaxLearner(Learner):
                 else:
                     steady_time += seg_dur
                     steady_steps += stop - start
+                    # Distribution, not just the latest value: the digest's
+                    # step-time sketch carries every steady segment, so the
+                    # fleet sees per-node step-time QUANTILES, not a racing
+                    # last-write gauge.
+                    SKETCHES.observe(
+                        "step_time", self._self_addr, seg_dur / (stop - start)
+                    )
                 seg_losses.append((stop - start, loss_f))
             last_loss = sum(n * l for n, l in seg_losses) / max(
                 sum(n for n, _ in seg_losses), 1
@@ -570,7 +578,9 @@ class JaxLearner(Learner):
             params,
             anchor,
         )
-        self.report("update_norm", float(jnp.sqrt(sum(jax.tree.leaves(upd_sq)))))
+        upd_norm = float(jnp.sqrt(sum(jax.tree.leaves(upd_sq))))
+        self.report("update_norm", upd_norm)
+        SKETCHES.observe("update_norm", self._self_addr, upd_norm)
 
         if self.dp_clip_norm <= 0.0:
             self._nonprivate_steps += total_steps

@@ -133,6 +133,13 @@ class MeshSimulation:
             ``aggregate_fn`` rules; BASELINE config #4).
         byzantine_attack: ``"signflip"`` (update negated around the round
             start) or ``"scaled"`` (10x the honest delta).
+        node_speed: optional ``[N]`` positive per-node speed-tier
+            multipliers (1.0 = baseline, 5.0 = a 5x-slower device class —
+            the ROADMAP item-3 scenario knob). The fused round runs in
+            lockstep regardless; the tiers drive the VIRTUAL per-node
+            health model (:meth:`fleet_health` — round lag, step time) so a
+            population-scale run produces a real observatory snapshot with
+            seeded stragglers in it.
     """
 
     def __init__(
@@ -159,6 +166,7 @@ class MeshSimulation:
         server_optimizer: "Optional[optax.GradientTransformation | str]" = None,
         server_lr: float = 1.0,
         clip_update_norm: float = 0.0,
+        node_speed: Optional[np.ndarray] = None,
     ) -> None:
         if task not in ("classification", "lm"):
             raise ValueError(f"unknown task {task!r}")
@@ -290,6 +298,19 @@ class MeshSimulation:
         else:
             self.x, self.y, self.sample_mask = _stack_partitions(partitions)
         self.num_nodes = int(self.x.shape[0])
+        # Device-class speed tiers (virtual — see fleet_health).
+        if node_speed is not None:
+            speeds = np.asarray(node_speed, np.float32)
+            if speeds.shape != (self.num_nodes,):
+                raise ValueError(
+                    f"node_speed has shape {speeds.shape}, expected "
+                    f"({self.num_nodes},) — one multiplier per node"
+                )
+            if not np.all(speeds > 0):
+                raise ValueError("node_speed multipliers must be > 0")
+            self.node_speed: Optional[np.ndarray] = speeds
+        else:
+            self.node_speed = None
         if self._byz is not None and self._byz.shape != (self.num_nodes,):
             # A wrong-length mask would be silently mis-gathered inside the
             # jitted body (JAX clamps out-of-bounds indices) and attack the
@@ -965,6 +986,93 @@ class MeshSimulation:
             "bytes_accessed_per_round": float(ca.get("bytes accessed", 0.0))
             / rounds_per_call,
         }
+
+    # --- fused-mesh observability --------------------------------------------
+
+    @staticmethod
+    @partial(jax.jit, static_argnames=("n", "rounds"))
+    def _fleet_summary_jit(
+        committees: jax.Array, speed: jax.Array, byz: jax.Array,
+        base_step_s: jax.Array, *, n: int, rounds: int,
+    ):
+        """On-device per-virtual-node health: one scatter-add over the
+        round committees plus elementwise math — O(R*K + N) on the mesh, so
+        a 100k-population summary never round-trips per-node Python."""
+        participation = (
+            jnp.zeros((n,), jnp.float32).at[committees.reshape(-1)].add(1.0)
+        )
+        step_time = base_step_s * speed
+        # A tier-s node's virtual clock covers rounds/s rounds in the time
+        # the fleet covers `rounds`: its round index lags by the rest
+        # (faster-than-baseline tiers clamp to zero lag — there is no
+        # "ahead of the fleet" in round indices).
+        round_lag = jnp.maximum(0.0, jnp.floor(rounds * (1.0 - 1.0 / speed)))
+        round_idx = rounds - round_lag
+        rejections = byz * participation
+        return participation, step_time, round_lag, round_idx, rejections
+
+    def fleet_health(self, result: SimulationResult, epochs: int = 1) -> Dict[str, np.ndarray]:
+        """Per-virtual-node health arrays for the completed ``result``.
+
+        ``participation`` (committee appearances) and ``rejections``
+        (Byzantine nodes' poisoned appearances — what wire admission would
+        have rejected) are measured from the run's committees;
+        ``step_time`` and ``round_lag`` apply the ``node_speed`` device
+        tiers to the MEASURED mean step time (the fused round is lockstep,
+        so per-node wall clocks are a model, and an honest one: a real
+        deployment of these tiers would show exactly these lags).
+        """
+        if result.committees is None:
+            raise ValueError("result carries no committee history")
+        n = self.num_nodes
+        rounds = int(result.committees.shape[0])
+        steps_per_round = max(1, (int(self.x.shape[1]) // self.batch_size) * epochs)
+        base_step_s = result.seconds_per_round / steps_per_round
+        speed = jnp.asarray(
+            self.node_speed if self.node_speed is not None else np.ones(n, np.float32)
+        )
+        byz = self._byz if self._byz is not None else jnp.zeros((n,), jnp.float32)
+        participation, step_time, round_lag, round_idx, rejections = (
+            self._fleet_summary_jit(
+                jnp.asarray(result.committees), speed, byz,
+                jnp.float32(base_step_s), n=n, rounds=rounds,
+            )
+        )
+        return {
+            "participation": np.asarray(participation),
+            "step_time": np.asarray(step_time),
+            "round_lag": np.asarray(round_lag),
+            "round": np.asarray(round_idx),
+            "rejections": np.asarray(rejections),
+        }
+
+    def fleet_snapshot(
+        self,
+        result: SimulationResult,
+        epochs: int = 1,
+        top_n: int = 16,
+        path: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Observatory snapshot for the virtual fleet: the
+        :meth:`fleet_health` arrays folded into quantile sketches host-side
+        (one vectorized pass per metric) plus a top-N straggler table — the
+        same document shape the real-wire observatory writes, so
+        ``scripts/fed_top.py`` renders a 10k-node mesh run identically to
+        an 8-node federation. ``path`` additionally writes it atomically.
+        """
+        from p2pfl_tpu.telemetry.observatory import (
+            population_snapshot,
+            write_snapshot_doc,
+        )
+
+        health = self.fleet_health(result, epochs=epochs)
+        names = [f"vnode/{i:05d}" for i in range(self.num_nodes)]
+        snap = population_snapshot(
+            observer="mesh-sim", node_names=names, metrics=health, top_n=top_n
+        )
+        if path is not None:
+            write_snapshot_doc(path, snap)
+        return snap
 
     def privacy_spent(self, delta: float = 1e-5) -> Dict[str, Any]:
         """Conservative per-node (epsilon, delta) for the DP-SGD run so far

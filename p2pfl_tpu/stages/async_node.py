@@ -207,6 +207,16 @@ class AsyncWindowStage(Stage):
             )
         if aggregated is None:
             return None
+        # Zero-duration marker span carrying the window's close diagnosis —
+        # the critical-path analyzer's window report reads these for the
+        # close-reason breakdown and the staleness-discount attribution
+        # (span args ride the chrome export, so offline merges see them too).
+        with TRACER.span(
+            "window_close", node=node.addr, round=w,
+            reason=agg.last_close_reason, mean_lag=round(agg.last_mean_lag, 4),
+            fill=agg.last_fill,
+        ):
+            pass
 
         model = node.learner.get_model()
         model.set_parameters(aggregated.params)

@@ -18,10 +18,14 @@ exposition and a JSON snapshot of the registry.
 
 The federation observatory builds on both halves:
 
+* :mod:`p2pfl_tpu.telemetry.sketches` — mergeable, wire-encodable
+  distribution summaries (relative-error quantile sketches + a HyperLogLog
+  distinct estimator) that keep fleet views sublinear in population,
 * :mod:`p2pfl_tpu.telemetry.digest` — the versioned per-node health digest
-  piggybacked on heartbeats (``Envelope.digest``),
+  piggybacked on heartbeats (``Envelope.digest``; v2 carries sketches),
 * :mod:`p2pfl_tpu.telemetry.observatory` — the per-node fleet view with
   derived straggler / suspect / link scores (``p2pfl_fed_*`` section),
+  TTL eviction and bounded population-overflow tracking,
 * :mod:`p2pfl_tpu.telemetry.flight_recorder` — the bounded postmortem
   event ring dumped to ``artifacts/flightrec_<node>.json`` on failure.
 
@@ -45,14 +49,22 @@ from p2pfl_tpu.telemetry.tracing import TRACER, Tracer  # noqa: F401
 from p2pfl_tpu.telemetry.critical_path import (  # noqa: F401
     CriticalPathAnalyzer,
 )
+from p2pfl_tpu.telemetry.sketches import (  # noqa: F401
+    DistinctEstimator,
+    QuantileSketch,
+    SKETCHES,
+)
 
 __all__ = [
     "Counter",
     "CriticalPathAnalyzer",
+    "DistinctEstimator",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "QuantileSketch",
     "REGISTRY",
+    "SKETCHES",
     "TRACER",
     "Tracer",
 ]

@@ -49,6 +49,8 @@ from p2pfl_tpu.telemetry.metrics import REGISTRY, MetricsRegistry
 from p2pfl_tpu.telemetry.tracing import TRACER, Span, Tracer
 
 #: Fine-grained stage-work spans that carry a round and form path segments.
+#: Async-scheduler spans ride the same machinery — a WINDOW is a round to
+#: the walk (the ``round`` span arg carries the window index).
 FINE_SPANS = (
     "vote_rtt",
     "fit",
@@ -57,7 +59,14 @@ FINE_SPANS = (
     "diffuse:init_model",
     "diffuse:partial_model",
     "diffuse:full_model",
+    "diffuse:async_model",
+    "async_window_wait",
 )
+
+#: Zero-duration diagnosis markers the async scheduler drops per window
+#: (close reason, mean folded lag, fill) — consumed by the window report,
+#: never path segments.
+WINDOW_MARKER = "window_close"
 
 #: Spans that end because a remote frame arrived, and the recv/apply span
 #: names that can resolve them. Order matters: earlier names are preferred
@@ -79,6 +88,10 @@ WAIT_RESOLVERS: Dict[str, Tuple[str, ...]] = {
         "recv:models_ready",
     ),
     "diffuse:full_model": ("recv:models_ready",),
+    # An async window's fill wait ends because a contribution arrived; the
+    # recv span's parent link crosses the wire to the (possibly slow)
+    # contributor whose frame closed the window.
+    "async_window_wait": ("recv:async_model", "apply:async_model"),
 }
 
 #: Container spans (whole-stage / whole-experiment) — never path segments.
@@ -106,6 +119,9 @@ class Seg:
     parent_id: str
     trace_id: str
     round: Optional[int]
+    #: raw span args (close reason, mean lag, ... — window markers carry
+    #: their diagnosis here; empty for most spans).
+    extra: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def dur_s(self) -> float:
@@ -201,6 +217,7 @@ class CriticalPathAnalyzer:
         self._recv = sorted(
             (s for s in segs if _is_recv(s.name)), key=lambda s: s.end_s
         )
+        self._markers = [s for s in segs if s.name == WINDOW_MARKER]
         self._by_id = {s.span_id: s for s in segs if s.span_id}
         self._fine_by_node: Dict[str, List[Seg]] = {}
         for s in self._fine:
@@ -227,6 +244,7 @@ class CriticalPathAnalyzer:
                 parent_id=s.parent_id,
                 trace_id=s.trace_id,
                 round=_round_of(s.args),
+                extra=dict(s.args),
             )
             for s in tracer.spans()
         ]
@@ -292,6 +310,10 @@ class CriticalPathAnalyzer:
                         parent_id=str(args.get("parent_id", "")),
                         trace_id=str(args.get("trace_id", "")),
                         round=_round_of(args),
+                        extra={
+                            k: v for k, v in args.items()
+                            if k not in ("trace_id", "span_id", "parent_id")
+                        },
                     )
                 )
         return cls(segs, slack_s=slack_s)
@@ -575,6 +597,117 @@ class CriticalPathAnalyzer:
             "ROADMAP item 4 (comm/compute overlap) can reclaim",
         }
 
+    # --- async window attribution --------------------------------------------
+
+    def has_windows(self) -> bool:
+        """True when the trace came from the async scheduler (window spans
+        or close markers present)."""
+        return bool(self._markers) or any(
+            s.name in ("async_window_wait", "diffuse:async_model")
+            for s in self._fine
+        )
+
+    def window_report(self, staleness_alpha: Optional[float] = None) -> Dict[str, Any]:
+        """Per-window attribution for async (Papaya/FedBuff) traces.
+
+        A window is a round to the backward gating walk — the async spans
+        (``fit``, ``diffuse:async_model``, ``async_window_wait``) are
+        registered fine spans, so :meth:`round_path` already answers "which
+        CONTRIBUTOR gated this window" (the wait resolves through the
+        ``recv:async_model`` whose arrival closed it, chasing back to the
+        slow origin). On top of the walk, each window's ``window_close``
+        marker (close reason, mean folded lag, fill) yields:
+
+        * **close-reason breakdown** — fill target met vs live-shrunk
+          target vs timeout, per window and aggregated;
+        * **staleness-discount vs wall-clock attribution** — the two
+          currencies the async scheduler can pay a straggler in: waiting
+          for it (``wait_s``, wall-clock on the window's critical path) or
+          accepting its stale contribution at a discount
+          (``discount_fraction = 1 - (1+mean_lag)^-alpha``, aggregate
+          weight given up to staleness). A fleet paying mostly wall-clock
+          wants a smaller fill target; one paying mostly discount wants a
+          larger alpha or a staleness cap.
+        """
+        if staleness_alpha is None:
+            from p2pfl_tpu.config import Settings
+
+            staleness_alpha = Settings.ASYNC_STALENESS_ALPHA
+        # Markers by window, newest-wins per (window, node); windows come
+        # from markers AND fine spans (a window that died before its close
+        # marker still shows its path).
+        marks: Dict[int, List[Seg]] = {}
+        for m in self._markers:
+            if m.round is not None:
+                marks.setdefault(m.round, []).append(m)
+        windows = sorted(set(self.rounds()) | set(marks))
+        out_windows: Dict[str, Any] = {}
+        reason_counts: Dict[str, int] = {}
+        gating_counts: Dict[str, int] = {}
+        total_wait_s = 0.0
+        discount_weighted = 0.0
+        for w in windows:
+            path = self.round_path(w)
+            if path.gating_node:
+                gating_counts[path.gating_node] = (
+                    gating_counts.get(path.gating_node, 0) + 1
+                )
+            wait_s = sum(
+                s.dur_s
+                for s in self._fine
+                if s.round == w and s.name == "async_window_wait"
+            )
+            total_wait_s += wait_s
+            wmarks = marks.get(w, [])
+            reasons = sorted({str(m.extra.get("reason", "")) for m in wmarks} - {""})
+            for r in reasons:
+                reason_counts[r] = reason_counts.get(r, 0) + 1
+            lags = [
+                float(m.extra.get("mean_lag", 0.0))
+                for m in wmarks
+                if m.extra.get("mean_lag") is not None
+            ]
+            mean_lag = sum(lags) / len(lags) if lags else 0.0
+            discount = 1.0 - (1.0 + mean_lag) ** (-float(staleness_alpha))
+            discount_weighted += discount
+            fills = [
+                int(m.extra.get("fill", 0)) for m in wmarks if m.extra.get("fill")
+            ]
+            out_windows[str(w)] = {
+                "gating_contributor": path.gating_node,
+                "wall_s": path.to_dict()["wall_s"],
+                "coverage": path.to_dict()["coverage"],
+                "wait_s": round(wait_s, 6),
+                "close_reasons": reasons,
+                "mean_lag": round(mean_lag, 4),
+                "staleness_discount": round(discount, 4),
+                "fill": max(fills) if fills else None,
+                "attributed_by_node": path.to_dict()["attributed_by_node"],
+            }
+        top = (
+            max(gating_counts, key=lambda n: gating_counts[n])
+            if gating_counts
+            else None
+        )
+        n_win = len(windows)
+        return {
+            "windows": out_windows,
+            "close_reason_counts": dict(sorted(reason_counts.items())),
+            "gating_counts": gating_counts,
+            "top_gating_contributor": top,
+            "top_gating_fraction": (
+                round(gating_counts.get(top, 0) / n_win, 4) if top and n_win else 0.0
+            ),
+            "staleness_alpha": float(staleness_alpha),
+            "wait_wall_s_total": round(total_wait_s, 6),
+            "mean_staleness_discount": (
+                round(discount_weighted / n_win, 4) if n_win else 0.0
+            ),
+            "note": "wait_wall_s_total is the wall-clock currency paid "
+            "waiting on contributions; mean_staleness_discount is the "
+            "aggregate-weight currency paid accepting stale ones",
+        }
+
     def report(self) -> Dict[str, Any]:
         """The full attribution report: one entry per round plus aggregates."""
         rounds = self.rounds()
@@ -585,6 +718,7 @@ class CriticalPathAnalyzer:
                 gating_counts[p.gating_node] = gating_counts.get(p.gating_node, 0) + 1
         top = max(gating_counts, key=lambda n: gating_counts[n]) if gating_counts else None
         return {
+            **({"window_report": self.window_report()} if self.has_windows() else {}),
             "rounds": {str(r): paths[r].to_dict() for r in rounds},
             "stage_shares_by_round": {
                 str(r): self.stage_shares(r) for r in rounds
@@ -653,6 +787,7 @@ __all__ = [
     "Seg",
     "FINE_SPANS",
     "WAIT_RESOLVERS",
+    "WINDOW_MARKER",
     "skew_from_registry",
     "load_chrome_trace",
 ]

@@ -33,22 +33,30 @@ from dataclasses import asdict, dataclass, field
 from typing import Any, Dict, Optional
 
 from p2pfl_tpu.telemetry.metrics import REGISTRY
+from p2pfl_tpu.telemetry.sketches import SKETCHES
 
 log = logging.getLogger("p2pfl_tpu")
 
 #: Bump when the digest schema changes incompatibly. Decoders keep reading
-#: newer digests best-effort (known fields only).
-DIGEST_VERSION = 1
+#: newer digests best-effort (known fields only). v2 adds the ``sk`` sketch
+#: table (mergeable quantile sketches + distinct-contributor estimator);
+#: v1 digests decode with an empty table and stay first-class citizens.
+DIGEST_VERSION = 2
 
 #: Reserved prefix for the trailing gRPC control-frame digest arg (the
 #: ``__trace__:`` pattern — the proto schema predates digests and protoc is
 #: not in the image to regenerate it).
 WIRE_ARG_PREFIX = "__digest__:"
 
-#: Digest payloads above this are dropped at decode: a digest is a few
-#: hundred bytes of JSON; anything larger is corrupt or hostile (heartbeats
-#: must stay cheap — they are the failure detector).
-MAX_DIGEST_BYTES = 8192
+#: Digest payloads above this are dropped at decode: a v2 digest is a few
+#: KB of JSON (four bounded sketches + scalars — size is a function of the
+#: bin cap, NOT of fleet size or stream length); anything larger is corrupt
+#: or hostile (heartbeats must stay cheap — they are the failure detector).
+MAX_DIGEST_BYTES = 16384
+
+#: Per-sketch wire bucket cap inside a digest (in-memory sketches may hold
+#: Settings.SKETCH_MAX_BINS; the wire form re-collapses to this).
+DIGEST_SKETCH_BINS = 48
 
 
 @dataclass
@@ -90,14 +98,38 @@ class HealthDigest:
     faults_seen: float = 0.0  # chaos faults injected at this node's sends
     # Device.
     mem_bytes: float = 0.0
+    # Distribution sketches (v2+): name -> QuantileSketch wire dict, plus
+    # the HyperLogLog distinct-contributor estimator under "__distinct__".
+    # Stored in WIRE form — decoding is lazy (the observatory decodes only
+    # when it merges fleet quantiles), and absent/{} means a v1 peer.
+    sketches: Dict[str, Any] = field(default_factory=dict)
+
+    # --- sketch accessors ----------------------------------------------------
+
+    def sketch(self, name: str):
+        """Decode one carried quantile sketch (None when absent/invalid)."""
+        from p2pfl_tpu.telemetry.sketches import QuantileSketch
+
+        return QuantileSketch.from_wire(self.sketches.get(name))
+
+    def distinct(self):
+        """Decode the distinct-contributor estimator (None when absent)."""
+        from p2pfl_tpu.telemetry.sketches import DistinctEstimator
+
+        return DistinctEstimator.from_wire(self.sketches.get("__distinct__"))
 
     # --- wire codec ---------------------------------------------------------
 
     def encode(self) -> str:
         """Compact JSON, stable key order (diffable in flight-recorder
-        dumps and deterministic for tests)."""
+        dumps and deterministic for tests). An empty sketch table is
+        omitted entirely — a v1-shaped digest encodes byte-identically to
+        the v1 wire (modulo the version stamp)."""
         d = asdict(self)
         d["v"] = d.pop("version")
+        sk = d.pop("sketches", None)
+        if sk:
+            d["sk"] = sk
         return json.dumps(d, separators=(",", ":"), sort_keys=True)
 
 
@@ -143,6 +175,15 @@ def decode(payload: str) -> Optional["HealthDigest"]:
                 except (TypeError, ValueError):
                     continue
             setattr(dig, name, table)
+    # v2 sketch table: kept in WIRE form (decoded lazily by consumers, so a
+    # malformed sketch degrades to "absent" at merge time, never at ingest).
+    # A v1 payload simply has no "sk" — empty table, fully functional digest.
+    sk = raw.get("sk")
+    if isinstance(sk, dict):
+        dig.sketches = {
+            str(k): v for k, v in sk.items()
+            if isinstance(v, dict) or (k == "__distinct__" and isinstance(v, str))
+        }
     return dig
 
 
@@ -238,12 +279,17 @@ def collect(addr: str, state: Any = None) -> HealthDigest:
         dig.staleness = _gauge_value("p2pfl_async_staleness", addr)
         dig.faults_seen = float(_series_sum("p2pfl_chaos_faults_total", addr))
         dig.mem_bytes = device_mem_bytes()
+        # v2: the node's distribution sketches (step-time, staleness,
+        # update-norm, agg-wait) + distinct-contributor estimator, wire
+        # bins bounded so the beat stays cheap regardless of stream length.
+        dig.sketches = SKETCHES.wire_for(addr, max_bins=DIGEST_SKETCH_BINS)
     except Exception:  # noqa: BLE001
         log.exception("(%s) health-digest collection failed", addr)
     return dig
 
 
 __all__ = [
+    "DIGEST_SKETCH_BINS",
     "DIGEST_VERSION",
     "HealthDigest",
     "MAX_DIGEST_BYTES",

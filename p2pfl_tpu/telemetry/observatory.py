@@ -25,9 +25,23 @@ reports directly:
   clock skew, read from the heartbeater's own gauges (these are facts about
   OUR link, so they come from the local registry, not from digests).
 
-Exports: the ``p2pfl_fed_*`` Prometheus section (refreshed on every
-ingest), :meth:`snapshot` (the JSON federation view ``scripts/fed_top.py``
-renders live) and :meth:`top` (argmax helpers the chaos bench asserts on).
+Population scale (PR 8): the observatory is bounded in fleet size. Peers
+whose digests stop arriving for ``Settings.OBS_PEER_TTL`` are EVICTED —
+dropped from the per-peer table AND every scoring statistic (a crashed
+peer must not skew straggler z-scores forever), counted
+``p2pfl_fed_evicted_total``. Beyond ``Settings.OBS_MAX_TRACKED`` live
+peers, new peers' digests fold into MERGED fleet sketches plus a bounded
+worst-straggler candidate table instead of growing the per-peer dict — the
+fleet quantile view (:meth:`fleet_quantiles`, built from the v2 digests'
+mergeable sketches) stays exact-within-sketch-error while per-node memory
+grows ~O(log n). Prometheus refreshes are rate-limited by
+``Settings.OBS_REFRESH_MIN_S`` (each refresh is O(live peers)).
+
+Exports: the ``p2pfl_fed_*`` Prometheus section, :meth:`snapshot` (the
+JSON federation view ``scripts/fed_top.py`` renders live — now with a
+``fleet`` quantile section), :meth:`top` (argmax helpers the benches
+assert on), and :func:`write_snapshot_doc` (the atomic writer the fused-
+mesh simulation reuses for its virtual-fleet snapshots).
 """
 
 from __future__ import annotations
@@ -41,11 +55,17 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from collections import deque
 
+from p2pfl_tpu.config import Settings
 from p2pfl_tpu.telemetry.digest import HealthDigest
 from p2pfl_tpu.telemetry.metrics import REGISTRY
+from p2pfl_tpu.telemetry.sketches import DistinctEstimator, QuantileSketch
 
 #: Membership churn tail kept (and snapshotted) per observatory.
 MEMBERSHIP_EVENTS = 64
+
+#: Top-N rows a population snapshot keeps per metric (and the unit of the
+#: bounded overflow straggler-candidate table, which holds 4x this).
+_TOP_CANDIDATES = 16
 
 _PEER_ROUND = REGISTRY.gauge(
     "p2pfl_fed_peer_round",
@@ -79,6 +99,18 @@ _DIGESTS_RX = REGISTRY.counter(
     "p2pfl_fed_digests_rx_total",
     "Health digests ingested, by reporting peer",
     labels=("node", "peer"),
+)
+_EVICTED = REGISTRY.counter(
+    "p2pfl_fed_evicted_total",
+    "Peers evicted from the observatory after OBS_PEER_TTL with no digest "
+    "(dead peers leave the scoring statistics instead of skewing them)",
+    labels=("node",),
+)
+_OVERFLOW = REGISTRY.gauge(
+    "p2pfl_fed_overflow_peers",
+    "Peers folded into merged fleet sketches instead of per-peer tracking "
+    "(population beyond OBS_MAX_TRACKED)",
+    labels=("node",),
 )
 
 #: A digest older than this many seconds is stale: its peer stops counting
@@ -117,6 +149,19 @@ class Observatory:
         #: worthy events (Node/protocol wire the per-node recorder in).
         self.recorder = recorder
         self._peers_known = _PEERS_KNOWN.labels(addr)
+        self._evicted = _EVICTED.labels(addr)
+        self._overflow_gauge = _OVERFLOW.labels(addr)
+        # Population-overflow state: beyond Settings.OBS_MAX_TRACKED live
+        # peers, new peers' digests fold here instead of into _peers —
+        # merged fleet sketches (mergeable by construction) + a bounded
+        # worst-round-lag candidate table so the top-straggler question
+        # still has an answer among untracked peers.
+        self._overflow_sketches: Dict[str, QuantileSketch] = {}
+        self._overflow_distinct: Optional[DistinctEstimator] = None
+        self._overflow_seen: set = set()  # addresses folded at least once
+        self._overflow_top: Dict[str, Tuple[float, int]] = {}  # peer -> (lag, round)
+        self._last_evict = 0.0  # monotonic; eviction sweep throttle
+        self._last_refresh = 0.0  # monotonic; Prometheus refresh throttle
 
     def _membership_event(self, event: str, peer: str) -> None:
         # caller holds the lock
@@ -135,8 +180,16 @@ class Observatory:
     def ingest(self, dig: HealthDigest) -> bool:
         """Record a peer's digest (or our own — the self view rides the same
         path). Returns True when the peer's round or stage CHANGED — the
-        signal the flight recorder logs as a digest-delta event."""
+        signal the flight recorder logs as a digest-delta event.
+
+        Memory bounds: an unknown peer arriving while the per-peer table is
+        at ``OBS_MAX_TRACKED`` folds into the overflow fleet sketches (and,
+        when its round lag is among the worst, the bounded straggler-
+        candidate table) instead of growing the table; peers silent past
+        ``OBS_PEER_TTL`` are evicted by the sweep this call triggers.
+        """
         now = time.monotonic()
+        self._evict_expired(now)
         with self._lock:
             prev = self._peers.get(dig.node)
             # Out-of-order delivery (gossip re-forwarding): keep the newest
@@ -144,6 +197,9 @@ class Observatory:
             if prev is not None and dig.ts and prev[0].ts and dig.ts < prev[0].ts:
                 return False
             if prev is None and dig.node != self._addr:
+                if len(self._peers) >= max(8, int(Settings.OBS_MAX_TRACKED)):
+                    self._fold_overflow(dig)
+                    return False
                 self._membership_event(
                     "rejoin" if dig.node in self._ever_seen else "join", dig.node
                 )
@@ -156,6 +212,60 @@ class Observatory:
             _DIGESTS_RX.labels(self._addr, dig.node).inc()
         self._refresh()
         return prev is None or prev[0].round != dig.round or prev[0].stage != dig.stage
+
+    def _fold_overflow(self, dig: HealthDigest) -> None:
+        """Population-overflow path (caller holds the lock): merge the
+        digest's sketches into the fleet aggregate and keep the peer only
+        if it belongs in the bounded worst-straggler candidate table."""
+        self._overflow_seen.add(dig.node)
+        self._overflow_gauge.set(len(self._overflow_seen))
+        for name in dig.sketches:
+            if name == "__distinct__":
+                est = dig.distinct()
+                if est is not None:
+                    if self._overflow_distinct is None:
+                        self._overflow_distinct = est
+                    else:
+                        self._overflow_distinct.merge_in(est)
+                continue
+            sk = dig.sketch(name)
+            if sk is None:
+                continue
+            mine = self._overflow_sketches.get(name)
+            if mine is None:
+                self._overflow_sketches[name] = sk
+            else:
+                mine.merge_in(sk)
+        # Worst-straggler candidates among the untracked mass: keyed by raw
+        # round index (the fleet-max baseline is applied at read time).
+        cap = 4 * _TOP_CANDIDATES
+        if dig.round >= 0:
+            self._overflow_top[dig.node] = (float(dig.round), dig.round)
+            if len(self._overflow_top) > cap:
+                # Drop the LEAST-behind candidate (highest round).
+                drop = max(self._overflow_top, key=lambda p: self._overflow_top[p][0])
+                self._overflow_top.pop(drop, None)
+
+    def _evict_expired(self, now: float) -> None:
+        """Drop peers whose last digest is older than OBS_PEER_TTL — they
+        leave the scoring statistics entirely (STALE_AFTER_S only hides a
+        peer from the live set; eviction frees its memory and its round-
+        entry record, which would otherwise skew lateness baselines
+        forever). Throttled to ~1/s: the sweep is O(peers)."""
+        ttl = float(Settings.OBS_PEER_TTL)
+        if ttl <= 0.0 or now - self._last_evict < 1.0:
+            return
+        self._last_evict = now
+        evicted: List[str] = []
+        with self._lock:
+            for peer, (_, seen) in list(self._peers.items()):
+                if peer != self._addr and now - seen > ttl:
+                    self._peers.pop(peer, None)
+                    self._entries.pop(peer, None)
+                    evicted.append(peer)
+                    self._membership_event("evict", peer)
+        for _ in evicted:
+            self._evicted.inc()
 
     def forget(self, peer: str) -> None:
         """Drop a peer's entry (heartbeat sweep declared it dead)."""
@@ -283,6 +393,77 @@ class Observatory:
             total += float(d.rejected_by_source.get(peer, 0.0))
         return total
 
+    def fleet_quantiles(self) -> Dict[str, Any]:
+        """Fleet-level distribution view, merged from the v2 digests'
+        sketches (live tracked peers + the population overflow aggregate):
+        ``{metric: {p50, p90, p99, count, mean}}`` plus the HyperLogLog
+        ``distinct_contributors`` estimate. Metrics nobody reported are
+        absent; v1 peers simply contribute nothing here."""
+        distinct: Optional[DistinctEstimator] = None
+        now = time.monotonic()
+        with self._lock:
+            live = [
+                d for d, seen in self._peers.values()
+                if now - seen <= STALE_AFTER_S
+            ]
+            merged = {k: v.copy() for k, v in self._overflow_sketches.items()}
+            if self._overflow_distinct is not None:
+                distinct = DistinctEstimator(self._overflow_distinct.m)
+                distinct._registers = bytearray(self._overflow_distinct._registers)
+        for d in live:
+            for name in d.sketches:
+                if name == "__distinct__":
+                    est = d.distinct()
+                    if est is not None:
+                        if distinct is None:
+                            distinct = est
+                        else:
+                            distinct.merge_in(est)
+                    continue
+                sk = d.sketch(name)
+                if sk is None:
+                    continue
+                mine = merged.get(name)
+                if mine is None:
+                    merged[name] = sk
+                else:
+                    mine.merge_in(sk)
+        out: Dict[str, Any] = {}
+        for name, sk in sorted(merged.items()):
+            if sk.count <= 0:
+                continue
+            q = sk.quantiles()
+            out[name] = {
+                "p50": round(q["p50"], 6),
+                "p90": round(q["p90"], 6),
+                "p99": round(q["p99"], 6),
+                "count": sk.count,
+                "mean": round(sk.mean, 6),
+            }
+        if distinct is not None:
+            out["distinct_contributors"] = round(distinct.estimate(), 1)
+        return out
+
+    def estimated_memory_bytes(self) -> int:
+        """Rough per-node observatory footprint: encoded size of every
+        tracked digest plus the overflow aggregate's wire size. The bench
+        plots this against fleet size — it must plateau (tracked peers cap
+        at OBS_MAX_TRACKED, overflow state is O(sketch bins))."""
+        total = 0
+        with self._lock:
+            for d, _ in self._peers.values():
+                try:
+                    total += len(d.encode())
+                except Exception:  # noqa: BLE001
+                    total += 512
+            for sk in self._overflow_sketches.values():
+                total += len(json.dumps(sk.to_wire()))
+            total += 64 * len(self._entries)
+            total += 80 * len(self._overflow_top)
+            if self._overflow_distinct is not None:
+                total += self._overflow_distinct.m
+        return total
+
     def top(self, metric: str) -> Optional[str]:
         """Peer (never self) with the highest nonzero ``metric`` score —
         ``"straggler"`` | ``"suspect"`` | ``"link"``. None when no peer
@@ -298,7 +479,16 @@ class Observatory:
     # --- export --------------------------------------------------------------
 
     def _refresh(self) -> None:
-        """Mirror the derived view into the p2pfl_fed_* registry section."""
+        """Mirror the derived view into the p2pfl_fed_* registry section.
+
+        Rate-limited by ``Settings.OBS_REFRESH_MIN_S``: the derivation is
+        O(live peers), and at population scale a per-beat refresh would make
+        ingest quadratic. 0 (default) refreshes on every ingest."""
+        now = time.monotonic()
+        min_s = float(Settings.OBS_REFRESH_MIN_S)
+        if min_s > 0.0 and now - self._last_refresh < min_s:
+            return
+        self._last_refresh = now
         scores = self.scores()
         for peer, s in scores.items():
             _PEER_ROUND.labels(self._addr, peer).set(s["round"])
@@ -315,9 +505,15 @@ class Observatory:
         scores = self.scores()
         peers: Dict[str, Any] = {}
         for d, _ in live:
+            stale_sk = d.sketch("staleness")
             entry = {
                 "ts": d.ts,
                 "version": d.version,
+                "staleness_p90": (
+                    round(stale_sk.quantile(0.9), 4)
+                    if stale_sk is not None and stale_sk.count > 0
+                    else None
+                ),
                 "round": d.round,
                 "total_rounds": d.total_rounds,
                 "stage": d.stage,
@@ -340,10 +536,26 @@ class Observatory:
             peers[d.node] = entry
         with self._lock:
             membership = list(self._membership)
+            overflow_peers = len(self._overflow_seen)
+            # The most-behind untracked peers (lowest reported round): the
+            # top-straggler question keeps an answer beyond the tracking cap.
+            overflow_worst = [
+                {"peer": p, "round": rnd}
+                for p, (key, rnd) in sorted(
+                    self._overflow_top.items(), key=lambda kv: kv[1][0]
+                )[:_TOP_CANDIDATES]
+            ]
         return {
             "observer": self._addr,
             "written_at": time.time(),
             "peers": peers,
+            "fleet": {
+                "tracked_peers": len(peers),
+                "overflow_peers": overflow_peers,
+                "size": len(peers) + overflow_peers,
+                "overflow_stragglers": overflow_worst,
+                "quantiles": self.fleet_quantiles(),
+            },
             "membership_events": membership,
             "top_straggler": self.top("straggler"),
             "top_suspect": self.top("suspect"),
@@ -352,12 +564,7 @@ class Observatory:
     def write_snapshot(self, path: str) -> str:
         """Atomically write :meth:`snapshot` as JSON to ``path`` (the file
         ``fed_top.py`` polls). Returns the path."""
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(self.snapshot(), f, indent=1, sort_keys=True)
-        os.replace(tmp, path)
-        return path
+        return write_snapshot_doc(path, self.snapshot())
 
     def reset(self) -> None:
         with self._lock:
@@ -365,7 +572,126 @@ class Observatory:
             self._entries.clear()
             self._membership.clear()
             self._ever_seen.clear()
+            self._overflow_sketches.clear()
+            self._overflow_top.clear()
+            self._overflow_seen.clear()
+            self._overflow_distinct = None
         self._peers_known.set(0)
+        self._overflow_gauge.set(0)
 
 
-__all__ = ["Observatory", "STALE_AFTER_S"]
+def write_snapshot_doc(path: str, doc: Dict[str, Any]) -> str:
+    """Atomically write a federation-snapshot document (tmp + rename, the
+    contract ``fed_top.py`` polls against). Shared by the real-wire
+    observatory and the fused-mesh virtual-fleet snapshot."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def population_snapshot(
+    observer: str,
+    node_names: List[str],
+    metrics: Dict[str, Any],
+    top_n: int = _TOP_CANDIDATES,
+    rel_err: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Build a fed_top-renderable snapshot from PER-NODE metric arrays.
+
+    The fused-mesh simulation's observability path: the jitted round
+    program computes per-virtual-node health arrays (round lag, step time,
+    participation, rejections), and this helper folds them into sketches
+    host-side (one vectorized pass per metric, not N Python calls) plus a
+    top-N straggler table — the same document shape
+    ``Observatory.snapshot`` produces, so a 10k-node mesh run renders in
+    the same ``fed_top`` view as an 8-node real-wire federation.
+
+    ``metrics`` maps metric name -> array-like of length ``len(node_names)``.
+    Straggler ordering uses ``round_lag`` (primary) then ``step_time``.
+    """
+    import numpy as np
+
+    if rel_err is None:
+        rel_err = Settings.SKETCH_REL_ERR
+    n = len(node_names)
+    arrays = {
+        k: np.asarray(v, np.float64).ravel() for k, v in metrics.items()
+    }
+    for k, a in arrays.items():
+        if a.shape != (n,):
+            raise ValueError(
+                f"metric {k!r} has shape {a.shape}, expected ({n},)"
+            )
+    quantiles: Dict[str, Any] = {}
+    for k, a in sorted(arrays.items()):
+        sk = QuantileSketch(rel_err=rel_err, max_bins=Settings.SKETCH_MAX_BINS)
+        sk.add_many(a)
+        q = sk.quantiles()
+        quantiles[k] = {
+            "p50": round(q["p50"], 6),
+            "p90": round(q["p90"], 6),
+            "p99": round(q["p99"], 6),
+            "count": sk.count,
+            "mean": round(sk.mean, 6),
+        }
+    lag = arrays.get("round_lag", np.zeros(n))
+    step = arrays.get("step_time", np.zeros(n))
+    # Straggler score mirrors the real observatory's shape: round lag plus
+    # the positive step-time z-score against the fleet distribution.
+    std = float(step.std())
+    z = np.maximum(0.0, (step - float(step.mean())) / std) if std > 1e-12 else np.zeros(n)
+    straggler = lag + z
+    order = np.argsort(-straggler, kind="stable")[: max(1, int(top_n))]
+    peers: Dict[str, Any] = {}
+    for i in order.tolist():
+        peers[node_names[i]] = {
+            "round": int(arrays.get("round", np.zeros(n))[i]) if "round" in arrays else -1,
+            "total_rounds": -1,
+            "stage": "virtual",
+            "mode": "",
+            "staleness": 0.0,
+            "staleness_p90": None,
+            "steps_per_s": (1.0 / step[i]) if step[i] > 0 else 0.0,
+            "tx_bytes": 0.0,
+            "rx_bytes": 0.0,
+            "rejections": {},
+            "rejected_by_source": {},
+            "scores": {
+                "straggler": round(float(straggler[i]), 4),
+                "suspect": round(float(arrays.get("rejections", np.zeros(n))[i]), 4),
+                "link": 0.0,
+                "round": float(arrays.get("round", np.zeros(n))[i]) if "round" in arrays else -1.0,
+                "age_s": 0.0,
+            },
+        }
+    top_idx = int(order[0]) if n else None
+    return {
+        "observer": observer,
+        "written_at": time.time(),
+        "virtual": True,
+        "peers": peers,
+        "fleet": {
+            "tracked_peers": len(peers),
+            "overflow_peers": max(0, n - len(peers)),
+            "size": n,
+            "quantiles": quantiles,
+        },
+        "membership_events": [],
+        "top_straggler": (
+            node_names[top_idx]
+            if top_idx is not None and straggler[top_idx] > 0
+            else None
+        ),
+        "top_suspect": None,
+    }
+
+
+__all__ = [
+    "Observatory",
+    "STALE_AFTER_S",
+    "population_snapshot",
+    "write_snapshot_doc",
+]

@@ -11,12 +11,18 @@ federation_snapshot.json``) as a continuously-refreshing table:
     python scripts/fed_top.py --once                  # one frame, no ANSI
 
 Columns: peer, reported round/total (``w``-prefixed for async windows),
-stage, steps/s, TX/RX MiB, async staleness (mean folded window lag),
+stage, steps/s, TX/RX MiB, async staleness (p90 from the digest's
+staleness sketch when the peer reports v2 digests, else the mean gauge),
 straggler / suspect / link scores (sorted worst-straggler first), digest
 age. The top straggler and top suspect are called out under the table,
-followed by the live membership-churn tail (join/rejoin/leave events from
-the observatory). Stdlib-only — no curses, no dependencies — so it runs
-anywhere the repo does.
+then the FLEET section — population size (tracked + sketch-folded
+overflow peers) and merged fleet quantiles (p50/p90/p99 step time,
+staleness, update norm, agg wait, distinct contributors) — then the live
+membership-churn tail. Snapshots written by the fused-mesh simulation
+(``MeshSimulation.fleet_snapshot``; ``bench.py --fleetobs``) render in
+the same view: the peer table is the top-N stragglers of a 10k-virtual-
+node run, the fleet row is the whole population. Stdlib-only — no curses,
+no dependencies — so it runs anywhere the repo does.
 """
 
 from __future__ import annotations
@@ -53,17 +59,23 @@ def render(snap: Dict[str, Any], color: bool = True) -> str:
     peers = snap.get("peers", {})
     top_straggler = snap.get("top_straggler")
     top_suspect = snap.get("top_suspect")
+    fleet = snap.get("fleet") or {}
+    fleet_size = fleet.get("size", len(peers))
+    title = (
+        f"federation observatory — observer {snap.get('observer', '?')} "
+        f"— {fleet_size} peers"
+    )
+    if snap.get("virtual"):
+        title += f" (virtual fleet; showing top {len(peers)} stragglers)"
+    elif fleet.get("overflow_peers"):
+        title += f" ({len(peers)} tracked + {fleet['overflow_peers']} sketch-folded)"
     header = (
         f"{'PEER':<23} {'ROUND':>7} {'STAGE':<22} {'STEP/S':>8} "
         f"{'TX MiB':>8} {'RX MiB':>8} {'STALE':>6} {'STRAG':>7} {'SUSP':>7} "
         f"{'LINK':>6} {'AGE s':>6}"
     )
     lines = [
-        paint(
-            _BOLD,
-            f"federation observatory — observer {snap.get('observer', '?')} "
-            f"— {len(peers)} peers",
-        ),
+        paint(_BOLD, title),
         paint(_BOLD, header),
     ]
     rows = sorted(
@@ -77,7 +89,11 @@ def render(snap: Dict[str, Any], color: bool = True) -> str:
         round_s = f"{rnd}/{total}" if rnd >= 0 and total >= 0 else ("-" if rnd < 0 else str(rnd))
         if p.get("mode") == "async":  # windows, not barrier rounds
             round_s = f"w{round_s}"
-        stale = p.get("staleness", 0.0)
+        # Sketch-carried staleness p90 beats the mean gauge when present
+        # (v2 digests): p90 is what a late-contribution SLO is written on.
+        stale = p.get("staleness_p90")
+        if stale is None:
+            stale = p.get("staleness", 0.0)
         row = (
             f"{_short(addr):<23} {round_s:>7} {p.get('stage') or '-':<22.22} "
             f"{p.get('steps_per_s', 0.0):>8.1f} {_mib(p.get('tx_bytes', 0.0)):>8} "
@@ -96,6 +112,20 @@ def render(snap: Dict[str, Any], color: bool = True) -> str:
     lines.append(
         f"top straggler: {top_straggler or '-'}    top suspect: {top_suspect or '-'}"
     )
+    quantiles = fleet.get("quantiles") or {}
+    if quantiles:
+        lines.append(paint(_BOLD, f"fleet ({fleet_size} nodes) — merged sketch quantiles:"))
+        for name, q in sorted(quantiles.items()):
+            if name == "distinct_contributors":
+                lines.append(f"  distinct contributors ~{q:.0f}")
+                continue
+            if not isinstance(q, dict):
+                continue
+            lines.append(
+                f"  {name:<14} p50 {q.get('p50', 0.0):>10.4g}  "
+                f"p90 {q.get('p90', 0.0):>10.4g}  p99 {q.get('p99', 0.0):>10.4g}  "
+                f"(n={q.get('count', 0):.0f})"
+            )
     churn = snap.get("membership_events") or []
     if churn:
         tail = churn[-5:]

@@ -1,0 +1,202 @@
+"""CI gate: 3-node async federation proving the sketch-native observability
+plane end to end, fast — invoked by ``make fleetobs-check``.
+
+Three checks in one ~20s run (one 3x-slow peer, one v1-digest peer, 2 async
+windows over the real in-memory wire):
+
+* **staleness sketches propagate on beats** — a fast observer's fleet view
+  holds a peer digest whose staleness sketch decoded (v2 digests riding
+  heartbeats, sketch quantiles readable off the gossip wire);
+* **window attribution flags the slow peer** — the window-level critical
+  path (``CriticalPathAnalyzer.window_report``) names the seeded 3x-slow
+  contributor as the top gating contributor;
+* **v1-digest peers are tolerated** — a node pinned to the v1 digest format
+  (no sketch table) interoperates: its digests still ingest, it still
+  scores, and it finishes every window.
+
+Exit 0 when every check passes; nonzero with a reason on stderr otherwise.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import time  # noqa: E402
+
+WINDOWS = 2
+FIT_FLOOR_S = 1.0
+SLOW_X = 3.0
+BUDGET_S = 90.0
+
+
+def _stretch(node, floor_s):
+    orig = node.learner.fit
+
+    def fit(*a, **kw):
+        t0 = time.monotonic()
+        r = orig(*a, **kw)
+        extra = floor_s - (time.monotonic() - t0)
+        if extra > 0:
+            time.sleep(extra)
+        return r
+
+    node.learner.fit = fit
+
+
+def main() -> int:
+    from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+    from p2pfl_tpu.config import Settings
+    from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.node import Node
+    from p2pfl_tpu.telemetry import REGISTRY, TRACER
+    from p2pfl_tpu.telemetry import digest as digest_mod
+    from p2pfl_tpu.telemetry.critical_path import CriticalPathAnalyzer
+    from p2pfl_tpu.telemetry.sketches import SKETCHES
+    from p2pfl_tpu.utils.utils import set_test_settings, wait_convergence
+
+    set_test_settings()
+    Settings.RESOURCE_MONITOR_PERIOD = 0
+    Settings.LOG_LEVEL = "WARNING"
+    Settings.EXECUTOR_MAX_WORKERS = 0  # inline fits: sleep floors must overlap
+    Settings.ASYNC_BUFFER_K = 2  # fast pair closes windows; slow folds stale
+    Settings.ASYNC_WINDOW_TIMEOUT = 12.0
+    REGISTRY.reset()
+    TRACER.reset()
+    SKETCHES.reset()
+
+    n = 3
+    data = synthetic_mnist(n_train=128 * n, n_test=64)
+    parts = data.generate_partitions(n, RandomIIDPartitionStrategy)
+    # One shared apply_fn + a throwaway-learner prewarm (the async/critical-
+    # path bench pattern): per-node XLA compiles serialized inside window 0
+    # would drown the seeded slowdown the attribution check measures.
+    from p2pfl_tpu.learning.learner import JaxLearner
+
+    template = mlp_model(seed=0)
+    warm = JaxLearner(
+        template.build_copy(), parts[0], self_addr="mem://warmup",
+        batch_size=32, seed=0,
+    )
+    warm.set_epochs(1)
+    warm.fit()
+    warm.evaluate()
+    del warm
+    SKETCHES.reset()  # the warmup learner's step times are not a node's
+    nodes = [
+        Node(
+            template.build_copy(params=mlp_model(seed=i).get_parameters()),
+            parts[i], batch_size=32,
+        )
+        for i in range(n)
+    ]
+    observer, v1_peer, slow = nodes
+    _stretch(observer, FIT_FLOOR_S)
+    _stretch(v1_peer, FIT_FLOOR_S)
+    _stretch(slow, FIT_FLOOR_S * SLOW_X)
+
+    # Pin one peer to the v1 digest format: same vitals, no sketch table —
+    # exactly what an un-upgraded node would gossip.
+    def v1_provider():
+        dig = digest_mod.collect(v1_peer.addr, v1_peer.state)
+        dig.version = 1
+        dig.sketches = {}
+        return dig
+
+    v1_peer.protocol.set_digest_source(v1_provider)
+
+    try:
+        for nd in nodes:
+            nd.start()
+        for i in range(1, n):
+            nodes[i].connect(nodes[0].addr)
+        wait_convergence(nodes, n - 1, wait=15)
+        observer.set_start_learning(rounds=WINDOWS, epochs=1, mode="async")
+        deadline = time.monotonic() + BUDGET_S
+        while time.monotonic() < deadline:
+            if all(
+                not nd.learning_in_progress()
+                and nd.learning_workflow is not None
+                and nd.learning_workflow.history.count("AsyncWindowFinishedStage")
+                >= WINDOWS
+                for nd in nodes
+            ):
+                break
+            time.sleep(0.1)
+        else:
+            print(f"FAIL: federation did not finish in {BUDGET_S}s", file=sys.stderr)
+            return 1
+        # Beats keep flowing after the windows end; give the last digests a
+        # moment to land so the sketch-propagation check reads settled state.
+        time.sleep(3 * Settings.HEARTBEAT_PERIOD)
+
+        snap = observer.observatory.snapshot()
+        peers = snap.get("peers", {})
+
+        # 1. staleness sketch propagated from a PEER's digest on beats.
+        sketch_peers = [
+            addr for addr, p in peers.items()
+            if addr != observer.addr and p.get("staleness_p90") is not None
+        ]
+        if not sketch_peers:
+            print(
+                f"FAIL: no peer digest carried a decodable staleness sketch "
+                f"(peers: {list(peers)})",
+                file=sys.stderr,
+            )
+            return 1
+
+        # 2. window attribution flags the seeded slow contributor.
+        wreport = CriticalPathAnalyzer.from_tracer(TRACER).window_report()
+        if wreport["top_gating_contributor"] != slow.addr:
+            print(
+                f"FAIL: window attribution named "
+                f"{wreport['top_gating_contributor']} as top gating, expected "
+                f"{slow.addr} (counts: {wreport['gating_counts']})",
+                file=sys.stderr,
+            )
+            return 1
+
+        # 3. the v1-digest peer is a full citizen: ingested, scored, done.
+        v1_entry = peers.get(v1_peer.addr)
+        if v1_entry is None or v1_entry.get("version") != 1:
+            print(
+                f"FAIL: v1-digest peer missing/mislabelled in the fleet view: "
+                f"{v1_entry}",
+                file=sys.stderr,
+            )
+            return 1
+        v1_windows = v1_peer.learning_workflow.history.count(
+            "AsyncWindowFinishedStage"
+        )
+        if v1_windows < WINDOWS:
+            print(
+                f"FAIL: v1-digest peer finished {v1_windows}/{WINDOWS} windows",
+                file=sys.stderr,
+            )
+            return 1
+    finally:
+        for nd in nodes:
+            try:
+                nd.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        InMemoryRegistry.reset()
+
+    print(
+        f"fleetobs-check OK: staleness sketch propagated from "
+        f"{len(sketch_peers)} peer(s); slow peer {slow.addr} top-gates "
+        f"{wreport['gating_counts'].get(slow.addr, 0)}/{WINDOWS} windows "
+        f"(close reasons: {wreport['close_reason_counts']}); v1-digest peer "
+        f"tolerated through {v1_windows} windows"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

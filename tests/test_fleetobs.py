@@ -1,0 +1,524 @@
+"""Sketch-native fleet observability (PR 8).
+
+Covers: the quantile sketch's merge algebra (associativity, commutativity,
+idempotent re-merge at the observatory) and relative-error bound against
+exact quantiles on adversarial distributions; the HyperLogLog distinct
+estimator; digest v1<->v2 cross-version round-trips; observatory TTL
+eviction and bounded population-overflow tracking; Prometheus summary/
+quantile exposition (with escaping/NaN regressions extended to the new
+form); window-DAG attribution on synthetic async traces; and the fused-mesh
+population snapshot.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import random
+import time
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.telemetry import REGISTRY
+from p2pfl_tpu.telemetry import digest as digest_mod
+from p2pfl_tpu.telemetry.critical_path import CriticalPathAnalyzer, Seg
+from p2pfl_tpu.telemetry.export import hist_quantile, render_prometheus
+from p2pfl_tpu.telemetry.observatory import Observatory, population_snapshot
+from p2pfl_tpu.telemetry.sketches import (
+    SKETCHES,
+    DistinctEstimator,
+    QuantileSketch,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_sketches():
+    SKETCHES.reset()
+    yield
+    SKETCHES.reset()
+
+
+# --- quantile sketch ----------------------------------------------------------
+
+
+def _adversarial_streams():
+    rng = random.Random(7)
+    return {
+        "constant": [3.14] * 500,
+        "bimodal_extreme": [1e-6] * 300 + [1e6] * 300,
+        "lognormal": [rng.lognormvariate(0.0, 2.0) for _ in range(2000)],
+        "with_zeros_and_negatives": (
+            [0.0] * 50
+            + [-rng.lognormvariate(0.0, 1.0) for _ in range(200)]
+            + [rng.lognormvariate(0.0, 1.0) for _ in range(200)]
+        ),
+        "heavy_duplicates": [float(rng.choice([1, 1, 1, 2, 50])) for _ in range(1000)],
+    }
+
+
+def _exact_quantile(values, q):
+    """Nearest-rank (floor) — the sketch walk's convention."""
+    s = sorted(values)
+    return s[int(q * (len(s) - 1))]
+
+
+def test_sketch_relative_error_bound_on_adversarial_distributions():
+    for name, stream in _adversarial_streams().items():
+        sk = QuantileSketch(rel_err=0.02, max_bins=1024)  # no collapse
+        for v in stream:
+            sk.add(v)
+        for q in (0.1, 0.5, 0.9, 0.99):
+            exact = _exact_quantile(stream, q)
+            est = sk.quantile(q)
+            if abs(exact) < 1e-9:
+                assert abs(est) < 1e-9, (name, q, est)
+            else:
+                rel = abs(est - exact) / abs(exact)
+                assert rel <= sk.rel_err + 1e-9, (name, q, exact, est, rel)
+
+
+def test_sketch_collapse_bounds_bins_and_tracks_degraded_error():
+    sk = QuantileSketch(rel_err=0.02, max_bins=32)
+    rng = random.Random(3)
+    stream = [rng.lognormvariate(0.0, 3.0) for _ in range(5000)]
+    for v in stream:
+        sk.add(v)
+    assert len(sk._bins) <= 32
+    assert sk.rel_err > 0.02  # collapse degraded (and TRACKED) the guarantee
+    for q in (0.5, 0.9, 0.99):
+        exact = _exact_quantile(stream, q)
+        est = sk.quantile(q)
+        assert abs(est - exact) / exact <= sk.rel_err + 1e-9, (q, exact, est)
+
+
+def test_sketch_merge_associative_commutative():
+    rng = random.Random(11)
+    streams = [
+        [rng.lognormvariate(0.0, 1.5) for _ in range(200)] for _ in range(3)
+    ]
+    a, b, c = (QuantileSketch(rel_err=0.02) for _ in range(3))
+    for sk, vals in zip((a, b, c), streams):
+        for v in vals:
+            sk.add(v)
+    left = a.merge(b).merge(c)
+    right = a.merge(b.merge(c))
+    swapped = c.merge(a.merge(b))
+    for q in (0.25, 0.5, 0.9, 0.99):
+        assert left.quantile(q) == right.quantile(q) == swapped.quantile(q)
+    assert left.count == right.count == swapped.count == 600
+    # Merged quantiles keep the bound vs the pooled stream.
+    pooled = streams[0] + streams[1] + streams[2]
+    for q in (0.5, 0.9):
+        exact = _exact_quantile(pooled, q)
+        assert abs(left.quantile(q) - exact) / exact <= left.rel_err + 1e-9
+
+
+def test_sketch_add_many_matches_scalar_adds():
+    vals = np.array([0.0, 0.001, 0.5, -2.0, 7.25, 7.25, 1e4], np.float64)
+    a = QuantileSketch(rel_err=0.02)
+    a.add_many(vals)
+    b = QuantileSketch(rel_err=0.02)
+    for v in vals:
+        b.add(float(v))
+    assert a.count == b.count and a.sum == pytest.approx(b.sum)
+    assert a._bins == b._bins and a._neg == b._neg
+    assert a.zero_count == b.zero_count
+
+
+def test_sketch_wire_roundtrip_and_hostile_payloads():
+    sk = QuantileSketch(rel_err=0.02)
+    rng = random.Random(5)
+    for _ in range(500):
+        sk.add(rng.lognormvariate(0.0, 1.0))
+    wire = sk.to_wire()
+    assert len(json.dumps(wire)) < 2048
+    back = QuantileSketch.from_wire(wire)
+    assert back is not None
+    assert back.count == sk.count
+    for q in (0.5, 0.9, 0.99):
+        assert back.quantile(q) == pytest.approx(sk.quantile(q), rel=back.rel_err + 0.01)
+    # Wire-bin bounding survives the round trip (digest beat budget).
+    small = QuantileSketch.from_wire(sk.to_wire(max_bins=16))
+    assert small is not None and len(small._bins) <= 16
+    # Hostile/garbage payloads decode to None, never raise.
+    for garbage in (
+        None, "x", 42, [], {"v": 99}, {"v": 1, "b": "nope"},
+        {"v": 1, "b": [[0, "NaN"]]},
+        {"v": 1, "c": 1, "b": [[0, 1e9]]},  # fabricated mass > count
+        {"v": 1, "c": float("inf"), "b": []},
+    ):
+        assert QuantileSketch.from_wire(garbage) is None, garbage
+
+
+def test_distinct_estimator_accuracy_merge_idempotence_and_wire():
+    a = DistinctEstimator()
+    for i in range(2000):
+        a.add(f"node-{i}")
+    est = a.estimate()
+    assert abs(est - 2000) / 2000 < 0.25  # HLL m=128: ~9% typical
+    # Idempotent re-merge: gossip redelivery must not inflate the count.
+    assert a.merge(a).estimate() == est
+    b = DistinctEstimator()
+    for i in range(1500, 2500):
+        b.add(f"node-{i}")
+    merged = a.merge(b)
+    assert merged.estimate() >= est  # union can only grow
+    assert a.merge(b).estimate() == b.merge(a).estimate()
+    back = DistinctEstimator.from_wire(a.to_wire())
+    assert back is not None and back.estimate() == est
+    for garbage in (None, 7, "!!!notb64!!!", "QUJD", ""):  # wrong size/format
+        assert DistinctEstimator.from_wire(garbage) is None, garbage
+
+
+# --- digest v1 <-> v2 ---------------------------------------------------------
+
+
+def _v2_digest(node="mem://peer", lags=(0, 0, 1, 2)):
+    sk = QuantileSketch(rel_err=0.02)
+    for lag in lags:
+        sk.add(float(lag))
+    est = DistinctEstimator()
+    est.add("a")
+    est.add("b")
+    return digest_mod.HealthDigest(
+        node=node, ts=time.time(), round=3, stage="AsyncWindowStage",
+        mode="async", steps_per_s=25.0,
+        sketches={"staleness": sk.to_wire(), "__distinct__": est.to_wire()},
+    )
+
+
+def test_digest_v2_roundtrip_carries_sketches():
+    dig = _v2_digest()
+    back = digest_mod.decode(dig.encode())
+    assert back is not None and back.version == digest_mod.DIGEST_VERSION
+    sk = back.sketch("staleness")
+    assert sk is not None and sk.count == 4
+    # Nearest-rank (floor) p90 of (0, 0, 1, 2) is 1.
+    assert sk.quantile(0.9) == pytest.approx(1.0, rel=0.05)
+    est = back.distinct()
+    assert est is not None and est.estimate() == pytest.approx(2.0, abs=0.5)
+    # v1 scalar fields survive alongside.
+    assert back.round == 3 and back.mode == "async" and back.steps_per_s == 25.0
+
+
+def test_digest_v1_payload_decodes_with_empty_sketches():
+    # A v1 sender's payload: no "sk" key at all.
+    v1 = digest_mod.HealthDigest(node="mem://old", ts=1.0, round=2)
+    v1.version = 1
+    v1.sketches = {}
+    payload = v1.encode()
+    assert '"sk"' not in payload
+    back = digest_mod.decode(payload)
+    assert back is not None and back.version == 1
+    assert back.sketches == {}
+    assert back.sketch("staleness") is None and back.distinct() is None
+    assert back.round == 2
+
+
+def test_digest_v2_readable_by_v1_field_set():
+    """An old decoder keeps every recognized field and ignores the rest —
+    simulate by checking the v2 payload is a strict superset of the v1
+    field set (the contract the old decode loop relies on)."""
+    raw = json.loads(_v2_digest().encode())
+    v1_fields = {
+        "node", "ts", "round", "total_rounds", "stage", "mode", "staleness",
+        "steps_per_s", "jit_compile_s", "tx_bytes", "rx_bytes", "queue_depth",
+        "agg_waits", "agg_wait_s", "contributors", "rejections",
+        "rejected_by_source", "faults_seen", "mem_bytes", "v",
+    }
+    assert v1_fields <= set(raw)
+    # Malformed sketch table degrades to absent, not to a dead digest.
+    raw["sk"] = {"staleness": "not-a-dict", "__distinct__": 42}
+    back = digest_mod.decode(json.dumps(raw))
+    assert back is not None and back.sketch("staleness") is None
+
+
+def test_digest_collect_includes_observed_sketches():
+    REGISTRY.reset()
+    SKETCHES.observe("step_time", "mem://me", 0.02)
+    SKETCHES.observe("staleness", "mem://me", 1.0)
+    SKETCHES.distinct_add("mem://me", "mem://peer")
+    dig = digest_mod.collect("mem://me")
+    assert dig.version == 2
+    assert dig.sketch("step_time") is not None
+    assert dig.sketch("staleness").count == 1
+    assert dig.distinct() is not None
+    assert len(dig.encode()) <= digest_mod.MAX_DIGEST_BYTES
+
+
+# --- observatory: idempotent re-merge, TTL eviction, overflow ----------------
+
+
+def test_observatory_remerge_is_idempotent():
+    obs = Observatory("mem://obs")
+    dig = _v2_digest(node="mem://peer")
+    obs.ingest(dig)
+    once = obs.fleet_quantiles()
+    obs.ingest(dig)  # gossip redelivery: latest-per-peer, not accumulation
+    twice = obs.fleet_quantiles()
+    assert once["staleness"]["count"] == twice["staleness"]["count"] == 4
+    assert once == twice
+
+
+def test_observatory_ttl_eviction_drops_dead_peer_from_scoring():
+    evicted = REGISTRY.get("p2pfl_fed_evicted_total")
+    with Settings.overridden(OBS_PEER_TTL=5.0):
+        obs = Observatory("mem://obs-ttl")
+        before = sum(
+            c.value for lbl, c in evicted.samples()
+            if lbl.get("node") == "mem://obs-ttl"
+        )
+        dead = _v2_digest(node="mem://dead")
+        obs.ingest(dead)
+        assert "mem://dead" in obs.scores()
+        # Age the dead peer's arrival past the TTL, then any ingest sweeps.
+        with obs._lock:
+            d, seen = obs._peers["mem://dead"]
+            obs._peers["mem://dead"] = (d, seen - 10.0)
+        obs._last_evict = 0.0
+        obs.ingest(_v2_digest(node="mem://alive"))
+        assert "mem://dead" not in obs.scores()
+        assert "mem://alive" in obs.scores()
+        after = sum(
+            c.value for lbl, c in evicted.samples()
+            if lbl.get("node") == "mem://obs-ttl"
+        )
+        assert after == before + 1
+        events = [e["event"] for e in obs.snapshot()["membership_events"]]
+        assert "evict" in events
+
+
+def test_observatory_overflow_stays_bounded_and_folds_fleet_sketches():
+    with Settings.overridden(OBS_MAX_TRACKED=8):
+        obs = Observatory("mem://obs-big")
+        for i in range(40):
+            obs.ingest(_v2_digest(node=f"mem://p{i:03d}", lags=(1,)))
+        assert len(obs.scores()) <= 8
+        snap = obs.snapshot()
+        assert snap["fleet"]["overflow_peers"] == 40 - 8
+        assert snap["fleet"]["size"] == 40
+        # Every peer's staleness fold is in the merged fleet view, tracked
+        # or not — the quantile plane is population-complete.
+        assert obs.fleet_quantiles()["staleness"]["count"] == 40
+        # Memory plateaus: ingesting more overflow peers barely moves it.
+        m1 = obs.estimated_memory_bytes()
+        for i in range(40, 80):
+            obs.ingest(_v2_digest(node=f"mem://p{i:03d}", lags=(1,)))
+        m2 = obs.estimated_memory_bytes()
+        assert m2 < m1 * 1.5
+
+
+def test_observatory_snapshot_surfaces_staleness_p90():
+    obs = Observatory("mem://obs-stale")
+    obs.ingest(_v2_digest(node="mem://peer", lags=(0, 0, 0, 0, 0, 0, 0, 0, 3, 3)))
+    entry = obs.snapshot()["peers"]["mem://peer"]
+    assert entry["staleness_p90"] == pytest.approx(3.0, rel=0.05)
+    v1 = digest_mod.HealthDigest(node="mem://old", ts=time.time(), round=1)
+    v1.version = 1
+    obs.ingest(v1)
+    assert obs.snapshot()["peers"]["mem://old"]["staleness_p90"] is None
+
+
+# --- Prometheus summary/quantile exposition ----------------------------------
+
+
+def test_prometheus_histogram_quantile_family():
+    REGISTRY.reset()
+    h = REGISTRY.histogram(
+        "t_fleetobs_demo_seconds", "demo", labels=("node",)
+    )
+    for v in (0.01, 0.02, 0.3, 1.2, 4.0):
+        h.labels("n1").observe(v)
+    text = render_prometheus()
+    assert "# TYPE t_fleetobs_demo_seconds_quantile gauge" in text
+    for q in ("0.5", "0.9", "0.99"):
+        assert f't_fleetobs_demo_seconds_quantile{{node="n1",quantile="{q}"}}' in text
+    # hist_quantile interpolates inside the covering bucket.
+    assert hist_quantile((1.0, 2.0, 4.0), (0, 2, 2), 0.5) == pytest.approx(2.0)
+    assert math.isnan(hist_quantile((1.0,), (0,), 0.5))
+
+
+def test_prometheus_sketch_quantiles_with_escaping_and_nan_regression():
+    REGISTRY.reset()
+    evil = 'no"de\\with\nnasties'
+    SKETCHES.observe("step_time", evil, 0.5)
+    text = render_prometheus()
+    assert "# TYPE p2pfl_sketch_step_time gauge" in text
+    # The node label is escaped exactly like every other label value.
+    assert 'node="no\\"de\\\\with\\nnasties"' in text
+    assert 'quantile="0.5"' in text
+    # Empty-histogram series emit NO quantile lines (no NaN noise): an
+    # empty histogram family renders buckets but no _quantile family.
+    REGISTRY.reset()
+    SKETCHES.reset()
+    REGISTRY.histogram("t_fleetobs_empty_seconds", "empty", labels=("node",)).labels("a")
+    text = render_prometheus()
+    assert "t_fleetobs_empty_seconds_bucket" in text
+    assert "t_fleetobs_empty_seconds_quantile" not in text
+    assert "NaN" not in text.split("t_fleetobs_empty_seconds")[-1][:200]
+
+
+# --- window-DAG attribution on synthetic async traces ------------------------
+
+
+def _win_seg(name, node, start, end, rnd, span_id="", parent_id="", **extra):
+    return Seg(
+        name=name, node=node, start_s=start, end_s=end, span_id=span_id,
+        parent_id=parent_id, trace_id="t", round=rnd, extra=extra,
+    )
+
+
+def _synthetic_async_trace(windows=3, slow="slow", fast="fast", slow_fit=3.0):
+    """Two contributors; ``slow``'s fit is slow_fit per window. The fast
+    node closes each window when the slow contribution arrives (the recv's
+    parent crosses the wire to the slow sender's diffuse span)."""
+    segs = []
+    t_fast = 0.0
+    t_slow = 0.0
+    for w in range(windows):
+        # Fast node: quick fit, diffuse, then a long wait for the slow frame.
+        segs.append(_win_seg("fit", fast, t_fast, t_fast + 0.5, w))
+        segs.append(
+            _win_seg("diffuse:async_model", fast, t_fast + 0.5, t_fast + 0.6, w)
+        )
+        # Slow node: long fit, then diffuse (the frame that closes the wait).
+        segs.append(
+            _win_seg("fit", slow, t_slow, t_slow + slow_fit, w, span_id=f"sf{w}")
+        )
+        segs.append(
+            _win_seg(
+                "diffuse:async_model", slow, t_slow + slow_fit,
+                t_slow + slow_fit + 0.1, w, span_id=f"sd{w}",
+            )
+        )
+        arrive = t_slow + slow_fit + 0.05
+        segs.append(
+            _win_seg(
+                "recv:async_model", fast, arrive, arrive + 0.02, w,
+                span_id=f"r{w}", parent_id=f"sd{w}",
+            )
+        )
+        segs.append(
+            _win_seg("async_window_wait", fast, t_fast + 0.6, arrive + 0.05, w)
+        )
+        segs.append(
+            _win_seg(
+                "window_close", fast, arrive + 0.05, arrive + 0.05, w,
+                reason="fill" if w < windows - 1 else "timeout",
+                mean_lag=1.0, fill=2,
+            )
+        )
+        t_fast = arrive + 0.1
+        t_slow += slow_fit + 0.2
+    return segs
+
+
+def test_window_report_attributes_slow_contributor_and_reasons():
+    an = CriticalPathAnalyzer(_synthetic_async_trace(windows=3), slack_s=0.5)
+    assert an.has_windows()
+    rep = an.window_report(staleness_alpha=0.5)
+    assert rep["top_gating_contributor"] == "slow"
+    assert rep["gating_counts"]["slow"] == 3
+    assert rep["top_gating_fraction"] == 1.0
+    assert rep["close_reason_counts"] == {"fill": 2, "timeout": 1}
+    for w in ("0", "1", "2"):
+        win = rep["windows"][w]
+        assert win["gating_contributor"] == "slow"
+        assert win["fill"] == 2
+        assert win["mean_lag"] == 1.0
+        # discount = 1 - (1+1)^-0.5
+        assert win["staleness_discount"] == pytest.approx(
+            1.0 - 2.0 ** -0.5, abs=1e-3
+        )
+    assert rep["wait_wall_s_total"] > 0
+    # The full report nests the window view for async traces.
+    assert "window_report" in an.report()
+
+
+def test_window_report_absent_for_sync_traces():
+    segs = [
+        _win_seg("fit", "a", 0.0, 1.0, 0),
+        _win_seg("diffuse:partial_model", "a", 1.0, 1.5, 0),
+    ]
+    an = CriticalPathAnalyzer(segs)
+    assert not an.has_windows()
+    assert "window_report" not in an.report()
+
+
+# --- population snapshot (fused-mesh path) -----------------------------------
+
+
+def test_population_snapshot_top_n_and_quantiles():
+    n = 200
+    rng = np.random.default_rng(0)
+    lag = np.zeros(n)
+    step = np.full(n, 0.01) + rng.normal(0, 1e-4, n)
+    seeded = [7, 50, 199]
+    lag[seeded] = 3.0
+    step[seeded] = 0.05
+    snap = population_snapshot(
+        "mesh-sim",
+        [f"vnode/{i:05d}" for i in range(n)],
+        {"round_lag": lag, "step_time": step, "round": np.full(n, 5.0)},
+        top_n=5,
+    )
+    top = list(snap["peers"])
+    assert {f"vnode/{i:05d}" for i in seeded} <= set(top)
+    assert snap["top_straggler"] in {f"vnode/{i:05d}" for i in seeded}
+    assert snap["virtual"] is True
+    assert snap["fleet"]["size"] == n and snap["fleet"]["overflow_peers"] == n - 5
+    q = snap["fleet"]["quantiles"]["round_lag"]
+    assert q["count"] == n and q["p99"] == pytest.approx(3.0, rel=0.1)
+    with pytest.raises(ValueError):
+        population_snapshot("x", ["a", "b"], {"round_lag": np.zeros(3)})
+
+
+def test_mesh_simulation_validates_node_speed_shape():
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.parallel.simulation import MeshSimulation
+
+    n = 8
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 8, 4)).astype(np.float32)
+    y = rng.integers(0, 2, size=(n, 8)).astype(np.int32)
+    m = np.ones((n, 8), np.float32)
+    model = mlp_model(input_shape=(4,), hidden_sizes=(4,), out_channels=2)
+    with pytest.raises(ValueError, match="node_speed"):
+        MeshSimulation(
+            model, (x, y, m), test_data=(x[0], y[0]), batch_size=4,
+            node_speed=np.ones(5, np.float32),
+        )
+    with pytest.raises(ValueError, match="> 0"):
+        MeshSimulation(
+            model, (x, y, m), test_data=(x[0], y[0]), batch_size=4,
+            node_speed=np.zeros(n, np.float32),
+        )
+
+
+@pytest.mark.slow
+def test_mesh_fleet_snapshot_flags_seeded_stragglers(tmp_path):
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.parallel.simulation import MeshSimulation
+
+    n = 16
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(n, 8, 4)).astype(np.float32)
+    y = rng.integers(0, 2, size=(n, 8)).astype(np.int32)
+    m = np.ones((n, 8), np.float32)
+    speed = np.ones(n, np.float32)
+    speed[[2, 9]] = 5.0
+    model = mlp_model(input_shape=(4,), hidden_sizes=(4,), out_channels=2)
+    sim = MeshSimulation(
+        model, (x, y, m), test_data=(x[0], y[0]), train_set_size=4,
+        batch_size=4, node_speed=speed, seed=0,
+    )
+    res = sim.run(rounds=2, warmup=False)
+    path = str(tmp_path / "snap.json")
+    snap = sim.fleet_snapshot(res, top_n=4, path=path)
+    sim.close()
+    assert {"vnode/00002", "vnode/00009"} <= set(snap["peers"])
+    assert snap["top_straggler"] in ("vnode/00002", "vnode/00009")
+    with open(path) as f:
+        assert json.load(f)["fleet"]["size"] == n

@@ -322,7 +322,7 @@ class FullModelCommand(Command):
                 "apply:full_model", node.addr, wire_ctx, source=source, round=round
             ):
                 node.learner.get_model().apply_frame(arrays, meta)
-                state.last_full_model_round = max(state.last_full_model_round, round)
+                state.note_full_model_round(round)
                 # Rejoin/round-anchor resync: adopting a DENSE full model for
                 # round r means we now hold the exact model every in-phase
                 # node will anchor round r+1 against — so a crashed-and-
@@ -550,7 +550,7 @@ class AsyncCatchupCommand(Command):
         try:
             node.learner.get_model().apply_frame(arrays, meta)
             state.wire.resync(node.learner.get_model().get_parameters(), int(round))
-            state.last_full_model_round = max(state.last_full_model_round, int(round))
+            state.note_full_model_round(int(round))
             state.model_initialized_event.set()
             node.protocol.flight_recorder.record(
                 "membership", event="catchup", peer=source, window=int(round)

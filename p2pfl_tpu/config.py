@@ -275,6 +275,12 @@ class Settings:
     COMPUTE_DTYPE: str = _env_override("COMPUTE_DTYPE", "bfloat16")
     # Disable device-mesh simulation (mirror of the reference's DISABLE_RAY).
     DISABLE_MESH: bool = _env_override("DISABLE_MESH", False)
+    # Disable the native (C++) PFLT wire codec and use the byte-identical
+    # pure-Python fallback. Previously a raw os.environ read inside
+    # native/__init__.py (P2PFL_TPU_NO_NATIVE=1 exactly); routed through the
+    # validated env layer so every accepted bool spelling works and the C5
+    # drift checker (make analyze) holds all config at this choke point.
+    NO_NATIVE: bool = _env_override("NO_NATIVE", False)
 
     @classmethod
     def snapshot(cls) -> dict[str, Any]:

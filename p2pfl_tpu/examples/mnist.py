@@ -82,7 +82,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--wire-compression",
-        choices=["none", "bf16", "int8"],
+        # Mirror config.py's validated choice set exactly ("topk" was
+        # missing here — config/CLI drift of the kind C5 polices): the flag
+        # writes Settings.WIRE_COMPRESSION, so the two sets must agree.
+        choices=["none", "bf16", "int8", "topk"],
         default=None,
         help="codec for gossiped weight frames (nodes mode; mesh mode "
         "never puts weights on a wire). Unset: the "

@@ -7,9 +7,9 @@ the host runtime hot spots natively. Currently: the PFLT wire-codec
 
 The library is compiled on first use with the in-image ``g++`` (pybind11
 isn't available, so the ABI is a C ``extern`` surface via ctypes). If
-compilation fails — or ``P2PFL_TPU_NO_NATIVE=1`` — callers transparently
-fall back to the pure-Python implementations, which produce byte-identical
-output.
+compilation fails — or ``Settings.NO_NATIVE`` (env ``P2PFL_TPU_NO_NATIVE``,
+validated in config.py) — callers transparently fall back to the
+pure-Python implementations, which produce byte-identical output.
 """
 
 from __future__ import annotations
@@ -80,7 +80,9 @@ def get_lib(rebuild: bool = False) -> Optional[ctypes.CDLL]:
     """The loaded native library, building it on first call; None if
     unavailable (disabled, no compiler, or build failure)."""
     global _lib, _tried
-    if os.environ.get("P2PFL_TPU_NO_NATIVE") == "1":
+    from p2pfl_tpu.config import Settings
+
+    if Settings.NO_NATIVE:
         return None
     with _lock:
         if rebuild:

@@ -90,6 +90,13 @@ class NodeState:
         # Synchronization.
         self.train_set_votes_lock = threading.Lock()
         self.start_thread_lock = threading.Lock()
+        # Guards the last_full_model_round monotonic update: the stage
+        # machine (workflow thread) and the full_model / async_catchup
+        # handlers (transport threads) all advance it with a read-modify-
+        # write max(); unguarded, two concurrent writers can regress the
+        # high-water mark and reopen the first-wins adoption window that
+        # PR 4 closed. Found by the C3 static checker (make analyze).
+        self.full_model_round_lock = threading.Lock()
         # Set when all expected votes have (possibly) arrived — consumers
         # re-check the vote table and clear it again while polling.
         self.votes_ready_event = threading.Event()
@@ -103,6 +110,19 @@ class NodeState:
         # WaitAggregatedModelsStage skip its wait if the model raced ahead of
         # the stage transition (clear-then-wait race).
         self.last_full_model_round = -1
+
+    def note_full_model_round(self, round: int) -> None:
+        """Advance the highest round whose full aggregated model we hold.
+
+        Monotonic and locked: callers race from the workflow thread
+        (TrainStage / AsyncWindowStage marking their own aggregate) and from
+        transport threads (full_model / async_catchup adoption), and an
+        interleaved ``max()`` read-modify-write could regress the mark —
+        letting a later (possibly Byzantine) full-model frame re-win a round
+        that first-wins already closed."""
+        with self.full_model_round_lock:
+            if round > self.last_full_model_round:
+                self.last_full_model_round = round
 
     # --- round bookkeeping (proxied off Experiment; reference :84-97) -------
 

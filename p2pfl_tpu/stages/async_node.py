@@ -224,7 +224,7 @@ class AsyncWindowStage(Stage):
         model.additional_info.update(aggregated.additional_info)
         # A later full-model frame for this window is redundant (first wins,
         # same contract as the sync TrainStage).
-        state.last_full_model_round = max(state.last_full_model_round, w)
+        state.note_full_model_round(w)
         _WINDOW_SECONDS.labels(node.addr).observe(time.perf_counter() - t0)
         return AsyncWindowFinishedStage
 

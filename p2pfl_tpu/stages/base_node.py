@@ -262,9 +262,7 @@ class TrainStage(Stage):
         # aggregate (first wins — FullModelCommand honors this; it also
         # closes the window where a Byzantine peer's corrupted full model
         # could clobber an honest aggregate post-aggregation).
-        state.last_full_model_round = max(
-            state.last_full_model_round, state.round or 0
-        )
+        state.note_full_model_round(state.round or 0)
         state.aggregated_model_event.set()
         node.protocol.broadcast(
             node.protocol.build_msg(ModelsReadyCommand.get_name(), round=state.round or 0)

@@ -1,0 +1,10 @@
+"""Seeded-defect fixtures for the static analysis suite.
+
+Each module plants ONE class of bug the checkers exist to catch; the
+analyzer regression tests (tests/test_analysis.py) run the checkers over
+this directory and assert every seed is flagged by the intended checker —
+so a refactor of the AST machinery that quietly blinds a checker fails CI.
+
+These modules are parsed, never imported (the analysis is pure-AST); keep
+them import-free of heavy deps anyway so an accidental import stays cheap.
+"""

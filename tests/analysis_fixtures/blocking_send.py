@@ -1,0 +1,30 @@
+"""SEEDED DEFECT (C2): blocking operations while a lock is held.
+
+``announce`` performs a transport send and a ``time.sleep`` inside the
+table lock; every other thread touching the table stalls behind the
+network. ``reap`` joins a worker thread under the same lock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class PeerTable:
+    def __init__(self, protocol) -> None:
+        self._table_lock = threading.Lock()
+        self._peers: dict = {}
+        self._worker_thread = None
+        self.protocol = protocol
+
+    def announce(self, env) -> None:
+        with self._table_lock:
+            for peer in self._peers:
+                self.protocol.send(peer, env)  # network I/O under the lock
+            time.sleep(0.05)  # pacing sleep under the lock
+
+    def reap(self) -> None:
+        with self._table_lock:
+            if self._worker_thread is not None:
+                self._worker_thread.join(timeout=1.0)  # join under the lock

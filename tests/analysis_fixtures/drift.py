@@ -1,0 +1,28 @@
+"""SEEDED DEFECT (C5): config / wire drift — a raw P2PFL_TPU_* env read
+bypassing config.py, an emitted metric documented nowhere, and a command
+sent that no Command class defines (so both transports drop it)."""
+
+from __future__ import annotations
+
+import os
+
+from p2pfl_tpu.telemetry import REGISTRY
+
+# bypasses the validated fail-fast env layer in config.py
+_TURBO = os.environ.get("P2PFL_TPU_FIXTURE_TURBO", "0") == "1"
+
+# appears in neither docs/ nor tests/ (the fixtures dir is excluded from
+# the reference corpus precisely so this stays undocumented)
+_GHOST = REGISTRY.counter(
+    "p2pfl_fixture_ghost_total", "seeded undocumented metric", labels=("node",)
+)
+
+
+class GhostAnnouncer:
+    def __init__(self, protocol) -> None:
+        self.protocol = protocol
+
+    def announce(self) -> None:
+        # no Command subclass anywhere defines "ghost_announce": receivers
+        # on either transport drop it as unknown
+        self.protocol.broadcast(self.protocol.build_msg("ghost_announce"))

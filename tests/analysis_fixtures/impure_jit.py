@@ -1,0 +1,30 @@
+"""SEEDED DEFECT (C4): side effects inside jit-compiled functions — they
+run once at trace time, then silently freeze: the metric stops counting,
+the timestamp is baked into the compiled program as a constant."""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2pfl_tpu.telemetry import REGISTRY
+
+_STEPS = REGISTRY.counter("p2pfl_fixture_steps_total", "seeded", labels=("node",))
+
+
+@jax.jit
+def noisy_step(params, grads):
+    _STEPS.labels("fixture").inc()  # traced once, never counts again
+    lr = 0.1 + 0.01 * np.random.random()  # baked in at trace time
+    return jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+
+
+def _scaled_loss_impl(x):
+    started = time.time()  # trace-time constant, not a clock
+    return jnp.sum(x * x) + (started - started)
+
+
+scaled_loss = jax.jit(_scaled_loss_impl)

@@ -1,0 +1,42 @@
+"""SEEDED DEFECT (C1): a lock-order inversion across two code paths.
+
+``transfer_ab`` nests B inside A; ``transfer_ba`` nests A inside B. Two
+threads running one each can deadlock — the acquisition-order graph has the
+cycle A -> B -> A. Also seeds a guaranteed self-deadlock: re-entering a
+non-reentrant ``threading.Lock`` through a same-class call.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class Ledger:
+    def __init__(self) -> None:
+        self._alpha_lock = threading.Lock()
+        self._beta_lock = threading.Lock()
+        self._guard = threading.Lock()
+        self.alpha = 0
+        self.beta = 0
+
+    def transfer_ab(self, amount: int) -> None:
+        with self._alpha_lock:
+            with self._beta_lock:  # order: alpha -> beta
+                self.alpha -= amount
+                self.beta += amount
+
+    def transfer_ba(self, amount: int) -> None:
+        with self._beta_lock:
+            with self._alpha_lock:  # order: beta -> alpha — INVERSION
+                self.beta -= amount
+                self.alpha += amount
+
+    def _audit(self) -> int:
+        with self._guard:
+            return self.alpha + self.beta
+
+    def audited_total(self) -> int:
+        with self._guard:
+            # same-class call that re-acquires the non-reentrant lock we
+            # already hold: guaranteed deadlock, not just a potential one
+            return self._audit()

@@ -1,0 +1,27 @@
+"""SEEDED DEFECT (C3): shared attributes written from a daemon-thread entry
+point with no guarding lock (and no ``# unguarded-ok:`` annotation), racing
+the main-thread writer of the same attributes."""
+
+from __future__ import annotations
+
+import threading
+
+
+class ProgressBoard:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.rounds_done = 0
+        self.best_score = 0.0
+
+    def start(self) -> None:
+        threading.Thread(target=self._poll, daemon=True).start()
+
+    def _poll(self) -> None:
+        # daemon-thread entry point: read-modify-write with no lock
+        self.rounds_done = self.rounds_done + 1
+        self.best_score = max(self.best_score, 1.0)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.rounds_done = 0
+            self.best_score = 0.0

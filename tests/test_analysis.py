@@ -1,0 +1,321 @@
+"""Correctness analysis plane: checker regressions over seeded-defect
+fixtures, clean-tree + baseline contract, and the runtime lock sentinel.
+
+The fixture assertions are the analyzer's own regression suite: every
+seeded bug in tests/analysis_fixtures/ must be flagged by the INTENDED
+checker, so a refactor of the AST machinery that blinds a checker fails
+here, not in a postmortem."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+from p2pfl_tpu.analysis import Baseline, compare, run_checkers
+from p2pfl_tpu.analysis.baseline import Suppression
+from p2pfl_tpu.analysis.runtime import LockOrderSentinel
+
+REPO = Path(__file__).resolve().parent.parent
+TESTS = Path(__file__).resolve().parent
+
+
+@pytest.fixture(scope="module")
+def fixture_findings():
+    return run_checkers(TESTS, ("analysis_fixtures",))
+
+
+def _keys(findings, checker):
+    return [f.key for f in findings if f.checker == checker]
+
+
+# --- seeded-defect regression coverage --------------------------------------
+
+
+def test_c1_flags_seeded_lock_inversion(fixture_findings):
+    keys = _keys(fixture_findings, "C1")
+    cycles = [k for k in keys if k.startswith("C1:cycle:")]
+    assert any("Ledger._alpha_lock" in k and "Ledger._beta_lock" in k for k in cycles), keys
+    assert any("self-deadlock" in k and "Ledger._guard" in k for k in keys), keys
+
+
+def test_c2_flags_seeded_blocking_send(fixture_findings):
+    keys = _keys(fixture_findings, "C2")
+    assert any("PeerTable.announce" in k and "send" in k for k in keys), keys
+    assert any("time.sleep" in k for k in keys), keys
+    assert any("PeerTable.reap" in k and "join" in k for k in keys), keys
+
+
+def test_c3_flags_seeded_unguarded_writes(fixture_findings):
+    keys = _keys(fixture_findings, "C3")
+    assert any("ProgressBoard._poll" in k and "rounds_done" in k for k in keys), keys
+    assert any("best_score" in k for k in keys), keys
+
+
+def test_c4_flags_seeded_impure_jit(fixture_findings):
+    keys = _keys(fixture_findings, "C4")
+    assert any("noisy_step" in k and "inc" in k for k in keys), keys
+    assert any("np.random" in k for k in keys), keys
+    # fn jitted via call site (jax.jit(_scaled_loss_impl)), not decorator
+    assert any("_scaled_loss_impl" in k and "time.time" in k for k in keys), keys
+
+
+def test_c5_flags_seeded_drift(fixture_findings):
+    keys = _keys(fixture_findings, "C5")
+    assert any(k.startswith("C5:env:") and "FIXTURE_TURBO" in k for k in keys), keys
+    assert "C5:metric:p2pfl_fixture_ghost_total" in keys, keys
+    assert "C5:cmd-unhandled:ghost_announce" in keys, keys
+
+
+def test_intended_checker_only(fixture_findings):
+    """Each fixture is flagged by the checker it seeds — C1 findings come
+    from the inversion module, C2 from the blocking module, etc. (no
+    cross-talk that would make the regression suite ambiguous)."""
+    by = {
+        "C1": "lock_inversion.py",
+        "C2": "blocking_send.py",
+        "C3": "unguarded_write.py",
+        "C4": "impure_jit.py",
+    }
+    for checker, path in by.items():
+        hits = [f for f in fixture_findings if f.checker == checker]
+        assert hits and all(f.path.endswith(path) for f in hits), (checker, hits)
+
+
+# --- the tree itself stays clean --------------------------------------------
+
+
+def test_package_tree_clean_against_baseline():
+    """`make analyze` as a test: the p2pfl_tpu tree must produce no finding
+    outside the committed baseline, and no baseline entry may be stale."""
+    findings = run_checkers(REPO, ("p2pfl_tpu",))
+    baseline = Baseline.load(REPO / "analysis_baseline.json")
+    new, _suppressed, stale = compare(findings, baseline)
+    assert not new, "new findings:\n" + "\n".join(f.render() for f in new)
+    assert not stale, f"stale suppressions: {[s.key for s in stale]}"
+
+
+def test_baseline_small_and_reasoned():
+    doc = json.loads((REPO / "analysis_baseline.json").read_text())
+    sups = doc["suppressions"]
+    assert len(sups) <= 10, "baseline growing — fix findings, don't suppress"
+    assert all(s.get("reason", "").strip() for s in sups)
+
+
+# --- baseline + exit-code contract ------------------------------------------
+
+
+def test_baseline_rejects_reasonless_entries(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text(
+        json.dumps(
+            {"version": 1, "suppressions": [{"checker": "C1", "key": "x", "reason": ""}]}
+        )
+    )
+    with pytest.raises(ValueError, match="reason"):
+        Baseline.load(p)
+
+
+def test_compare_partitions_new_suppressed_stale(fixture_findings):
+    some = fixture_findings[0]
+    baseline = Baseline(
+        [
+            Suppression(some.checker, some.key, "seeded fixture"),
+            Suppression("C1", "C1:cycle:never-matches", "stale on purpose"),
+        ]
+    )
+    new, suppressed, stale = compare(fixture_findings, baseline)
+    assert [f.key for f in suppressed] == [some.key]
+    assert len(new) == len(fixture_findings) - 1
+    assert [s.key for s in stale] == ["C1:cycle:never-matches"]
+
+
+def _analyze(*args):
+    return subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "analyze.py"), *args],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+
+
+def test_cli_exit_codes(tmp_path):
+    # 0: clean tree against the committed baseline
+    r = _analyze("--baseline", str(REPO / "analysis_baseline.json"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    # 1: seeded fixtures with no baseline
+    r = _analyze("--root", str(TESTS), "--subdirs", "analysis_fixtures")
+    assert r.returncode == 1, r.stdout + r.stderr
+    # 2: stale suppression over the clean tree
+    stale = tmp_path / "stale.json"
+    stale.write_text(
+        json.dumps(
+            {
+                "version": 1,
+                "suppressions": [
+                    {"checker": "C1", "key": "C1:cycle:ghost", "reason": "stale"}
+                ],
+            }
+        )
+    )
+    r = _analyze("--baseline", str(stale))
+    assert r.returncode == 2, r.stdout + r.stderr
+
+
+def test_finding_keys_are_line_number_free(fixture_findings):
+    """Suppression keys must survive refactors that move code: no line
+    numbers baked in."""
+    for f in fixture_findings:
+        for part in f.key.split(":"):
+            assert not part.isdigit(), f.key
+
+
+# --- runtime sentinel --------------------------------------------------------
+
+
+def test_sentinel_records_and_clears_edges():
+    s = LockOrderSentinel()
+    with s.patched():
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+    edges = s.edges()
+    assert len(edges) == 1
+    ((held, acq),) = edges
+    assert "test_analysis.py" in held and "test_analysis.py" in acq
+    assert s.find_cycle() is None
+    s.assert_acyclic()
+
+
+def test_sentinel_detects_deliberate_inversion():
+    s = LockOrderSentinel()
+    with s.patched():
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+    cycle = s.find_cycle()
+    assert cycle is not None
+    with pytest.raises(AssertionError, match="cycle"):
+        s.assert_acyclic()
+
+
+def test_sentinel_cross_thread_inversion_detected():
+    """The graph is global: thread 1 takes A->B, thread 2 takes B->A —
+    never deadlocking in this run, still a reportable inversion."""
+    s = LockOrderSentinel()
+    with s.patched():
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        def t2():
+            with b:
+                with a:
+                    pass
+
+        th1 = threading.Thread(target=t1)
+        th1.start()
+        th1.join()
+        th2 = threading.Thread(target=t2)
+        th2.start()
+        th2.join()
+    assert s.find_cycle() is not None
+
+
+def test_sentinel_rlock_reentry_is_not_an_edge():
+    s = LockOrderSentinel()
+    with s.patched():
+        r = threading.RLock()
+        with r:
+            with r:  # reentrant: no self-edge, no cycle
+                pass
+    assert s.edges() == {}
+    s.assert_acyclic()
+
+
+def test_sentinel_condition_and_event_survive_instrumentation():
+    """threading.Condition/Event build on patched locks; the wrapper's
+    _release_save/_acquire_restore hooks must keep cond.wait working AND
+    the held-stack truthful across the wait."""
+    s = LockOrderSentinel()
+    with s.patched():
+        cond = threading.Condition()
+        ready = []
+
+        def waiter():
+            with cond:
+                while not ready:
+                    cond.wait(timeout=2.0)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        ev = threading.Event()
+        assert not ev.wait(timeout=0.01)
+        with cond:
+            ready.append(1)
+            cond.notify_all()
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+        ev.set()
+        assert ev.wait(timeout=1.0)
+    s.assert_acyclic()
+
+
+def test_sentinel_stats_and_reset():
+    s = LockOrderSentinel()
+    with s.patched():
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+    assert s.stats()["locks"] >= 2
+    assert s.stats()["edges"] == 1
+    s.reset()
+    assert s.stats() == {"locks": 0, "edges": 0}
+
+
+# --- the C3 fix that fell out of the pass ------------------------------------
+
+
+def test_note_full_model_round_is_monotonic_and_race_free():
+    from p2pfl_tpu.node_state import NodeState
+
+    state = NodeState("test://c3")
+    state.note_full_model_round(3)
+    state.note_full_model_round(1)  # must not regress
+    assert state.last_full_model_round == 3
+
+    # hammer from many threads: the high-water mark must equal the max seen
+    state = NodeState("test://c3b")
+    barrier = threading.Barrier(8)
+
+    def writer(vals):
+        barrier.wait()
+        for v in vals:
+            state.note_full_model_round(v)
+
+    threads = [
+        threading.Thread(target=writer, args=([i, 100 - i, i * 7 % 50],))
+        for i in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert state.last_full_model_round == 100

@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 
 from p2pfl_tpu import native
+from p2pfl_tpu.config import Settings
 from p2pfl_tpu.exceptions import DecodingParamsError
 from p2pfl_tpu.ops.serialization import deserialize_arrays, serialize_arrays
 
@@ -26,14 +27,16 @@ def test_native_builds_and_loads():
     assert lib is not None, "g++ is in the image; the codec must build"
 
 
-def test_native_and_python_paths_byte_identical(monkeypatch):
+def test_native_and_python_paths_byte_identical():
     arrays = _arrays()
     meta = {"contributors": ["a", "b"], "num_samples": 7}
     assert native.get_lib() is not None
     buf_native = serialize_arrays(arrays, meta)
     assert isinstance(buf_native, bytearray)  # single-copy native path
-    monkeypatch.setenv("P2PFL_TPU_NO_NATIVE", "1")
-    buf_python = serialize_arrays(arrays, meta)
+    # NO_NATIVE now rides the validated Settings layer (env read at config
+    # load), so runtime disabling goes through Settings.overridden.
+    with Settings.overridden(NO_NATIVE=True):
+        buf_python = serialize_arrays(arrays, meta)
     assert isinstance(buf_python, bytes)
     assert bytes(buf_native) == buf_python
 
@@ -85,11 +88,11 @@ def test_packed_size_matches_python_framing():
     assert total == off
 
 
-def test_python_fallback_when_disabled(monkeypatch):
-    monkeypatch.setenv("P2PFL_TPU_NO_NATIVE", "1")
+def test_python_fallback_when_disabled():
     arrays = _arrays()
-    buf = serialize_arrays(arrays, {"x": [1, 2]})
-    out, meta = deserialize_arrays(buf)
+    with Settings.overridden(NO_NATIVE=True):
+        buf = serialize_arrays(arrays, {"x": [1, 2]})
+        out, meta = deserialize_arrays(buf)
     assert meta == {"x": [1, 2]}
     for a, b in zip(arrays, out):
         np.testing.assert_array_equal(np.asarray(a), b)

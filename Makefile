@@ -1,6 +1,6 @@
 # Convenience targets; everything also runs as the plain commands shown.
 
-.PHONY: test test-fast bench dryrun proto-check api-docs telemetry-check chaos-check byzantine-check observatory-check perf-check async-check fleetobs-check analyze race-check
+.PHONY: test test-fast bench dryrun proto-check api-docs telemetry-check chaos-check byzantine-check observatory-check perf-check async-check fleetobs-check recovery-check analyze race-check
 
 test:            ## full suite on the virtual 8-device CPU mesh (~30 min, 1 core)
 	python -m pytest tests/ -q
@@ -37,6 +37,9 @@ async-check:     ## 3-node gate: async windows beat sync rounds with a 3x stragg
 
 fleetobs-check:  ## 3-node gate: staleness sketches propagate on beats, window attribution flags a 3x-slow peer, v1-digest peer tolerated (CPU-only)
 	JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/fleetobs_check.py
+
+recovery-check:  ## 3-node gate: kill one journaled node mid-round, resume it from its journal as the same addr, federation finishes (CPU-only)
+	JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/recovery_check.py
 
 analyze:         ## static correctness pass (C1-C5: lock order, blocking-under-lock, unguarded writes, jit purity, drift); exit 0 clean / 1 new finding / 2 stale suppression
 	PYTHONPATH=. python scripts/analyze.py --baseline analysis_baseline.json

@@ -1605,6 +1605,414 @@ def run_chaos_bench() -> None:
     _emit(out, seed=locals().get("seed"), backend="cpu")
 
 
+def run_recovery_bench() -> None:
+    """Subprocess-style mode ``--recovery``: durable-recovery acceptance run.
+
+    Five arms over the real Node/gossip/aggregator stack (8-node in-memory
+    MNIST FedAvg, full committees, per-node write-ahead journals):
+
+    * **baseline** — fault-free run (the accuracy/wall yardstick);
+    * **crash_restart** — one seeded trainset member crashed mid-round, then
+      RESUMED from its journal as the same address: it must re-enter the
+      stage machine, contribute within 2 rounds of the resume, and the
+      federation must finish at 0.0 pp accuracy delta vs baseline;
+    * **partition_heal** — a seeded 4|4 partition held for ~2 rounds, then
+      healed: the halves must re-discover each other (heal probes), exchange
+      reconcile pings, catch the behind half up (dense round-anchor
+      catch-up when a half leads), and converge to ONE federation at 0.0 pp;
+    * **quorum_park** — the same 4|4 split with RECOVERY_QUORUM_FRACTION
+      set so neither half has quorum: every node must PARK (no vote
+      progress, state journaled) instead of burning vote timeouts, unpark on
+      heal, and still finish all rounds at 0.0 pp;
+    * **async_partition_heal** — the 4|4 split under the async scheduler:
+      windows keep closing in both halves and the heal merges both halves'
+      contributions through the staleness-weighted buffer.
+
+    Determinism: the seeded recovery trace replays identically
+    (plan_recovery is a pure function of the seed) and a fresh chaos plane
+    replaying the same intercept+recovery sequence yields identical fault
+    counts. Artifact: ``artifacts/RECOVERY_BENCH.json``.
+
+    Shape overrides: P2PFL_TPU_RECOVERY_BENCH_NODES (default 8),
+    P2PFL_TPU_RECOVERY_BENCH_ROUNDS (default 5),
+    P2PFL_TPU_RECOVERY_BENCH_SEED (default 42).
+    """
+    out: dict = {}
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"  # protocol-stack bench: CPU venue
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import contextlib
+        import tempfile
+
+        from p2pfl_tpu.chaos import CHAOS, ChaosPlane
+        from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+        from p2pfl_tpu.config import Settings
+        from p2pfl_tpu.learning.dataset import (
+            RandomIIDPartitionStrategy,
+            synthetic_mnist,
+        )
+        from p2pfl_tpu.management.checkpoint import NodeJournal, attach_node_journal
+        from p2pfl_tpu.models import mlp_model
+        from p2pfl_tpu.node import Node
+        from p2pfl_tpu.telemetry import REGISTRY
+        from p2pfl_tpu.utils.utils import set_test_settings, wait_convergence
+
+        n_nodes = int(os.environ.get("P2PFL_TPU_RECOVERY_BENCH_NODES", "8"))
+        rounds = int(os.environ.get("P2PFL_TPU_RECOVERY_BENCH_ROUNDS", "5"))
+        seed = int(os.environ.get("P2PFL_TPU_RECOVERY_BENCH_SEED", "42"))
+        set_test_settings()
+        Settings.RESOURCE_MONITOR_PERIOD = 0
+        Settings.LOG_LEVEL = "WARNING"
+        Settings.TRAIN_SET_SIZE = n_nodes  # full committee: victims train
+
+        def metric_sum(name: str) -> float:
+            fam = REGISTRY.get(name)
+            return sum(c.value for _, c in fam.samples()) if fam else 0.0
+
+        def metric_by_label(name: str) -> dict:
+            fam = REGISTRY.get(name)
+            if fam is None:
+                return {}
+            agg: dict = {}
+            for labels, child in fam.samples():
+                key = labels.get("role") or labels.get("fault") or labels.get("node")
+                agg[key] = agg.get(key, 0.0) + child.value
+            return agg
+
+        def run_leg(kind: str, mode: str = "sync", quorum: float = 0.0) -> dict:
+            REGISTRY.reset()
+            CHAOS.reset()
+            data = synthetic_mnist(n_train=256 * n_nodes, n_test=256)
+            parts = data.generate_partitions(n_nodes, RandomIIDPartitionStrategy)
+            nodes = [
+                Node(mlp_model(seed=i), parts[i], batch_size=32)
+                for i in range(n_nodes)
+            ]
+            tmpdir = tempfile.mkdtemp(prefix=f"recovery-bench-{kind}-")
+            journals = [
+                NodeJournal(os.path.join(tmpdir, f"j{i}")) for i in range(n_nodes)
+            ]
+            by_addr = {nd.addr: nd for nd in nodes}
+            for nd, journal in zip(nodes, journals):
+                attach_node_journal(nd, journal)
+                nd.start()
+            addrs = [nd.addr for nd in nodes]
+            plan = CHAOS.plan_recovery(
+                rounds, addrs, seed=seed,
+                crash_round=(1 if kind == "crash_restart" else None),
+                restart_after=1,
+                partition_round=(1 if kind != "crash_restart" else None),
+                heal_after=2,
+            ) if kind != "baseline" else ()
+            victim_addr = next((e.node for e in plan if e.kind == "crash"), None)
+            part_groups = next(
+                (e.groups for e in plan if e.kind == "partition"), None
+            )
+            crashed = healed = False
+            part_base = None
+            full_park_at = None
+            resumed_node = None
+            resume_round = None
+            contributed_round = [None]
+            quorum_scope = (
+                Settings.overridden(RECOVERY_QUORUM_FRACTION=quorum)
+                if quorum > 0.0
+                else contextlib.nullcontext()
+            )
+            try:
+                with quorum_scope:
+                    for i in range(1, n_nodes):
+                        nodes[i].connect(nodes[0].addr)
+                    wait_convergence(nodes, n_nodes - 1, wait=30)
+                    t0 = time.monotonic()
+                    nodes[0].set_start_learning(rounds=rounds, epochs=1, mode=mode)
+                    observer = nodes[0]
+                    deadline = time.time() + 900
+                    while time.time() < deadline:
+                        r0 = observer.state.round or 0
+                        if (
+                            victim_addr is not None
+                            and not crashed
+                            and by_addr[victim_addr].recovery_journal is not None
+                            and journals[addrs.index(victim_addr)].all_steps()
+                        ):
+                            victim = by_addr[victim_addr]
+                            _phase(f"recovery: crashing {victim_addr} mid-round {victim.state.round}")
+                            victim.crash()
+                            CHAOS.recovery(victim_addr, "crash")
+                            journal = journals[addrs.index(victim_addr)]
+                            journal.wait()
+                            resumed_node = Node.resume(
+                                mlp_model(seed=1000),
+                                parts[addrs.index(victim_addr)],
+                                journal, batch_size=32,
+                            )
+                            assert resumed_node.addr == victim_addr
+                            resumed_node.start()
+                            resumed_node.resume_learning()
+                            resume_round = resumed_node.state.round or 0
+                            CHAOS.recovery(victim_addr, "restart")
+                            nodes[addrs.index(victim_addr)] = resumed_node
+                            by_addr[victim_addr] = resumed_node
+                            _phase(
+                                f"recovery: resumed {victim_addr} at round {resume_round}"
+                            )
+                            crashed = True
+                        if part_groups is not None and not healed:
+                            if part_base is None:
+                                if r0 >= 1 and observer.learning_in_progress():
+                                    _phase(
+                                        f"recovery: partitioning "
+                                        f"{len(part_groups[0])}|{len(part_groups[1])} "
+                                        f"at round {r0}"
+                                    )
+                                    CHAOS.partition(*part_groups)
+                                    CHAOS.recovery("fleet", "partition")
+                                    part_base = r0
+                            else:
+                                # quorum arm: rounds stop advancing once the
+                                # fleet parks — heal a beat after everyone is
+                                # parked rather than on round progress.
+                                parked_now = sum(
+                                    1 for nd in nodes if nd.state.parked
+                                )
+                                if quorum > 0.0 and parked_now >= n_nodes - 1:
+                                    full_park_at = full_park_at or time.monotonic()
+                                heal_due = (
+                                    r0 >= part_base + 2
+                                    or (
+                                        full_park_at is not None
+                                        and time.monotonic() - full_park_at > 2.0
+                                    )
+                                    or not observer.learning_in_progress()
+                                )
+                                if heal_due:
+                                    _phase(f"recovery: healing at round {r0}")
+                                    CHAOS.heal()
+                                    CHAOS.recovery("fleet", "heal")
+                                    healed = True
+                        # track the resumed identity's first post-resume
+                        # appearance in a SURVIVOR's aggregation progress
+                        if resumed_node is not None and contributed_round[0] is None:
+                            watcher = next(
+                                nd for nd in nodes if nd.addr != victim_addr
+                            )
+                            for peer, merged in list(
+                                watcher.state.models_aggregated.items()
+                            ):
+                                if peer != victim_addr and victim_addr in merged:
+                                    contributed_round[0] = watcher.state.round
+                                    break
+                        if all(
+                            not nd.learning_in_progress()
+                            and nd.learning_workflow is not None
+                            for nd in nodes
+                        ):
+                            break
+                        time.sleep(0.1)
+                    else:
+                        raise TimeoutError(
+                            f"{kind} federation did not finish "
+                            f"(stages: {({nd.addr: nd.state.current_stage for nd in nodes})})"
+                        )
+                    wall_s = time.monotonic() - t0
+                    if part_groups is not None and not healed:
+                        CHAOS.heal()
+                    faults = CHAOS.fault_counts()
+                accs = [
+                    nd.learner.evaluate().get("test_acc", 0.0) for nd in nodes
+                ]
+                leg = {
+                    "wall_s": round(wall_s, 2),
+                    "final_test_acc_mean": round(sum(accs) / len(accs), 4),
+                    "final_test_acc_min": round(min(accs), 4),
+                    "final_test_acc_max": round(max(accs), 4),
+                    "rounds_finished": [
+                        nd.learning_workflow.history.count("RoundFinishedStage")
+                        + nd.learning_workflow.history.count("AsyncWindowFinishedStage")
+                        for nd in nodes
+                    ],
+                    "journal_saves": metric_sum("p2pfl_recovery_journal_saves_total"),
+                    "injected_faults": faults,
+                    "recovery_events_executed": int(faults.get("recovery", 0)),
+                    "planned_events": [
+                        {"when": e.when, "kind": e.kind, "node": e.node}
+                        for e in plan
+                    ],
+                }
+                if kind == "crash_restart":
+                    leg.update(
+                        {
+                            "victim": victim_addr,
+                            "resumed_same_identity": resumed_node is not None
+                            and resumed_node.addr == victim_addr,
+                            "resume_round": resume_round,
+                            "contributed_round": contributed_round[0],
+                            "resumes": metric_sum("p2pfl_recovery_resumes_total"),
+                            "resumed_history_head": (
+                                resumed_node.learning_workflow.history[:6]
+                                if resumed_node is not None
+                                and resumed_node.learning_workflow is not None
+                                else []
+                            ),
+                        }
+                    )
+                if part_groups is not None:
+                    leg.update(
+                        {
+                            "heals_detected": metric_sum("p2pfl_recovery_heals_total"),
+                            "reconcile": metric_by_label(
+                                "p2pfl_recovery_reconcile_total"
+                            ),
+                        }
+                    )
+                if quorum > 0.0:
+                    leg.update(
+                        {
+                            "parks": metric_sum("p2pfl_recovery_parks_total"),
+                            "parked_seconds": round(
+                                metric_sum("p2pfl_recovery_parked_seconds_total"), 2
+                            ),
+                        }
+                    )
+                return leg
+            finally:
+                for nd in nodes:
+                    nd.stop()
+                if resumed_node is not None:
+                    resumed_node.stop()
+                for journal in journals:
+                    try:
+                        journal.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                InMemoryRegistry.reset()
+                CHAOS.reset()
+
+        _phase(f"recovery bench: {n_nodes}-node baseline (fault-free)")
+        baseline = run_leg("baseline")
+        _phase(f"baseline done: {json.dumps(baseline)}")
+
+        _phase("recovery bench: crash_restart arm")
+        crash_leg = run_leg("crash_restart")
+        _phase(f"crash_restart done: {json.dumps(crash_leg)}")
+
+        _phase("recovery bench: partition_heal arm (4|4, split-brain)")
+        part_leg = run_leg("partition_heal")
+        _phase(f"partition_heal done: {json.dumps(part_leg)}")
+
+        _phase("recovery bench: quorum_park arm (4|4 below quorum)")
+        quorum_leg = run_leg("quorum_park", quorum=0.6)
+        _phase(f"quorum_park done: {json.dumps(quorum_leg)}")
+
+        _phase("recovery bench: async partition_heal arm")
+        async_leg = run_leg("async_partition_heal", mode="async")
+        _phase(f"async_partition_heal done: {json.dumps(async_leg)}")
+
+        # --- acceptance assertions ---------------------------------------
+        base_acc = baseline["final_test_acc_mean"]
+        deltas = {
+            name: round(100.0 * (base_acc - leg["final_test_acc_mean"]), 2)
+            for name, leg in (
+                ("crash_restart", crash_leg),
+                ("partition_heal", part_leg),
+                ("quorum_park", quorum_leg),
+                ("async_partition_heal", async_leg),
+            )
+        }
+        worst_delta = max(deltas.values())
+        if worst_delta > 0.0:
+            raise AssertionError(
+                f"recovery arm degraded accuracy vs fault-free baseline: "
+                f"{deltas} (baseline {base_acc})"
+            )
+        if not crash_leg["resumed_same_identity"]:
+            raise AssertionError("crash_restart: identity not restored from journal")
+        if crash_leg["contributed_round"] is None or (
+            crash_leg["contributed_round"] - crash_leg["resume_round"] > 2
+        ):
+            raise AssertionError(
+                f"crash_restart: resumed node did not contribute within 2 "
+                f"rounds (resumed at {crash_leg['resume_round']}, first seen "
+                f"at {crash_leg['contributed_round']})"
+            )
+        if part_leg["heals_detected"] < 2:
+            raise AssertionError(
+                f"partition_heal: heal detections missing: {part_leg}"
+            )
+        if part_leg["final_test_acc_min"] != part_leg["final_test_acc_max"]:
+            raise AssertionError(
+                f"partition_heal: halves did not converge to one model: "
+                f"{part_leg}"
+            )
+        if quorum_leg["parks"] < n_nodes:
+            raise AssertionError(
+                f"quorum_park: expected every node to park below quorum, got "
+                f"{quorum_leg['parks']}"
+            )
+
+        # --- determinism ---------------------------------------------------
+        plan_a = ChaosPlane().plan_recovery(
+            rounds, [f"n{i}" for i in range(n_nodes)], seed=seed,
+            crash_round=1, partition_round=1, heal_after=2,
+        )
+        plan_b = ChaosPlane().plan_recovery(
+            rounds, [f"n{i}" for i in range(n_nodes)], seed=seed,
+            crash_round=1, partition_round=1, heal_after=2,
+        )
+        if plan_a != plan_b:
+            raise AssertionError("plan_recovery is not deterministic")
+        replay_counts = []
+        for _ in range(2):
+            plane = ChaosPlane()
+            with Settings.overridden(CHAOS_ENABLED=True, CHAOS_SEED=seed):
+                plane.partition([f"n{i}" for i in range(4)],
+                                [f"n{i}" for i in range(4, 8)])
+                for e in plan_a:
+                    plane.recovery(e.node or "fleet", e.kind)
+                for i in range(4):
+                    for j in range(4, 8):
+                        plane.intercept(f"n{i}", f"n{j}")
+            replay_counts.append(plane.fault_counts())
+        if replay_counts[0] != replay_counts[1]:
+            raise AssertionError(
+                f"recovery fault replay not deterministic: {replay_counts}"
+            )
+
+        out = {
+            "metric": "recovery_durable_8node_mnist_fedavg",
+            "value": worst_delta,
+            "unit": "worst_pp_acc_delta_vs_fault_free",
+            "vs_baseline": None,
+            "extra": {
+                "nodes": n_nodes,
+                "rounds": rounds,
+                "seed": seed,
+                "baseline": baseline,
+                "crash_restart": crash_leg,
+                "partition_heal": part_leg,
+                "quorum_park": quorum_leg,
+                "async_partition_heal": async_leg,
+                "acc_delta_pp": deltas,
+                "deterministic_replay_counts": replay_counts[0],
+                "note": "crash-restarted node resumes its own identity from "
+                "the write-ahead journal and contributes within 2 rounds; a "
+                "healed 4|4 partition reconciles to one model; below-quorum "
+                "halves park instead of burning vote timeouts; the seeded "
+                "recovery trace replays deterministically",
+            },
+        }
+        os.makedirs("artifacts", exist_ok=True)
+        with open(os.path.join("artifacts", "RECOVERY_BENCH.json"), "w") as f:
+            json.dump({**out, "meta": _bench_meta(seed=seed, backend="cpu")}, f, indent=1)
+    except Exception as e:  # noqa: BLE001
+        traceback.print_exc(file=sys.stderr)
+        out["error"] = f"{type(e).__name__}: {e}"
+    _emit(out, seed=locals().get("seed"), backend="cpu")
+
+
 def run_async_bench() -> None:
     """Subprocess-style mode ``--async``: elastic async federation acceptance.
 
@@ -4020,6 +4428,8 @@ if __name__ == "__main__":
         run_critical_path_bench()
     elif "--chaos" in sys.argv:
         run_chaos_bench()
+    elif "--recovery" in sys.argv:
+        run_recovery_bench()
     elif "--byzantine" in sys.argv:
         run_byzantine_bench()
     elif "--async" in sys.argv:

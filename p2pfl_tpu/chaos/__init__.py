@@ -8,6 +8,14 @@ from p2pfl_tpu.chaos.plane import (  # noqa: F401
     ChaosPlane,
     ChurnEvent,
     Decision,
+    RecoveryEvent,
 )
 
-__all__ = ["BYZANTINE_ATTACKS", "CHAOS", "ChaosPlane", "ChurnEvent", "Decision"]
+__all__ = [
+    "BYZANTINE_ATTACKS",
+    "CHAOS",
+    "ChaosPlane",
+    "ChurnEvent",
+    "Decision",
+    "RecoveryEvent",
+]

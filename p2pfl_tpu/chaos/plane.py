@@ -85,6 +85,27 @@ BYZANTINE_ATTACKS = ("signflip", "scaled", "nan", "inflate")
 
 
 @dataclass(frozen=True)
+class RecoveryEvent:
+    """One scheduled recovery-scenario step: at round/window ``when``,
+
+    * ``crash`` — ``node`` dies abruptly (:meth:`Node.crash`),
+    * ``restart`` — the same node is rebuilt from its journal
+      (:meth:`Node.resume`) and re-enters as itself,
+    * ``partition`` — the fleet splits into ``groups``
+      (:meth:`ChaosPlane.partition`),
+    * ``heal`` — the partition heals (:meth:`ChaosPlane.heal`).
+
+    Executing an event is the driver's job; each executed event is reported
+    via :meth:`ChaosPlane.recovery` so it lands in the deterministic fault
+    table (``fault="recovery"``) like every other injected fault."""
+
+    when: int
+    kind: str  # "crash" | "restart" | "partition" | "heal"
+    node: str = ""
+    groups: Tuple[Tuple[str, ...], ...] = ()
+
+
+@dataclass(frozen=True)
 class ChurnEvent:
     """One scheduled membership change: at round/window ``when``, ``node``
     performs ``kind`` ("leave" — abrupt death via :meth:`Node.crash`; or
@@ -222,6 +243,73 @@ class ChaosPlane:
                 if joiners:
                     events.append(ChurnEvent(r, "join", joiners.pop(0)))
         return tuple(events)
+
+    def plan_recovery(
+        self,
+        rounds: int,
+        nodes: Sequence[str],
+        *,
+        seed: Optional[int] = None,
+        crash_round: int = 1,
+        restart_after: int = 1,
+        partition_round: Optional[int] = None,
+        heal_after: int = 2,
+        groups: int = 2,
+    ) -> Tuple["RecoveryEvent", ...]:
+        """Seeded crash-restart + timed-partition scenario trace (the
+        durable-recovery acceptance shape, à la :meth:`plan_churn`).
+
+        Deterministic: a pure function of ``(seed, nodes, shape)`` — the
+        crash victim is drawn with a dedicated
+        ``random.Random(f"{seed}|recovery")`` stream, and the partition
+        split is a seeded shuffle of ``nodes`` dealt round-robin into
+        ``groups``. The driver executes each event (crash the node / resume
+        it from its journal / partition / heal) and reports it via
+        :meth:`recovery` so replays can assert identical event counts.
+        """
+        rng = random.Random(
+            f"{seed if seed is not None else Settings.CHAOS_SEED}|recovery"
+        )
+        pool = list(nodes)
+        events = []
+        if crash_round is not None and 0 <= crash_round < rounds and pool:
+            victim = pool[rng.randrange(len(pool))]
+            events.append(RecoveryEvent(crash_round, "crash", victim))
+            back = crash_round + max(1, restart_after)
+            if back < rounds:
+                events.append(RecoveryEvent(back, "restart", victim))
+        if partition_round is not None and 0 <= partition_round < rounds and pool:
+            shuffled = list(pool)
+            rng.shuffle(shuffled)
+            split: Tuple[Tuple[str, ...], ...] = tuple(
+                tuple(shuffled[g::groups]) for g in range(max(2, groups))
+            )
+            events.append(RecoveryEvent(partition_round, "partition", groups=split))
+            healed = partition_round + max(1, heal_after)
+            events.append(RecoveryEvent(min(healed, rounds), "heal", groups=split))
+        return tuple(sorted(events, key=lambda e: (e.when, e.kind, e.node)))
+
+    def recovery(self, label: str, kind: str) -> None:
+        """Count one EXECUTED recovery-scenario event (``kind`` is "crash" |
+        "restart" | "partition" | "heal" — recorded for the log line; the
+        fault counter buckets them all under ``fault="recovery"``)."""
+        with self._lock:
+            self._count(label, "recovery")
+        log.warning("chaos: recovery event %s %s", kind, label)
+
+    def link_blocked(self, src: str, dst: str) -> Optional[str]:
+        """State-only view of whether the ``src -> dst`` link is blocked
+        ("crash" | "partition" | None). Used by the heal-detection probe:
+        unlike :meth:`intercept` it draws NO randomness and counts nothing,
+        so probing (whose cadence is wall-clock-dependent) can never desync
+        the deterministic per-pair decision streams."""
+        with self._lock:
+            if src in self._crashed or dst in self._crashed:
+                return "crash"
+            gs, gd = self._groups.get(src), self._groups.get(dst)
+            if gs is not None and gd is not None and gs != gd:
+                return "partition"
+        return None
 
     def churn(self, addr: str, kind: str) -> None:
         """Count one EXECUTED churn event (``kind`` is "join" | "leave" |

@@ -341,6 +341,142 @@ class FullModelCommand(Command):
             log.exception("full_model from %s failed", source)
 
 
+class ReconcileCommand(Command):
+    """Partition-heal progress exchange (control plane).
+
+    Sent by a node's heal handler when a failure-departed peer demonstrably
+    returns: ``args = [sender_round, sender_mode]``. Both sides of a healed
+    split detect the heal and ping, so each handler only has to answer one
+    question — *am I ahead?* If this node leads the sender by at least
+    ``Settings.RECOVERY_RECONCILE_MIN_LEAD`` rounds/windows, it ships its
+    current ROUND ANCHOR (the round-start model every in-phase node deltas
+    against) as a dense ``reconcile_model`` catch-up; the behind side adopts
+    it at its next round boundary and fast-forwards. Equal-round splits
+    exchange nothing — the next round's normal aggregation merges the two
+    branches (and the async buffer folds both halves staleness-weighted)."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "reconcile"
+
+    def execute(self, source: str, round: int, *args: str, **kwargs: Any) -> None:
+        node = self._node
+        state = node.state
+        my_round = state.round
+        if my_round is None or source == node.addr:
+            return
+        try:
+            sender_round = int(args[0]) if args else int(round)
+        except ValueError:
+            return
+        if sender_round - my_round >= Settings.RECOVERY_RECONCILE_MIN_LEAD:
+            # THEY are ahead: request the catch-up by pinging our own
+            # position back (covers asymmetric heal detection — only one
+            # side noticed the return). Cooldown-guarded on the node.
+            node.send_reconcile_ping(source)
+            return
+        if my_round - sender_round < Settings.RECOVERY_RECONCILE_MIN_LEAD:
+            return
+        anchor = state.wire.anchor_model()
+        if anchor is None:
+            return
+        leaves, anchor_round = anchor
+        if anchor_round <= sender_round:
+            return
+        model = node.learner.get_model()
+        catchup = model.build_copy(
+            params=leaves,
+            contributors=model.contributors or [node.addr],
+            num_samples=model.get_num_samples(),
+        )
+        env = node.protocol.build_weights(
+            ReconcileModelCommand.get_name(),
+            anchor_round,
+            catchup.encode_parameters(),  # always dense: generations diverged
+            catchup.contributors,
+            catchup.get_num_samples(),
+        )
+        try:
+            node.protocol.send(
+                source, env, create_connection=True,
+                raise_error=False, remove_on_error=False,
+            )
+        except Exception:  # noqa: BLE001 — a failed catch-up must not hurt us
+            log.exception("reconcile catch-up to %s failed", source)
+            return
+        from p2pfl_tpu.stages.recovery import reconcile_metric
+
+        reconcile_metric(node.addr, "catchup_tx")
+        node.protocol.flight_recorder.record(
+            "reconcile", role="catchup_tx", peer=source,
+            round=anchor_round, behind=sender_round,
+        )
+        log.warning(
+            "%s: healed peer %s is %d behind (round %s vs %s) — shipped the "
+            "round-%s anchor as dense catch-up",
+            node.addr, source, my_round - sender_round, sender_round, my_round,
+            anchor_round,
+        )
+
+
+class ReconcileModelCommand(Command):
+    """Dense catch-up from the ahead side of a healed split (model plane).
+
+    The payload is the sender's round anchor for ``round``. Adoption is
+    deferred: the screened arrays are parked in the node state and applied
+    ATOMICALLY at the next round/window boundary
+    (:func:`p2pfl_tpu.stages.recovery.apply_pending_reconcile`) — applying
+    mid-stage would race the stage's own model writes. The sliced stage
+    waits are woken so the dead-branch round winds down fast."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "reconcile_model"
+
+    def execute(self, source: str, round: int, *args: str, **kwargs: Any) -> None:
+        from p2pfl_tpu.models.model_handle import decode_wire_frame
+
+        node = self._node
+        state = node.state
+        if state.round is None or int(round) <= state.round:
+            return
+        weights: bytes = kwargs["weights"]
+        try:
+            arrays, meta = decode_wire_frame(weights)
+        except Exception as exc:
+            log.debug("reconcile_model from %s undecodable: %s", source, exc)
+            state.admission.record("corrupt", source, "reconcile_model")
+            return
+        # Structure + finiteness screening; no norm bound — our stale branch
+        # is arbitrarily far from the surviving generation (same rationale
+        # as full_model / async_catchup adoption).
+        if state.admission.screen(
+            arrays, node.learner.get_model(),
+            source=source, cmd="reconcile_model", check_norm=False,
+        ):
+            return
+        if state.offer_reconcile(
+            int(round), arrays, list(kwargs.get("contributors", [])), source
+        ):
+            # Wind the dead-branch round down: sliced waits re-check
+            # reconcile_ahead() and exit instead of sleeping out deadlines.
+            state.votes_ready_event.set()
+            state.aggregated_model_event.set()
+            node.protocol.flight_recorder.record(
+                "reconcile", role="offer", peer=source, round=int(round)
+            )
+            log.info(
+                "%s: reconcile catch-up for round %s staged (from %s)",
+                node.addr, round, source,
+            )
+
+
 class AsyncContributionCommand(Command):
     """Fold a peer's async contribution into the buffered aggregator.
 

@@ -186,6 +186,71 @@ class DeltaWireCodec:
             self._residual = None
             self._history.clear()
 
+    # --- recovery journal (management/checkpoint.py NodeJournal) ------------
+
+    def export_state(self) -> Dict[str, Any]:
+        """Snapshot of the recovery closure this codec owns: the current
+        anchor (flat float32 leaves + shapes + round + fingerprint) and the
+        error-feedback residuals. The anchor HISTORY is deliberately not
+        exported — after a crash the federation has moved on, and retired
+        anchors would decode in-flight frames into a dead generation (the
+        same rationale as :meth:`resync` dropping it)."""
+        with self._lock:
+            return {
+                "anchor": (
+                    [a.copy() for a in self._anchor]
+                    if self._anchor is not None
+                    else None
+                ),
+                "shapes": list(self._shapes) if self._shapes is not None else None,
+                "anchor_round": self._anchor_round,
+                "anchor_crc": self._anchor_crc,
+                "residual": (
+                    [np.asarray(r, np.float32).copy() for r in self._residual]
+                    if self._residual is not None
+                    else None
+                ),
+            }
+
+    def import_state(self, st: Dict[str, Any]) -> None:
+        """Restore an :meth:`export_state` snapshot (crash-restart resume):
+        the node re-enters the federation holding the exact anchor and EF
+        residuals it journaled, so sparse frames for the journaled round
+        keep decoding and the untransmitted-mass accounting survives the
+        restart bit-exact."""
+        with self._lock:
+            anchor = st.get("anchor")
+            self._anchor = (
+                [np.ascontiguousarray(a, dtype=np.float32).reshape(-1) for a in anchor]
+                if anchor is not None
+                else None
+            )
+            shapes = st.get("shapes")
+            self._shapes = [tuple(s) for s in shapes] if shapes is not None else None
+            self._anchor_round = int(st.get("anchor_round", -1))
+            self._anchor_crc = int(st.get("anchor_crc", 0))
+            residual = st.get("residual")
+            self._residual = (
+                [np.ascontiguousarray(r, dtype=np.float32).reshape(-1) for r in residual]
+                if residual is not None
+                else None
+            )
+            self._history.clear()
+
+    def anchor_model(self) -> Optional[Tuple[List[np.ndarray], int]]:
+        """(leaves reshaped to model shapes, anchor round), or ``None`` when
+        no anchor is set. This is the round-START model every in-phase node
+        anchors the current round against — exactly what a healed
+        partition's behind half must adopt to rejoin the ahead half's model
+        generation (the reconcile catch-up payload)."""
+        with self._lock:
+            if self._anchor is None or self._shapes is None:
+                return None
+            return (
+                [a.reshape(s).copy() for a, s in zip(self._anchor, self._shapes)],
+                self._anchor_round,
+            )
+
     # --- encode -------------------------------------------------------------
 
     def encode_model(self, model: Any, round: int) -> Optional[bytes]:

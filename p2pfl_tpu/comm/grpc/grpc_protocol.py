@@ -190,7 +190,8 @@ class GrpcCommunicationProtocol(CommunicationProtocol):
                 return node_pb2.Ack(error=str(exc))
 
         def disconnect(request: node_pb2.Hello, context: Any) -> node_pb2.Ack:
-            protocol.neighbors.remove(request.addr, notify=False)
+            # Graceful goodbye from the peer — not a failure departure.
+            protocol.neighbors.remove(request.addr, notify=False, departed=False)
             return node_pb2.Ack()
 
         def send(request: node_pb2.Envelope, context: Any) -> node_pb2.Ack:

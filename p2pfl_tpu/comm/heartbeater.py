@@ -65,6 +65,7 @@ class Heartbeater:
         neighbors: Neighbors,
         broadcast_fn: Callable[[Envelope], None],
         digest_fn: Optional[Callable[[], Optional[str]]] = None,
+        probe_fn: Optional[Callable[[], None]] = None,
     ) -> None:
         self._self_addr = self_addr
         self._neighbors = neighbors
@@ -73,6 +74,11 @@ class Heartbeater:
         # beat). Settable after construction (protocol.set_digest_source);
         # None keeps beats digest-free — the pre-observatory wire format.
         self._digest_fn = digest_fn
+        # Heal detection (protocol._probe_departed): invoked on every sweep
+        # tick so write-offs that were a PARTITION, not a death, are
+        # rediscovered once the partition heals — beats alone cannot carry
+        # a peer back after the failed send dropped the last link to it.
+        self._probe_fn = probe_fn
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_beat_at: Dict[str, float] = {}  # peer -> local monotonic
@@ -162,5 +168,12 @@ class Heartbeater:
                 self._live_peers.set(
                     sum(1 for s in last_seen.values() if now - s <= Settings.HEARTBEAT_TIMEOUT)
                 )
+                if self._probe_fn is not None:
+                    try:
+                        self._probe_fn()
+                    except Exception:  # probes must not stop the beat
+                        log.exception(
+                            "(%s) heal-detection probe failed", self._self_addr
+                        )
             if self._stop.wait(Settings.HEARTBEAT_PERIOD):
                 return

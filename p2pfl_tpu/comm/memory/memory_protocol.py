@@ -81,7 +81,9 @@ class InMemoryCommunicationProtocol(CommunicationProtocol):
         self.neighbors.add(source_addr, non_direct=False, handshake=False)
 
     def accept_disconnect(self, source_addr: str) -> None:
-        self.neighbors.remove(source_addr, notify=False)
+        # The peer said goodbye: graceful, not a failure departure — it owes
+        # no heal and must not enter the recovery probe pool.
+        self.neighbors.remove(source_addr, notify=False, departed=False)
 
     def deliver(self, env: Envelope) -> None:
         """Entry point for inbound envelopes (the "RPC")."""

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import random
 import threading
 import time
 from typing import Any, Callable, List, Optional
@@ -58,6 +59,11 @@ _PEER_WRITTEN_OFF = REGISTRY.counter(
     "Neighbors removed after a send failed all its retry attempts",
     labels=("node",),
 )
+_HEALS = REGISTRY.counter(
+    "p2pfl_recovery_heals_total",
+    "Failure-departed peers observed coming back (heal/recover detections)",
+    labels=("node",),
+)
 _DIGEST_BYTES = REGISTRY.counter(
     "p2pfl_digest_bytes_total",
     "Health-digest payload bytes emitted onto heartbeats (per beat) — the "
@@ -65,6 +71,25 @@ _DIGEST_BYTES = REGISTRY.counter(
     "as the fleet grows (sketches, not per-peer scalars)",
     labels=("node",),
 )
+
+
+def jittered_backoff(src: str, dst: str, attempt: int) -> float:
+    """Seeded-jitter retry backoff for gossip sends.
+
+    Pure exponential backoff synchronizes retries: after a partition heals,
+    every survivor that was mid-retry against the returned peer fires again
+    in lockstep (same base, same attempt index), re-colliding forever. The
+    fix is the classic decorrelation jitter — scale the exponential base by
+    a uniform in [0.5, 1.5) — but drawn from a DEDICATED stream seeded by
+    ``(CHAOS_SEED, src, dst, attempt)``, so replays stay deterministic and
+    the chaos plane's per-pair decision streams are never consumed."""
+    base = min(Settings.GOSSIP_SEND_BACKOFF * (2 ** max(0, int(attempt))), 2.0)
+    if base <= 0.0:
+        return 0.0
+    u = random.Random(
+        f"{Settings.CHAOS_SEED}|backoff|{src}->{dst}|{attempt}"
+    ).random()
+    return base * (0.5 + u)
 
 
 def running(fn: Callable) -> Callable:
@@ -114,10 +139,17 @@ class CommunicationProtocol:
             recorder=self.flight_recorder,
         )
         self.heartbeater = Heartbeater(
-            self._addr, self.neighbors, self.broadcast, digest_fn=self._digest_wire
+            self._addr,
+            self.neighbors,
+            self.broadcast,
+            digest_fn=self._digest_wire,
+            probe_fn=self._probe_departed,
         )
         # Dead peers leave the fleet view and the postmortem record together.
         self.neighbors.add_removal_listener(self._observe_peer_removed)
+        # Healed peers re-enter it with fresh scoring state (a returned
+        # partition survivor must not inherit its pre-partition z-scores).
+        self.neighbors.add_recovery_listener(self._observe_peer_recovered)
         # auto-register the heartbeat handler (reference
         # grpc_communication_protocol.py:63-89)
         protocol = self
@@ -179,6 +211,50 @@ class CommunicationProtocol:
     def _observe_peer_removed(self, addr: str) -> None:
         self.observatory.forget(addr)
         self.flight_recorder.record("peer_lost", peer=addr)
+
+    def _observe_peer_recovered(self, addr: str) -> None:
+        """A failure-departed peer demonstrably returned: the heal event.
+        The observatory resets its scoring state (stale pre-partition
+        straggler/link stats must not outlive the partition) and the return
+        is postmortem-worthy."""
+        self.observatory.peer_recovered(addr)
+        self.flight_recorder.record("peer_recovered", peer=addr)
+        _HEALS.labels(self._addr).inc()
+
+    def on_neighbor_recovered(self, fn: Callable[[str], None]) -> None:
+        """Register a heal callback: fired (with the address) whenever a
+        peer that was written off via a failure path comes back — the hook
+        partition-heal reconciliation hangs off (node-level reconcile pings,
+        stages re-evaluating quorum)."""
+        self.neighbors.add_recovery_listener(fn)
+
+    def _probe_departed(self) -> None:
+        """Heal detection (runs on the heartbeater's sweep tick): attempt to
+        re-reach peers that left the table via failure paths. Beats alone
+        cannot re-discover a healed partition — the first blocked send
+        already dropped the only link that would carry them — so the
+        detector must actively knock.
+
+        The probe is a handshake-connect: it respects chaos partitions and
+        crashes via the STATE-ONLY :meth:`ChaosPlane.link_blocked` check
+        (drawing from the per-pair decision streams here would make their
+        replay depend on probe cadence), touches neither side's neighbor
+        table unless the connect round-trips, and fires the recovery
+        listeners only on success."""
+        if not self._running or not Settings.RECOVERY_PROBE_ENABLED:
+            return
+        for addr in self.neighbors.departed(Settings.RECOVERY_PROBE_MAX):
+            if not self._running:
+                return
+            if CHAOS.active and CHAOS.link_blocked(self._addr, addr):
+                continue  # still partitioned/crashed: don't pierce it
+            try:
+                # connect_to performs the transport handshake; failure (peer
+                # still down) leaves both tables untouched, success re-adds
+                # the peer and _note_returned fires the recovery listeners.
+                self.neighbors.add(addr, non_direct=False)
+            except Exception:  # noqa: BLE001 — still dead; keep probing
+                log.debug("(%s) heal probe to %s failed", self._addr, addr)
 
     def export_trace(self, path: str) -> str:
         """Write this PROCESS's span buffer as an annotated Chrome trace.
@@ -283,7 +359,8 @@ class CommunicationProtocol:
 
     @running
     def disconnect(self, addr: str, notify: bool = True) -> None:
-        self.neighbors.remove(addr, notify=notify)
+        # Explicit local disconnect: graceful, never a failure departure.
+        self.neighbors.remove(addr, notify=notify, departed=False)
 
     @running
     def get_neighbors(self, only_direct: bool = False) -> List[str]:
@@ -389,9 +466,7 @@ class CommunicationProtocol:
             except Exception as exc:
                 if attempt + 1 < attempts:
                     _SEND_RETRIES.labels(self._addr).inc()
-                    time.sleep(
-                        min(Settings.GOSSIP_SEND_BACKOFF * (2**attempt), 2.0)
-                    )
+                    time.sleep(jittered_backoff(self._addr, nei, attempt))
                     continue
                 if remove_on_error:
                     _PEER_WRITTEN_OFF.labels(self._addr).inc()

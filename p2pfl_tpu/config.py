@@ -196,6 +196,45 @@ class Settings:
     ASYNC_SUSPECT_GATE: float = _env_float("ASYNC_SUSPECT_GATE", 1.0, 0.0, 1e9)
     ASYNC_STRAGGLER_GATE: float = _env_float("ASYNC_STRAGGLER_GATE", 2.0, 0.0, 1e9)
 
+    # --- durable recovery plane (management/checkpoint.py NodeJournal,
+    # stages/recovery.py, comm heal detection) ------------------------------
+    # Crash-restart resume, partition-heal reconciliation and quorum-aware
+    # degraded mode. All values validated at load with the WIRE_COMPRESSION
+    # fail-fast pattern.
+    #
+    # Quorum fraction of the session's known membership that must be live
+    # (self included) for a node to make vote/window progress. Below it the
+    # node PARKS: no round progress, state journaled, heartbeats keep
+    # running — it unparks when membership recovers instead of burning a
+    # vote timeout per unwinnable round. 0 disables parking.
+    RECOVERY_QUORUM_FRACTION: float = _env_float("RECOVERY_QUORUM_FRACTION", 0.0, 0.0, 1.0)
+    # Poll slice while parked (early-stop and quorum re-checked per slice).
+    RECOVERY_PARK_POLL_S: float = _env_float("RECOVERY_PARK_POLL_S", 0.5, 0.05, 60.0)
+    # Hard cap on one park: on expiry the node unparks and proceeds degraded
+    # (a federation that never heals must still terminate). 0 = park forever.
+    RECOVERY_PARK_MAX_S: float = _env_float("RECOVERY_PARK_MAX_S", 300.0, 0.0, 86400.0)
+    # Write-ahead node-state journal: snapshots retained / cadence in rounds.
+    RECOVERY_JOURNAL_KEEP: int = _env_int("RECOVERY_JOURNAL_KEEP", 3, 1, 100)
+    RECOVERY_JOURNAL_EVERY: int = _env_int("RECOVERY_JOURNAL_EVERY", 1, 1, 1000)
+    # Partition-heal reconciliation: rounds/windows of lead before the ahead
+    # side of a healed split sends its round anchor as a dense catch-up.
+    RECOVERY_RECONCILE_MIN_LEAD: int = _env_int("RECOVERY_RECONCILE_MIN_LEAD", 1, 1, 1000)
+    # Min seconds between reconcile pings to the same recovered peer (heals
+    # fire from several paths at once; the exchange is idempotent but cheap
+    # only when rate-limited).
+    RECOVERY_RECONCILE_COOLDOWN_S: float = _env_float(
+        "RECOVERY_RECONCILE_COOLDOWN_S", 1.0, 0.0, 3600.0
+    )
+    # Heal detection: the heartbeater's sweep re-probes peers that left the
+    # table via FAILURE paths (heartbeat timeout, send write-off) — a healed
+    # partition cannot re-announce itself on beats alone, because the first
+    # failed send already dropped the only link that would carry them.
+    # Probes respect chaos partitions/crashes and fire the recovery
+    # listeners only on a confirmed round-trip. RECOVERY_PROBE_MAX bounds
+    # the addresses probed per sweep.
+    RECOVERY_PROBE_ENABLED: bool = _env_override("RECOVERY_PROBE_ENABLED", True)
+    RECOVERY_PROBE_MAX: int = _env_int("RECOVERY_PROBE_MAX", 8, 1, 1024)
+
     # --- learning round -----------------------------------------------------
     TRAIN_SET_SIZE: int = _env_override("TRAIN_SET_SIZE", 4)
     VOTE_TIMEOUT: float = _env_override("VOTE_TIMEOUT", 60.0)

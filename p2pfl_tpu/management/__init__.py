@@ -1,6 +1,11 @@
 """Management: logging, metrics, monitoring, telemetry, checkpointing."""
 
-__all__ = ["FLCheckpointer", "attach_node_checkpointing"]
+__all__ = [
+    "FLCheckpointer",
+    "NodeJournal",
+    "attach_node_checkpointing",
+    "attach_node_journal",
+]
 
 
 def __getattr__(name: str):

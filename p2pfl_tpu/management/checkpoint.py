@@ -17,6 +17,7 @@ and keeps the last ``max_to_keep`` steps.
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Any, Dict, List, Optional
 
@@ -24,7 +25,39 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+log = logging.getLogger("p2pfl_tpu")
+
 Pytree = Any
+
+from p2pfl_tpu.telemetry import REGISTRY  # noqa: E402  (after orbax guard docs)
+
+_JOURNAL_SAVES = REGISTRY.counter(
+    "p2pfl_recovery_journal_saves_total",
+    "Write-ahead recovery-journal snapshots committed to disk",
+    labels=("node",),
+)
+
+#: Orbax's per-step commit marker: written as the final act of a save (the
+#: step directory itself lands via write-to-temp + atomic rename). A step
+#: directory without it is TORN — a crash interrupted the save — and must be
+#: invisible to ``latest_step``/``restore`` instead of poisoning recovery.
+_COMMIT_MARKER = "_CHECKPOINT_METADATA"
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory fd so a completed atomic rename survives power loss
+    (the rename itself is atomic but not durable until the directory entry
+    is flushed). Best-effort: not every filesystem supports dir fsync."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 class FLCheckpointer:
@@ -51,18 +84,42 @@ class FLCheckpointer:
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 enable_async_checkpointing=True,
+                # A crash mid-save leaves a tmp-staged step; sweep stale tmp
+                # directories at (re)open so a restarted process never
+                # accumulates them.
+                cleanup_tmp_directories=True,
             ),
         )
+
+    # --- crash safety --------------------------------------------------------
+
+    def _step_complete(self, step: int) -> bool:
+        """A step is trustworthy only once its commit marker exists. Orbax
+        stages every save in a temp directory and atomically renames it into
+        place (write-to-temp + rename), writing the marker as the final act
+        — so a torn/partial step directory (crash mid-save, or a bare
+        directory a crashed rename left behind) is detectable and must be
+        SKIPPED, never restored from."""
+        d = os.path.join(self.directory, str(step))
+        return os.path.isdir(d) and os.path.exists(os.path.join(d, _COMMIT_MARKER))
 
     # --- generic pytree + metadata ------------------------------------------
 
     def save(self, step: int, state: Pytree, meta: Optional[Dict[str, Any]] = None) -> bool:
         """Save ``state`` (pytree of arrays) + JSON-able ``meta`` at ``step``.
 
+        Crash-safe: the save is staged in a temp directory and atomically
+        renamed into place with a trailing commit marker; :meth:`wait`
+        additionally fsyncs the directory entries so the rename is durable.
+        A crash at ANY point mid-save leaves either no step directory or a
+        torn one — and torn steps are skipped by ``restore``/``latest_step``
+        instead of raising, so a crash mid-save can never poison recovery.
+
         Returns False (and skips) when the step is off the save interval.
         """
         if step % self.save_interval != 0:
             return False
+        self._drain_finalize()
         self._mngr.save(
             step,
             args=ocp.args.Composite(
@@ -72,16 +129,64 @@ class FLCheckpointer:
         )
         return True
 
+    def _drain_finalize(self) -> None:
+        """Join any in-flight async save before issuing the next one.
+
+        Orbax clears its finalize-thread handle only when ``wait`` is called
+        from the THREAD that requested the save — but a crash-restarted node
+        journals from a fresh workflow thread, and the handle the dead
+        thread left behind trips ``save``'s internal assertion forever.
+        After the join returns, clear the dead handle ourselves (guarded,
+        best-effort: private attrs of the pinned orbax version)."""
+        try:
+            self._mngr.wait_until_finished()
+            lock = getattr(self._mngr, "_finalize_thread_lock", None)
+            if lock is None:
+                return
+            with lock:
+                ft = getattr(self._mngr, "_finalize_thread", None)
+                if ft is not None and not ft.is_alive():
+                    self._mngr._finalize_thread = None
+        except Exception:  # noqa: BLE001 — degrade to orbax's own behavior
+            log.debug("checkpoint finalize drain failed", exc_info=True)
+
     def restore(self, template: Pytree, step: Optional[int] = None):
-        """Restore (state, meta) at ``step`` (default: latest).
+        """Restore (state, meta) at ``step`` (default: newest restorable).
 
         ``template`` supplies structure/shapes/shardings: device arrays in it
         are restored onto their existing shardings (a resumed mesh run stays
         sharded over the same mesh).
+
+        With ``step=None``, torn or unreadable snapshots are skipped: the
+        restore walks complete steps newest-first and returns the first one
+        that loads, raising :class:`FileNotFoundError` only when none does.
         """
-        step = self.latest_step() if step is None else step
         if step is None:
-            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+            candidates = sorted(self.all_steps(), reverse=True)
+            if not candidates:
+                raise FileNotFoundError(f"no checkpoints under {self.directory}")
+            last_exc: Optional[Exception] = None
+            for s in candidates:
+                try:
+                    return self._restore_step(template, s)
+                except Exception as exc:  # noqa: BLE001 — torn step: try older
+                    last_exc = exc
+                    log.warning(
+                        "checkpoint step %s under %s unreadable (%s) — "
+                        "falling back to the previous snapshot",
+                        s, self.directory, exc,
+                    )
+            raise FileNotFoundError(
+                f"no restorable checkpoint under {self.directory} "
+                f"(last error: {last_exc})"
+            )
+        if not self._step_complete(step):
+            raise FileNotFoundError(
+                f"checkpoint step {step} under {self.directory} is torn/absent"
+            )
+        return self._restore_step(template, step)
+
+    def _restore_step(self, template: Pytree, step: int):
         restored = self._mngr.restore(
             step,
             args=ocp.args.Composite(
@@ -102,16 +207,31 @@ class FLCheckpointer:
         return state, dict(restored["meta"] or {})
 
     def restore_meta(self, step: Optional[int] = None) -> dict:
-        """Restore ONLY the JSON meta record at ``step`` (default: latest).
+        """Restore ONLY the JSON meta record at ``step`` (default: newest
+        restorable — torn steps are skipped like :meth:`restore` does).
 
         Lets callers validate configuration pins (optimizer rule, DP
         parameters) BEFORE committing to the heavy structural restore — a
         mismatched template would otherwise surface as an opaque pytree
         structure error instead of the pin's explanatory ValueError.
         """
-        step = self.latest_step() if step is None else step
         if step is None:
+            for s in sorted(self.all_steps(), reverse=True):
+                try:
+                    restored = self._mngr.restore(
+                        s, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
+                    )
+                    return dict(restored["meta"] or {})
+                except Exception as exc:  # noqa: BLE001 — torn step: try older
+                    log.warning(
+                        "checkpoint meta at step %s under %s unreadable (%s) "
+                        "— falling back", s, self.directory, exc,
+                    )
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        if not self._step_complete(step):
+            raise FileNotFoundError(
+                f"checkpoint step {step} under {self.directory} is torn/absent"
+            )
         restored = self._mngr.restore(
             step, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
         )
@@ -140,14 +260,24 @@ class FLCheckpointer:
     # --- bookkeeping ---------------------------------------------------------
 
     def latest_step(self) -> Optional[int]:
-        return self._mngr.latest_step()
+        steps = self.all_steps()
+        return max(steps) if steps else None
 
     def all_steps(self) -> List[int]:
-        return list(self._mngr.all_steps())
+        """Complete (committed) steps only — torn directories a crash left
+        behind are invisible here, so they can never be selected as the
+        resume point."""
+        return [s for s in self._mngr.all_steps() if self._step_complete(s)]
 
     def wait(self) -> None:
-        """Block until in-flight async saves land."""
+        """Block until in-flight async saves land, then fsync the committed
+        step directories' entries (the atomic rename is durable only once
+        the parent directory is flushed)."""
         self._mngr.wait_until_finished()
+        _fsync_dir(self.directory)
+        latest = self.latest_step()
+        if latest is not None:
+            _fsync_dir(os.path.join(self.directory, str(latest)))
 
     def close(self) -> None:
         self._mngr.wait_until_finished()
@@ -158,6 +288,199 @@ class FLCheckpointer:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+class NodeJournal:
+    """Write-ahead node-state journal: the durable-recovery closure of one
+    federated node, snapshotted atomically per round/window.
+
+    Where :func:`attach_node_checkpointing` snapshots only the MODEL, the
+    journal captures everything :meth:`p2pfl_tpu.node.Node.resume` needs to
+    bring a crashed node back *as itself* mid-experiment (Papaya treats
+    restarts as the normal operating condition; APPFL makes restartability a
+    framework capability):
+
+    * model params + contributor metadata,
+    * the sparse-delta wire state — round anchor AND error-feedback
+      residuals (``comm/delta.py``), restored bit-exact so sparse frames for
+      the journaled round keep decoding and no transmitted mass is lost,
+    * round/window position, scheduler mode, epochs, total rounds,
+    * known membership + per-peer round status, so the resumed node can
+      reconnect and re-enter the stage machine where it left off.
+
+    Steps are indexed by round; saves ride :class:`FLCheckpointer`'s
+    crash-safe path (temp-staged, atomically renamed, commit-marked), so a
+    crash mid-journal leaves the previous snapshot restorable.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: Optional[int] = None,
+        every: Optional[int] = None,
+    ) -> None:
+        from p2pfl_tpu.config import Settings
+
+        self._ck = FLCheckpointer(
+            directory,
+            max_to_keep=max_to_keep or Settings.RECOVERY_JOURNAL_KEEP,
+            save_interval=1,
+        )
+        self.every = max(1, int(every or Settings.RECOVERY_JOURNAL_EVERY))
+
+    @property
+    def directory(self) -> str:
+        return self._ck.directory
+
+    # --- write side ----------------------------------------------------------
+
+    def snapshot(self, node) -> bool:
+        """Journal ``node``'s full recovery closure at its current round.
+        No-op (False) outside an experiment or when this round is already
+        journaled."""
+        state = node.state
+        r = state.round
+        if state.experiment is None or r is None:
+            return False
+        if r in self._ck.all_steps():
+            return False  # this position is already durable
+        model = node.learner.get_model()
+        wire_st = state.wire.export_state()
+        tree: Dict[str, Any] = {
+            "params": [np.asarray(p) for p in model.get_parameters()]
+        }
+        if wire_st["anchor"] is not None:
+            tree["anchor"] = wire_st["anchor"]
+        if wire_st["residual"] is not None:
+            tree["residual"] = wire_st["residual"]
+        try:
+            membership = list(node.protocol.get_neighbors(only_direct=False))
+        except Exception:  # noqa: BLE001 — protocol stopping; journal anyway
+            membership = []
+        meta = {
+            "journal_version": 1,
+            "addr": node.addr,
+            "round": int(r),
+            "total_rounds": int(state.total_rounds or 0),
+            "epochs": int(state.epochs),
+            "fed_mode": state.fed_mode,
+            "exp_name": state.experiment.exp_name,
+            "anchor_round": int(wire_st["anchor_round"]),
+            "anchor_crc": int(wire_st["anchor_crc"]),
+            "anchor_shapes": [list(s) for s in (wire_st["shapes"] or [])],
+            "has_anchor": wire_st["anchor"] is not None,
+            "has_residual": wire_st["residual"] is not None,
+            "membership": membership,
+            "nei_status": {k: int(v) for k, v in state.nei_status.items()},
+            "contributors": list(model.contributors),
+            "num_samples": int(model.get_num_samples()),
+        }
+        saved = self._ck.save(int(r), tree, meta)
+        if saved:
+            _JOURNAL_SAVES.labels(node.addr).inc()
+            try:
+                node.protocol.flight_recorder.record(
+                    "journal", round=int(r), steps=len(self._ck.all_steps())
+                )
+            except Exception:  # noqa: BLE001 — observability must not raise
+                pass
+        return saved
+
+    # --- read side -----------------------------------------------------------
+
+    def latest_meta(self) -> Dict[str, Any]:
+        """Newest restorable snapshot's metadata (raises FileNotFoundError
+        when the journal is empty; torn steps are skipped)."""
+        return self._ck.restore_meta()
+
+    def restore_into(self, node) -> Dict[str, Any]:
+        """Load the newest restorable snapshot into ``node``: model params +
+        contribution, delta anchor + EF residuals (bit-exact), and per-peer
+        round status. Walks older snapshots when the newest is torn. Returns
+        the snapshot metadata (also stashed as ``node._resume_meta`` for
+        :meth:`p2pfl_tpu.node.Node.resume_learning`)."""
+        steps = sorted(self._ck.all_steps(), reverse=True)
+        last_exc: Optional[Exception] = None
+        for step in steps:
+            try:
+                meta = self._ck.restore_meta(step)
+                model = node.learner.get_model()
+                tree_t: Dict[str, Any] = {
+                    "params": [np.asarray(p) for p in model.get_parameters()]
+                }
+                flat_sizes = [
+                    int(np.prod(s, dtype=np.int64)) if s else 1
+                    for s in meta.get("anchor_shapes") or []
+                ]
+                if meta.get("has_anchor"):
+                    tree_t["anchor"] = [np.zeros((n,), np.float32) for n in flat_sizes]
+                if meta.get("has_residual"):
+                    tree_t["residual"] = [np.zeros((n,), np.float32) for n in flat_sizes]
+                tree, _ = self._ck.restore(tree_t, step)
+                model.set_parameters([np.asarray(p) for p in tree["params"]])
+                model.set_contribution(
+                    list(meta.get("contributors") or [node.addr]),
+                    int(meta.get("num_samples", 1)),
+                )
+                shapes = [tuple(s) for s in meta.get("anchor_shapes") or []]
+                node.state.wire.import_state(
+                    {
+                        "anchor": tree.get("anchor"),
+                        "shapes": shapes or None,
+                        "anchor_round": meta.get("anchor_round", -1),
+                        "anchor_crc": meta.get("anchor_crc", 0),
+                        "residual": tree.get("residual"),
+                    }
+                )
+                node.state.nei_status.update(
+                    {k: int(v) for k, v in (meta.get("nei_status") or {}).items()}
+                )
+                node._resume_meta = dict(meta)
+                return dict(meta)
+            except Exception as exc:  # noqa: BLE001 — torn step: fall back
+                last_exc = exc
+                log.warning(
+                    "journal step %s under %s unrestorable (%s) — trying the "
+                    "previous snapshot", step, self.directory, exc,
+                )
+        raise FileNotFoundError(
+            f"no restorable journal under {self.directory} "
+            f"(last error: {last_exc})"
+        )
+
+    # --- bookkeeping ---------------------------------------------------------
+
+    def all_steps(self) -> List[int]:
+        return self._ck.all_steps()
+
+    def wait(self) -> None:
+        self._ck.wait()
+
+    def close(self) -> None:
+        self._ck.close()
+
+    def __enter__(self) -> "NodeJournal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def attach_node_journal(node, journal: NodeJournal) -> None:
+    """Durable recovery: journal the node's full recovery closure at every
+    ``journal.every``-th round end (and expose the journal on the node so
+    quorum parking can snapshot on demand — ``Node.journal_now``)."""
+    node.recovery_journal = journal
+
+    def hook(n) -> None:
+        r = n.state.round
+        if r is None:
+            return
+        total = n.state.total_rounds or 0
+        if r % journal.every == 0 or r >= total:
+            journal.snapshot(n)
+
+    node.round_end_hooks.append(hook)
 
 
 def attach_node_checkpointing(node, checkpointer: FLCheckpointer) -> None:

@@ -32,6 +32,8 @@ from p2pfl_tpu.comm.commands.impl import (
     ModelsAggregatedCommand,
     ModelsReadyCommand,
     PartialModelCommand,
+    ReconcileCommand,
+    ReconcileModelCommand,
     StartLearningCommand,
     StopLearningCommand,
     VoteTrainSetCommand,
@@ -108,6 +110,13 @@ class Node:
         # Fired (with this node) after each round completes; used by e.g.
         # checkpoint.attach_node_checkpointing.
         self.round_end_hooks: List = []
+        # Durable recovery plane: the write-ahead journal (set by
+        # checkpoint.attach_node_journal / Node.resume) and the restored
+        # snapshot metadata resume_learning re-enters the experiment from.
+        self.recovery_journal = None
+        self._resume_meta: Optional[dict] = None
+        # Rate limit for reconcile pings per recovered peer.
+        self._reconcile_ping_at: dict = {}
 
         # Round-survival: any neighbor removal (heartbeat-declared death,
         # send-failure write-off, disconnect) shrinks this round's
@@ -115,6 +124,9 @@ class Node:
         # condition and partial-gossip candidate sets all re-evaluate
         # instead of sleeping out their fixed timeouts.
         self.protocol.on_neighbor_removed(self._on_peer_death)
+        # Partition heal: a failure-departed peer coming back triggers the
+        # reconcile progress exchange (ahead side ships dense catch-up).
+        self.protocol.on_neighbor_recovered(self._on_peer_heal)
 
         # Federation observatory: replace the protocol's registry-only
         # digest source with the state-aware one (round/stage/total_rounds
@@ -148,6 +160,10 @@ class Node:
                 AsyncWelcomeCommand(self),
                 AsyncCatchupCommand(self),
                 AsyncDoneCommand(self),
+                # Durable recovery plane (stages/recovery.py): partition-heal
+                # progress exchange + dense catch-up adoption.
+                ReconcileCommand(self),
+                ReconcileModelCommand(self),
             ]
         )
 
@@ -308,13 +324,17 @@ class Node:
         epochs: int,
         mode: str = "sync",
         start_round: int = 0,
+        resuming: bool = False,
     ) -> None:
         """Spawn the stage machine on a daemon thread (idempotent per
         session; also the handler body of the start_learning command).
 
         ``mode`` picks the scheduler over the shared stage machine
         (``scheduler_start_stage``); ``start_round`` fast-forwards a
-        mid-experiment async joiner to the window its welcome reported."""
+        mid-experiment async joiner to the window its welcome reported;
+        ``resuming`` enters through :class:`~p2pfl_tpu.stages.recovery.
+        ResumeStage` instead — the crash-restart path, which re-announces
+        the journaled identity and skips session bootstrap entirely."""
         with self.state.start_thread_lock:
             if self.learning_in_progress():
                 return
@@ -341,7 +361,13 @@ class Node:
                 self.async_agg = AsyncBufferedAggregator(self.addr, rule)
             logger.experiment_started(self.addr, self.state.experiment)
             self.learner.set_epochs(epochs)
-            self._workflow = LearningWorkflow(scheduler_start_stage(mode))
+            if resuming:
+                from p2pfl_tpu.stages.recovery import ResumeStage
+
+                start_stage = ResumeStage
+            else:
+                start_stage = scheduler_start_stage(mode)
+            self._workflow = LearningWorkflow(start_stage)
             self._learning_thread = threading.Thread(
                 target=self._workflow.run,
                 kwargs={"node": self},
@@ -349,6 +375,99 @@ class Node:
                 daemon=True,
             )
             self._learning_thread.start()
+
+    # --- durable recovery (management/checkpoint.py NodeJournal) -------------
+
+    @classmethod
+    def resume(
+        cls,
+        model: ModelHandle,
+        data: FederatedDataset,
+        journal,
+        addr: Optional[str] = None,
+        **kwargs,
+    ) -> "Node":
+        """Rebuild a crashed node from its write-ahead journal — AS ITSELF.
+
+        The journal's newest restorable snapshot supplies the identity
+        (address), model params, sparse-delta anchor + error-feedback
+        residuals (bit-exact), round/window position and known membership.
+        The returned node is constructed but not started; the full restart
+        sequence is::
+
+            node = Node.resume(fresh_model, data, journal)
+            node.start()
+            node.resume_learning()   # reconnect + re-enter mid-experiment
+
+        ``journal`` is a :class:`~p2pfl_tpu.management.checkpoint.
+        NodeJournal`; it stays attached, so the resumed node keeps
+        journaling from where it left off.
+        """
+        from p2pfl_tpu.management.checkpoint import attach_node_journal
+
+        meta = journal.latest_meta()
+        node = cls(model, data, addr=addr or meta.get("addr"), **kwargs)
+        journal.restore_into(node)
+        attach_node_journal(node, journal)
+        return node
+
+    def resume_learning(self) -> None:
+        """Re-enter the journaled experiment mid-flight: reconnect to the
+        journaled membership, then run the scheduler from the journaled
+        round/window through :class:`~p2pfl_tpu.stages.recovery.ResumeStage`
+        (which re-announces this identity to the fleet). Requires a prior
+        :meth:`resume` (or ``NodeJournal.restore_into``) and a started
+        node."""
+        meta = self._resume_meta
+        if not meta:
+            raise ValueError(
+                f"{self.addr}: no journal snapshot restored — build the node "
+                "via Node.resume(...) first"
+            )
+        for peer in meta.get("membership") or []:
+            if peer == self.addr:
+                continue
+            try:
+                self.protocol.connect(peer)
+            except Exception:  # noqa: BLE001 — that peer may be gone too
+                logger.warning(self.addr, f"resume reconnect to {peer} failed")
+        total = int(meta.get("total_rounds") or 0)
+        start_round = int(meta.get("round") or 0)
+        if total <= 0 or start_round >= total:
+            logger.warning(
+                self.addr,
+                f"journal is at round {start_round}/{total} — nothing to resume",
+            )
+            return
+        self.start_learning_thread(
+            total,
+            int(meta.get("epochs") or 1),
+            mode=meta.get("fed_mode") or "sync",
+            start_round=start_round,
+            resuming=True,
+        )
+        # Quorum baseline: the journaled membership is the session's known
+        # fleet (set_experiment reset it to {self}).
+        self.state.session_members |= set(meta.get("membership") or [])
+        # Announce our journaled position to every reconnected peer: while
+        # we were down the federation moved on, and whichever peer is ahead
+        # replies with its round anchor as a dense catch-up — the resumed
+        # node folds back in within a round instead of limping behind the
+        # fleet (the heal pings peers sent while we were still booting hit
+        # an experiment-less node and were rightly ignored).
+        for peer in meta.get("membership") or []:
+            self.send_reconcile_ping(peer)
+
+    def journal_now(self) -> None:
+        """Snapshot the recovery closure on demand (quorum parking journals
+        before going quiet). No-op without an attached journal."""
+        journal = self.recovery_journal
+        if journal is None:
+            return
+        try:
+            journal.snapshot(self)
+        except Exception as e:  # noqa: BLE001 — journaling must not kill stages
+            logger.warning(self.addr, f"journal snapshot failed: {e!r}")
 
     def request_async_join(self) -> None:
         """Ask a running elastic async federation to take this node in:
@@ -397,6 +516,49 @@ class Node:
         rec = self.protocol.flight_recorder
         rec.record("agg_stall", missing=list(missing), round=self.state.round)
         rec.dump("stall")
+
+    def _on_peer_heal(self, addr: str) -> None:
+        """Heal callback (runs on the probing/handshake thread): a peer we
+        wrote off came back. Exchange round/window progress so a healed
+        split reconciles — each side pings its position; whichever side is
+        ahead ships its round anchor as dense catch-up (ReconcileCommand).
+        Rate-limited per peer; both sides ping, so one lost frame only
+        delays the exchange by the peer's own ping."""
+        self.send_reconcile_ping(addr)
+
+    def send_reconcile_ping(self, addr: str) -> bool:
+        """Tell ``addr`` our round/window position so whichever side of a
+        heal is ahead ships its dense catch-up. Rate-limited per peer via
+        ``RECOVERY_RECONCILE_COOLDOWN_S``; no-op outside an experiment."""
+        state = self.state
+        if state.experiment is None or state.round is None or addr == self.addr:
+            return False
+        now = time.monotonic()
+        if now - self._reconcile_ping_at.get(addr, 0.0) < Settings.RECOVERY_RECONCILE_COOLDOWN_S:
+            return False
+        self._reconcile_ping_at[addr] = now
+        state.session_members.add(addr)
+        try:
+            self.protocol.send(
+                addr,
+                self.protocol.build_msg(
+                    ReconcileCommand.get_name(),
+                    args=[str(state.round), state.fed_mode],
+                    round=state.round,
+                ),
+                create_connection=True,
+                raise_error=False,
+                remove_on_error=False,
+            )
+        except Exception:  # noqa: BLE001 — the peer may flap right back out
+            return False
+        from p2pfl_tpu.stages.recovery import reconcile_metric
+
+        reconcile_metric(self.addr, "ping_tx")
+        self.protocol.flight_recorder.record(
+            "reconcile", role="ping_tx", peer=addr, round=state.round
+        )
+        return True
 
     def _on_peer_death(self, addr: str) -> None:
         """Death callback (runs on the heartbeater/transport thread that

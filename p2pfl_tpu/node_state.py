@@ -80,6 +80,22 @@ class NodeState:
         # would burn the window timeout (the last-node-standing case).
         self.async_done_peers: set = set()
 
+        # --- durable recovery plane (stages/recovery.py) --------------------
+        # True while the node is PARKED in quorum-aware degraded mode: below
+        # the live-peer quorum it makes no vote/window progress (heartbeats
+        # continue, state is journaled) instead of burning timeout rounds.
+        self.parked: bool = False
+        # Every address (self included) seen live during this experiment —
+        # the quorum denominator. Grows monotonically per session; reset by
+        # set_experiment.
+        self.session_members: set = set()
+        # Partition-heal reconciliation: a dense catch-up model offered by
+        # the ahead side of a healed split, adopted ATOMICALLY at the next
+        # round boundary (applying it mid-stage would race the stage's own
+        # model writes). {"round", "params", "contributors", "source"}.
+        self._reconcile_lock = threading.Lock()
+        self._pending_reconcile: Optional[Dict[str, Any]] = None
+
         # Learning info (populated by commands / stages).
         self.models_aggregated: Dict[str, List[str]] = {}
         self.nei_status: Dict[str, int] = {}
@@ -124,6 +140,50 @@ class NodeState:
             if round > self.last_full_model_round:
                 self.last_full_model_round = round
 
+    # --- partition-heal reconciliation (stages/recovery.py) -----------------
+
+    def offer_reconcile(
+        self, round: int, params: Any, contributors: List[str], source: str
+    ) -> bool:
+        """Store a reconcile catch-up (transport thread). Kept only when it
+        is ahead of both the current round and any already-pending offer —
+        the freshest generation wins, stale offers are dropped."""
+        with self._reconcile_lock:
+            current = self.round
+            if current is None or round <= current:
+                return False
+            if (
+                self._pending_reconcile is not None
+                and round <= self._pending_reconcile["round"]
+            ):
+                return False
+            self._pending_reconcile = {
+                "round": int(round),
+                "params": params,
+                "contributors": list(contributors),
+                "source": source,
+            }
+            return True
+
+    def reconcile_ahead(self) -> bool:
+        """True when a pending catch-up targets a round ahead of us — the
+        signal sliced stage waits use to wind the current round down fast."""
+        with self._reconcile_lock:
+            return (
+                self._pending_reconcile is not None
+                and self.round is not None
+                and self._pending_reconcile["round"] > self.round
+            )
+
+    def take_reconcile(self) -> Optional[Dict[str, Any]]:
+        """Pop the pending catch-up iff still ahead of the current round
+        (stale offers — we caught up naturally — are discarded)."""
+        with self._reconcile_lock:
+            p, self._pending_reconcile = self._pending_reconcile, None
+            if p is None or self.round is None or p["round"] <= self.round:
+                return None
+            return p
+
     # --- round bookkeeping (proxied off Experiment; reference :84-97) -------
 
     @property
@@ -138,6 +198,10 @@ class NodeState:
         """Start (or restart) an experiment and flip status to Learning."""
         self.status = "Learning"
         self.async_done_peers = set()
+        self.parked = False
+        self.session_members = {self.addr}
+        with self._reconcile_lock:
+            self._pending_reconcile = None
         self.experiment = Experiment(exp_name=exp_name, total_rounds=total_rounds)
 
     def increase_round(self) -> None:

@@ -151,11 +151,26 @@ class AsyncWindowStage(Stage):
     @staticmethod
     def execute(node: "Node") -> Optional[Type[Stage]]:
         from p2pfl_tpu.management.profiler import device_trace_window
+        from p2pfl_tpu.stages.recovery import (
+            apply_pending_reconcile,
+            park_until_quorum,
+        )
 
         state = node.state
         agg = node.async_agg
         if agg is None:  # stopped under our feet
             return None
+        # Quorum-aware degraded mode: below the live-peer quorum, park
+        # between windows (state journaled, heartbeats + heal probes keep
+        # running) instead of closing empty windows on the timeout.
+        if not park_until_quorum(node):
+            return None
+        # Partition-heal catch-up: adopt the ahead side's generation and
+        # fast-forward the window counter, then run this window from the
+        # fresh model — no committee bookkeeping to skip in async mode, and
+        # both halves' in-flight contributions keep folding through the
+        # staleness-weighted buffer.
+        apply_pending_reconcile(node)
         w = state.round or 0
         t0 = time.perf_counter()
         agg.open_window(w)

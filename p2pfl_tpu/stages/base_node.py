@@ -124,7 +124,22 @@ class VoteTrainSetStage(Stage):
 
     @staticmethod
     def execute(node: "Node") -> Optional[Type[Stage]]:
+        from p2pfl_tpu.stages.recovery import (
+            apply_pending_reconcile,
+            park_until_quorum,
+        )
+
         state = node.state
+        # Quorum-aware degraded mode: below the live-peer quorum, park here
+        # (no vote progress, state journaled, heartbeats + heal probes keep
+        # running) instead of burning a vote timeout per unwinnable round.
+        if not park_until_quorum(node):
+            return None
+        # Partition-heal catch-up lands at the round boundary: adopt the
+        # ahead side's generation, fast-forward, and sit the jump round out
+        # as a non-trainer (its committee was elected before we returned).
+        if apply_pending_reconcile(node):
+            return WaitAggregatedModelsStage
         if check_early_stop(node):
             return None
 
@@ -165,6 +180,14 @@ class VoteTrainSetStage(Stage):
                     break
                 if time.time() >= deadline:
                     log.info("%s: vote timeout — missing %s", node.addr, expected - have)
+                    break
+                if state.reconcile_ahead():
+                    # A healed peer's catch-up targets a later round: this
+                    # round belongs to a dead branch — wind it down now.
+                    log.info(
+                        "%s: reconcile catch-up pending — abandoning the "
+                        "round-%s vote wait", node.addr, state.round,
+                    )
                     break
                 # Short slices: the deadline overshoot is bounded by one
                 # slice, so the stage ends within ~VOTE_TIMEOUT even when the
@@ -370,6 +393,11 @@ class WaitAggregatedModelsStage(Stage):
                             break
                         if check_early_stop(node):
                             return None
+                        if state.reconcile_ahead():
+                            # A fresher generation is staged for adoption at
+                            # the next round boundary — stop waiting for this
+                            # dead branch's full model.
+                            break
                         live = set(
                             node.protocol.get_neighbors(only_direct=False)
                         ) | {node.addr}

@@ -145,6 +145,18 @@ class Observatory:
         #: surfaced in the snapshot so ``fed_top`` shows churn live.
         self._membership: deque = deque(maxlen=MEMBERSHIP_EVENTS)
         self._ever_seen: set = set()
+        #: peers that left via forget (suspected death) or TTL eviction —
+        #: their NEXT appearance is a "recover" heal, not a plain rejoin,
+        #: and their scoring state starts fresh.
+        self._forgotten: set = set()
+        #: peers whose "recover" event was already emitted (explicit
+        #: peer_recovered from the heal detector) — the digest that follows
+        #: must not emit a second membership event.
+        self._returned: set = set()
+        #: peer -> missed-beat counter value at its last recovery: the link
+        #: score reads misses ABOVE this baseline, so a healed peer does not
+        #: inherit every beat the partition ate.
+        self._link_baseline: Dict[str, float] = {}
         #: optional flight recorder — membership transitions are postmortem-
         #: worthy events (Node/protocol wire the per-node recorder in).
         self.recorder = recorder
@@ -200,9 +212,20 @@ class Observatory:
                 if len(self._peers) >= max(8, int(Settings.OBS_MAX_TRACKED)):
                     self._fold_overflow(dig)
                     return False
-                self._membership_event(
-                    "rejoin" if dig.node in self._ever_seen else "join", dig.node
-                )
+                if dig.node in self._returned:
+                    # The heal detector already announced this recovery and
+                    # reset the peer's stats — no second membership event.
+                    self._returned.discard(dig.node)
+                elif dig.node in self._forgotten:
+                    # Reappearance after suspected death / TTL eviction: a
+                    # heal. Scoring state starts fresh — stale pre-partition
+                    # z-stats must not outlive the partition.
+                    self._recover_locked(dig.node)
+                else:
+                    self._membership_event(
+                        "rejoin" if dig.node in self._ever_seen else "join",
+                        dig.node,
+                    )
             self._ever_seen.add(dig.node)
             self._peers[dig.node] = (dig, now)
             entry = self._entries.get(dig.node)
@@ -262,6 +285,7 @@ class Observatory:
                 if peer != self._addr and now - seen > ttl:
                     self._peers.pop(peer, None)
                     self._entries.pop(peer, None)
+                    self._forgotten.add(peer)  # a return after TTL is a heal
                     evicted.append(peer)
                     self._membership_event("evict", peer)
         for _ in evicted:
@@ -274,6 +298,28 @@ class Observatory:
             self._entries.pop(peer, None)
             if known:
                 self._membership_event("leave", peer)
+                self._forgotten.add(peer)
+        self._refresh()
+
+    def _recover_locked(self, peer: str) -> None:
+        """Heal bookkeeping (caller holds the lock): emit the "recover"
+        membership event (mirrored to the flight recorder like every other
+        membership transition) and reset the peer's scoring state — its
+        round-entry clock restarts, and the link score's missed-beat
+        baseline moves to NOW so partition-era misses stop counting."""
+        self._forgotten.discard(peer)
+        self._entries.pop(peer, None)
+        self._link_baseline[peer] = self._missed_beats(peer)
+        self._membership_event("recover", peer)
+
+    def peer_recovered(self, peer: str) -> None:
+        """Explicit heal notification (the protocol's heal detector saw a
+        failure-departed peer come back): announce the recovery and reset
+        the peer's scoring state. The digest that follows re-populates the
+        table without a duplicate membership event."""
+        with self._lock:
+            self._recover_locked(peer)
+            self._returned.add(peer)
         self._refresh()
 
     # --- derived health ------------------------------------------------------
@@ -366,15 +412,25 @@ class Observatory:
             }
         return out
 
+    def _missed_beats(self, peer: str) -> float:
+        missed = REGISTRY.get("p2pfl_heartbeat_missed_total")
+        if missed is None:
+            return 0.0
+        return sum(
+            child.value
+            for labels, child in missed.samples()
+            if labels.get("node") == self._addr and labels.get("peer") == peer
+        )
+
     def _link_score(self, peer: str) -> float:
         """Missed beats + |clock skew| for OUR link to ``peer`` (heartbeater
-        gauges — already computed locally, not gossiped)."""
-        score = 0.0
-        missed = REGISTRY.get("p2pfl_heartbeat_missed_total")
-        if missed is not None:
-            for labels, child in missed.samples():
-                if labels.get("node") == self._addr and labels.get("peer") == peer:
-                    score += child.value
+        gauges — already computed locally, not gossiped). Misses below the
+        peer's recovery baseline don't count: a healed partition survivor
+        starts its link score fresh instead of inheriting every beat the
+        partition ate."""
+        score = max(
+            0.0, self._missed_beats(peer) - self._link_baseline.get(peer, 0.0)
+        )
         skew = REGISTRY.get("p2pfl_heartbeat_clock_skew_seconds")
         if skew is not None:
             for labels, child in skew.samples():
@@ -572,6 +628,9 @@ class Observatory:
             self._entries.clear()
             self._membership.clear()
             self._ever_seen.clear()
+            self._forgotten.clear()
+            self._returned.clear()
+            self._link_baseline.clear()
             self._overflow_sketches.clear()
             self._overflow_top.clear()
             self._overflow_seen.clear()

@@ -1,0 +1,151 @@
+"""CI gate: 3-node in-memory federation with write-ahead journals — one node
+is killed mid-round, then RESUMED from its journal as the same address; the
+resumed identity must re-enter the stage machine, run real training rounds,
+and the whole federation (resumed node included) must finish within the wall
+budget. Fast, CPU-only, tier-1-safe — invoked by ``make recovery-check``.
+
+Exit 0 when every check passes; nonzero with a reason on stderr otherwise.
+"""
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import time  # noqa: E402
+
+ROUNDS = 4
+#: Wall budget for the whole learning run including the kill + resume.
+#: Generous for a loaded 1-core CI box, far below what timeout-burning
+#: (rounds x vote/aggregation deadlines) would need.
+WALL_BUDGET_S = 120.0
+
+
+def main() -> int:
+    from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+    from p2pfl_tpu.config import Settings
+    from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from p2pfl_tpu.management.checkpoint import NodeJournal, attach_node_journal
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.node import Node
+    from p2pfl_tpu.telemetry import REGISTRY
+    from p2pfl_tpu.utils.utils import set_test_settings, wait_convergence
+
+    set_test_settings()
+    Settings.RESOURCE_MONITOR_PERIOD = 0
+    Settings.LOG_LEVEL = "WARNING"
+    Settings.TRAIN_SET_SIZE = 3  # full committee: the victim is always a trainer
+    REGISTRY.reset()
+
+    n = 3
+    data = synthetic_mnist(n_train=128 * n, n_test=64)
+    parts = data.generate_partitions(n, RandomIIDPartitionStrategy)
+    nodes = [Node(mlp_model(seed=i), parts[i], batch_size=32) for i in range(n)]
+    tmp = tempfile.mkdtemp(prefix="recovery-check-")
+    journals = [NodeJournal(os.path.join(tmp, f"j{i}")) for i in range(n)]
+    for nd, journal in zip(nodes, journals):
+        attach_node_journal(nd, journal)
+        nd.start()
+    try:
+        for i in range(1, n):
+            nodes[i].connect(nodes[0].addr)
+        wait_convergence(nodes, n - 1, wait=15)
+
+        t0 = time.monotonic()
+        nodes[0].set_start_learning(rounds=ROUNDS, epochs=1)
+
+        victim = nodes[2]
+        victim_addr = victim.addr
+        # Kill only after the victim's first snapshot is durable.
+        deadline = time.time() + 45
+        while time.time() < deadline and not journals[2].all_steps():
+            time.sleep(0.05)
+        if not journals[2].all_steps():
+            print("FAIL: victim never journaled a round", file=sys.stderr)
+            return 1
+        victim.crash()
+        journals[2].wait()
+        print(f"killed {victim_addr} mid-round", file=sys.stderr)
+
+        resumed = Node.resume(mlp_model(seed=99), parts[2], journals[2], batch_size=32)
+        if resumed.addr != victim_addr:
+            print(
+                f"FAIL: resumed as {resumed.addr}, journal identity was "
+                f"{victim_addr}", file=sys.stderr,
+            )
+            return 1
+        resumed.start()
+        resumed.resume_learning()
+        nodes[2] = resumed
+        print(
+            f"resumed {resumed.addr} from its journal at round "
+            f"{resumed.state.round}", file=sys.stderr,
+        )
+
+        finish_deadline = t0 + WALL_BUDGET_S
+        while time.monotonic() < finish_deadline:
+            if all(
+                not nd.learning_in_progress() and nd.learning_workflow is not None
+                for nd in nodes
+            ):
+                break
+            time.sleep(0.2)
+        else:
+            print(
+                f"FAIL: federation did not finish within {WALL_BUDGET_S:.0f}s "
+                f"(stages: {({nd.addr: nd.state.current_stage for nd in nodes})})",
+                file=sys.stderr,
+            )
+            return 1
+        elapsed = time.monotonic() - t0
+
+        history = resumed.learning_workflow.history
+        if history[:1] != ["ResumeStage"]:
+            print(f"FAIL: resumed node did not enter via ResumeStage: {history[:3]}",
+                  file=sys.stderr)
+            return 1
+        if history.count("RoundFinishedStage") < 1 or history.count("TrainStage") < 1:
+            print(
+                f"FAIL: resumed node never trained/finished a round: {history}",
+                file=sys.stderr,
+            )
+            return 1
+        for nd in nodes[:2]:
+            if nd.learning_workflow.history.count("RoundFinishedStage") != ROUNDS:
+                print(
+                    f"FAIL: {nd.addr} finished "
+                    f"{nd.learning_workflow.history.count('RoundFinishedStage')}"
+                    f"/{ROUNDS} rounds", file=sys.stderr,
+                )
+                return 1
+        resumes = REGISTRY.get("p2pfl_recovery_resumes_total")
+        n_resumes = sum(c.value for _, c in resumes.samples()) if resumes else 0
+        if n_resumes < 1:
+            print("FAIL: p2pfl_recovery_resumes_total not incremented", file=sys.stderr)
+            return 1
+    finally:
+        for nd in nodes:
+            nd.stop()
+        for journal in journals:
+            try:
+                journal.close()
+            except Exception:  # noqa: BLE001
+                pass
+        InMemoryRegistry.reset()
+
+    print(
+        f"recovery-check OK: {victim_addr} crashed mid-round, resumed from its "
+        f"journal as itself, trained "
+        f"{history.count('TrainStage')} round(s) post-resume; federation "
+        f"finished {ROUNDS} rounds in {elapsed:.1f}s"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -388,9 +388,11 @@ def test_observatory_membership_events():
     obs.ingest(HealthDigest(node="p1", ts=time.time() + 1))
     snap = obs.snapshot()
     kinds = [e["event"] for e in snap["membership_events"] if e["peer"] == "p1"]
-    assert kinds == ["join", "leave", "rejoin"]
+    # Reappearance after suspected death is a HEAL (durable recovery plane):
+    # the peer's scoring state starts fresh and the event says "recover".
+    assert kinds == ["join", "leave", "recover"]
     recorded = [d["event"] for k, d in rec.events if k == "membership"]
-    assert recorded == ["join", "leave", "rejoin"]
+    assert recorded == ["join", "leave", "recover"]
 
 
 # --- e2e: mid-run join under the sparse wire ---------------------------------

@@ -1,0 +1,569 @@
+"""Durable recovery plane tests.
+
+Contract coverage for the three recovery behaviors every real deployment
+hits (Papaya: restarts and splits are the NORMAL operating condition):
+
+* torn-snapshot tolerance — a crash mid-save must never poison recovery
+  (FLCheckpointer skips incomplete step directories instead of raising);
+* heal detection + reconcile — a peer written off during a partition is
+  re-discovered by the heartbeater's probe once the partition heals, emits a
+  "recover" membership event with fresh scoring state, and whichever side
+  is ahead ships its round anchor as a dense catch-up the behind side
+  adopts at its next round boundary (split-brain repair, BOTH schedulers);
+* quorum-aware degraded mode — below the live-peer quorum a node parks
+  (state journaled, heartbeats continue) and unparks on recovery, instead
+  of burning a vote timeout per unwinnable round.
+
+The crash→restart→resume journal round-trip lives in tests/test_checkpoint.py
+(the journal is a checkpointing contract); these tests cover the protocol and
+stage machinery around it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.telemetry import REGISTRY
+
+
+def _metric(name: str) -> dict:
+    fam = REGISTRY.get(name)
+    if fam is None:
+        return {}
+    return {tuple(labels.values()): child.value for labels, child in fam.samples()}
+
+
+def _wait(cond, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+# --- torn-snapshot tolerance --------------------------------------------------
+
+
+def test_torn_step_directories_are_skipped(tmp_path):
+    """A bare step directory (crash mid-save) must be invisible to
+    latest_step/all_steps, and restore must fall back to the newest GOOD
+    snapshot instead of raising."""
+    from p2pfl_tpu.management.checkpoint import FLCheckpointer
+
+    tree = {"w": np.arange(4.0, dtype=np.float32)}
+    with FLCheckpointer(str(tmp_path / "ck"), max_to_keep=5) as ck:
+        ck.save(1, {"w": tree["w"] * 1}, {"step": 1})
+        ck.save(2, {"w": tree["w"] * 2}, {"step": 2})
+        ck.wait()
+        # Crash artifacts: a bare step dir, and a marker-only dir whose
+        # payload never landed.
+        os.makedirs(str(tmp_path / "ck" / "9"))
+        os.makedirs(str(tmp_path / "ck" / "7"))
+        open(str(tmp_path / "ck" / "7" / "_CHECKPOINT_METADATA"), "w").close()
+
+        assert 9 not in ck.all_steps()
+        assert ck.latest_step() == 7 or ck.latest_step() == 2  # 7 passes the
+        # marker check but must still fall through on restore:
+        state, meta = ck.restore({"w": np.zeros(4, np.float32)})
+        assert meta["step"] == 2
+        np.testing.assert_array_equal(state["w"], tree["w"] * 2)
+        assert ck.restore_meta()["step"] == 2
+
+
+def test_empty_checkpointer_still_raises(tmp_path):
+    from p2pfl_tpu.management.checkpoint import FLCheckpointer
+
+    with FLCheckpointer(str(tmp_path / "empty")) as ck:
+        with pytest.raises(FileNotFoundError):
+            ck.restore({"w": np.zeros(2, np.float32)})
+        with pytest.raises(FileNotFoundError):
+            ck.restore_meta()
+
+
+# --- gossip backoff jitter ----------------------------------------------------
+
+
+def test_backoff_jitter_deterministic_decorrelated_bounded():
+    """Retry backoff must be seeded-deterministic (replayable), decorrelated
+    across node pairs (no post-heal retry lockstep), and bounded to
+    [0.5, 1.5) x the exponential base."""
+    from p2pfl_tpu.comm.protocol import jittered_backoff
+
+    with Settings.overridden(GOSSIP_SEND_BACKOFF=0.1, CHAOS_SEED=0):
+        a = jittered_backoff("n1", "n2", 1)
+        assert a == jittered_backoff("n1", "n2", 1)  # deterministic
+        others = {jittered_backoff(f"n{i}", "n2", 1) for i in range(3, 10)}
+        assert a not in others  # decorrelated across pairs
+        base = 0.2
+        for attempt, mult in ((0, 1), (1, 2), (2, 4)):
+            v = jittered_backoff("x", "y", attempt)
+            lo, hi = 0.1 * mult * 0.5, 0.1 * mult * 1.5
+            assert lo <= v < hi, (attempt, v)
+    with Settings.overridden(CHAOS_SEED=1234):
+        assert jittered_backoff("n1", "n2", 1) != a  # seed moves the stream
+    with Settings.overridden(GOSSIP_SEND_BACKOFF=0.0):
+        assert jittered_backoff("n1", "n2", 3) == 0.0
+
+
+# --- recovery scenario traces -------------------------------------------------
+
+
+def test_plan_recovery_deterministic_and_counted():
+    from p2pfl_tpu.chaos import CHAOS, ChaosPlane
+
+    nodes = [f"n{i}" for i in range(8)]
+    plan = ChaosPlane().plan_recovery(
+        6, nodes, seed=7, crash_round=1, partition_round=2, heal_after=2
+    )
+    replay = ChaosPlane().plan_recovery(
+        6, nodes, seed=7, crash_round=1, partition_round=2, heal_after=2
+    )
+    assert plan == replay
+    assert ChaosPlane().plan_recovery(6, nodes, seed=8, partition_round=2) != plan
+    kinds = [e.kind for e in plan]
+    assert kinds.count("crash") == 1 and kinds.count("restart") == 1
+    assert kinds.count("partition") == 1 and kinds.count("heal") == 1
+    part = next(e for e in plan if e.kind == "partition")
+    assert sorted(a for g in part.groups for a in g) == sorted(nodes)
+    # executed events land in the deterministic fault table
+    CHAOS.reset()
+    for e in plan:
+        CHAOS.recovery(e.node or "fleet", e.kind)
+    assert CHAOS.fault_counts() == {"recovery": len(plan)}
+    CHAOS.reset()
+
+
+def test_link_blocked_is_state_only():
+    """The heal probe's chaos check must draw NO randomness: interleaving it
+    must not shift the per-pair decision streams, and it must count no
+    faults."""
+    from p2pfl_tpu.chaos import ChaosPlane
+
+    with Settings.overridden(CHAOS_ENABLED=True, CHAOS_SEED=3, CHAOS_DROP_RATE=0.3):
+        p1, p2 = ChaosPlane(), ChaosPlane()
+        seq1 = [p1.intercept("a", "b").drop for _ in range(50)]
+        seq2 = []
+        for _ in range(50):
+            p2.link_blocked("a", "b")  # interleaved probes
+            seq2.append(p2.intercept("a", "b").drop)
+        assert seq1 == seq2
+        assert "partition" not in p2.fault_counts()
+        p2.partition(["a"], ["b"])
+        assert p2.link_blocked("a", "b") == "partition"
+        counts_before = p2.fault_counts()
+        p2.link_blocked("a", "b")
+        assert p2.fault_counts() == counts_before  # probes count nothing
+        p2.crash("c")
+        assert p2.link_blocked("a", "c") == "crash"
+
+
+# --- heal detection -----------------------------------------------------------
+
+
+def test_failure_departures_enter_probe_pool_graceful_does_not():
+    from p2pfl_tpu.comm.memory.memory_protocol import InMemoryCommunicationProtocol
+
+    p1 = InMemoryCommunicationProtocol()
+    p2 = InMemoryCommunicationProtocol()
+    p3 = InMemoryCommunicationProtocol()
+    for p in (p1, p2, p3):
+        p.start()
+    try:
+        p1.connect(p2.addr)
+        p1.connect(p3.addr)
+        p1.disconnect(p2.addr)  # graceful: no heal owed
+        p1.neighbors.remove(p3.addr, notify=False)  # write-off: heal-probed
+        assert p1.neighbors.departed() == [p3.addr]
+    finally:
+        for p in (p1, p2, p3):
+            p.stop()
+
+
+def test_probe_detects_heal_and_fires_recover():
+    """A written-off peer that is reachable again must be re-added by the
+    probe, firing the recovery listeners, the observatory's 'recover'
+    membership event and the heals metric — and the probe must NOT pierce a
+    still-active chaos partition."""
+    from p2pfl_tpu.chaos import CHAOS
+    from p2pfl_tpu.comm.memory.memory_protocol import InMemoryCommunicationProtocol
+
+    REGISTRY.reset()
+    CHAOS.reset()
+    p1 = InMemoryCommunicationProtocol()
+    p2 = InMemoryCommunicationProtocol()
+    healed: list = []
+    p1.on_neighbor_recovered(healed.append)
+    p1.start()
+    p2.start()
+    try:
+        p1.connect(p2.addr)
+        p1.neighbors.remove(p2.addr, notify=False)  # simulate write-off
+        assert p2.addr not in p1.get_neighbors()
+
+        CHAOS.partition([p1.addr], [p2.addr])
+        p1._probe_departed()
+        assert healed == []  # the probe respects the partition
+        assert p2.addr not in p1.get_neighbors()
+
+        CHAOS.heal()
+        p1._probe_departed()
+        assert healed == [p2.addr]
+        assert p2.addr in p1.get_neighbors()
+        events = [
+            e["event"]
+            for e in p1.observatory.snapshot()["membership_events"]
+            if e["peer"] == p2.addr
+        ]
+        assert "recover" in events
+        assert sum(_metric("p2pfl_recovery_heals_total").values()) >= 1
+        # once healed, the peer leaves the probe pool
+        assert p2.addr not in p1.neighbors.departed()
+    finally:
+        CHAOS.reset()
+        p1.stop()
+        p2.stop()
+
+
+def test_observatory_recover_resets_link_baseline():
+    from p2pfl_tpu.telemetry.observatory import Observatory
+
+    REGISTRY.reset()
+    obs = Observatory("me")
+    missed = REGISTRY.counter(
+        "p2pfl_heartbeat_missed_total", "test shim", labels=("node", "peer")
+    )
+    missed.labels("me", "p1").inc(5)
+    assert obs._link_score("p1") >= 5.0
+    obs.peer_recovered("p1")
+    assert obs._link_score("p1") == 0.0  # partition-era misses forgiven
+    missed.labels("me", "p1").inc(2)
+    assert obs._link_score("p1") >= 2.0  # fresh misses still count
+
+
+# --- reconcile (split-brain repair) ------------------------------------------
+
+
+def _mini_nodes(n, batch=16):
+    from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.node import Node
+
+    data = synthetic_mnist(n_train=64 * n, n_test=32)
+    parts = data.generate_partitions(n, RandomIIDPartitionStrategy)
+    return [
+        Node(mlp_model(seed=i), parts[i], batch_size=batch, executor=False)
+        for i in range(n)
+    ]
+
+
+def test_offer_take_reconcile_semantics():
+    from p2pfl_tpu.node_state import NodeState
+
+    st = NodeState("me")
+    st.set_experiment("e", 10)
+    st.experiment.round = 3
+    params = [np.zeros(2, np.float32)]
+    assert not st.offer_reconcile(2, params, [], "p")  # behind: rejected
+    assert not st.offer_reconcile(3, params, [], "p")  # equal: rejected
+    assert st.offer_reconcile(5, params, [], "p")
+    assert not st.offer_reconcile(4, params, [], "q")  # older than pending
+    assert st.offer_reconcile(6, params, [], "q")  # fresher replaces
+    assert st.reconcile_ahead()
+    st.experiment.round = 7  # caught up naturally: offer is stale
+    assert st.take_reconcile() is None
+    assert not st.reconcile_ahead()
+
+
+def test_reconcile_model_staged_and_applied_at_boundary():
+    """reconcile_model stages the catch-up; apply_pending_reconcile adopts
+    it atomically: params, anchor resync, round fast-forward, events."""
+    from p2pfl_tpu.comm.commands.impl import ReconcileModelCommand
+    from p2pfl_tpu.stages.recovery import apply_pending_reconcile
+
+    REGISTRY.reset()
+    node = _mini_nodes(1)[0]
+    node.start()
+    try:
+        state = node.state
+        state.set_experiment("e", 10)
+        state.experiment.round = 1
+        state.wire.set_anchor(node.learner.get_model().get_parameters(), 1)
+
+        ahead = node.learner.get_model().build_copy(
+            params=[np.asarray(p) + 0.5 for p in node.learner.get_model().get_parameters()]
+        )
+        blob = ahead.encode_parameters()
+        ReconcileModelCommand(node).execute(
+            "peer-x", 4, weights=blob, contributors=["peer-x"], num_samples=1
+        )
+        assert state.reconcile_ahead()
+        assert state.votes_ready_event.is_set()
+
+        assert apply_pending_reconcile(node)
+        assert state.round == 4
+        assert state.wire.anchor_round == 4
+        assert state.last_full_model_round == 3
+        np.testing.assert_allclose(
+            np.asarray(node.learner.get_model().get_parameters()[0]),
+            np.asarray(ahead.get_parameters()[0]),
+            rtol=0, atol=1e-6,
+        )
+        rec = _metric("p2pfl_recovery_reconcile_total")
+        assert rec.get((node.addr, "catchup_rx")) == 1.0
+        # stale frames for rounds at/behind us are ignored
+        ReconcileModelCommand(node).execute(
+            "peer-x", 3, weights=blob, contributors=["peer-x"], num_samples=1
+        )
+        assert not state.reconcile_ahead()
+    finally:
+        node.stop()
+
+
+def test_reconcile_ping_triggers_catchup_from_ahead_peer():
+    """The full ping → catch-up → staged-offer exchange between two live
+    nodes: behind pings, ahead ships its round anchor, behind stages it."""
+    node_b, node_a = _mini_nodes(2)
+    node_a.start()
+    node_b.start()
+    try:
+        node_b.connect(node_a.addr)
+        assert _wait(lambda: node_a.addr in node_b.get_neighbors(), 10)
+        # ahead node at round 5 with an anchor to ship
+        node_a.state.set_experiment("e", 10)
+        node_a.state.experiment.round = 5
+        node_a.state.wire.set_anchor(node_a.learner.get_model().get_parameters(), 5)
+        # behind node at round 1
+        node_b.state.set_experiment("e", 10)
+        node_b.state.experiment.round = 1
+        assert node_b.send_reconcile_ping(node_a.addr)
+        assert _wait(node_b.state.reconcile_ahead, 10)
+        rec = _metric("p2pfl_recovery_reconcile_total")
+        assert rec.get((node_a.addr, "catchup_tx"), 0) >= 1
+    finally:
+        node_a.stop()
+        node_b.stop()
+
+
+# --- quorum-aware degraded mode ----------------------------------------------
+
+
+def test_park_until_quorum_parks_and_unparks():
+    from p2pfl_tpu.stages.recovery import park_until_quorum
+
+    REGISTRY.reset()
+    nodes = _mini_nodes(3)
+    try:
+        nodes[0].start()
+        nodes[1].start()
+        nodes[1].connect(nodes[0].addr)
+        assert _wait(lambda: nodes[1].addr in nodes[0].get_neighbors(), 10)
+        st = nodes[0].state
+        st.set_experiment("park", 3)
+        # the known fleet is 3 — the third member is down right now
+        st.session_members = {nodes[0].addr, nodes[1].addr, nodes[2].addr}
+        result = [None]
+        with Settings.overridden(
+            RECOVERY_QUORUM_FRACTION=0.9, RECOVERY_PARK_MAX_S=30.0
+        ):
+            t = threading.Thread(
+                target=lambda: result.__setitem__(0, park_until_quorum(nodes[0]))
+            )
+            t.start()
+            assert _wait(lambda: st.parked, 5)
+            # third member arrives: quorum met, node unparks
+            nodes[2].start()
+            nodes[2].connect(nodes[0].addr)
+            t.join(timeout=15)
+            assert result[0] is True and not st.parked
+        parks = _metric("p2pfl_recovery_parks_total")
+        assert parks.get((nodes[0].addr,)) == 1.0
+        assert sum(_metric("p2pfl_recovery_parked_seconds_total").values()) > 0
+        assert _metric("p2pfl_recovery_parked").get((nodes[0].addr,)) == 0.0
+    finally:
+        for nd in nodes:
+            try:
+                nd.stop()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def test_park_early_stop_and_cap():
+    from p2pfl_tpu.stages.recovery import park_until_quorum
+
+    nodes = _mini_nodes(1)
+    node = nodes[0]
+    node.start()
+    try:
+        st = node.state
+        st.set_experiment("park", 3)
+        st.session_members = {node.addr, "mem://ghost-a", "mem://ghost-b"}
+        # early stop while parked -> False
+        result = [None]
+        with Settings.overridden(RECOVERY_QUORUM_FRACTION=1.0, RECOVERY_PARK_MAX_S=0.0):
+            t = threading.Thread(
+                target=lambda: result.__setitem__(0, park_until_quorum(node))
+            )
+            t.start()
+            assert _wait(lambda: st.parked, 5)
+            st.experiment = None
+            t.join(timeout=10)
+            assert result[0] is False
+        # cap expiry -> proceeds degraded (True)
+        st.set_experiment("park2", 3)
+        st.session_members = {node.addr, "mem://ghost-a", "mem://ghost-b"}
+        with Settings.overridden(RECOVERY_QUORUM_FRACTION=1.0, RECOVERY_PARK_MAX_S=0.6):
+            assert park_until_quorum(node) is True
+            assert not st.parked
+        # quorum disabled -> no parking at all
+        with Settings.overridden(RECOVERY_QUORUM_FRACTION=0.0):
+            assert park_until_quorum(node) is True
+    finally:
+        node.stop()
+
+
+def test_recovery_settings_validated():
+    """The RECOVERY_* env knobs ride the validated fail-fast layer."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["P2PFL_TPU_RECOVERY_QUORUM_FRACTION"] = "1.7"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", "import p2pfl_tpu.config"],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert out.returncode != 0
+    assert "RECOVERY_QUORUM_FRACTION" in out.stderr
+
+
+# --- split-brain reconcile e2e (both schedulers) ------------------------------
+
+
+@pytest.mark.slow
+def test_partition_heal_reconciles_sync():
+    """4-node sync federation, 2|2 partition held ~2 rounds, then healed:
+    every node must finish, heals must be detected on both sides, and the
+    behind half must adopt the ahead half's generation via dense catch-up."""
+    from p2pfl_tpu.chaos import CHAOS
+    from p2pfl_tpu.node import Node
+    from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from p2pfl_tpu.models import mlp_model
+
+    REGISTRY.reset()
+    CHAOS.reset()
+    n, rounds = 4, 6
+    data = synthetic_mnist(n_train=128 * n, n_test=64)
+    parts = data.generate_partitions(n, RandomIIDPartitionStrategy)
+    with Settings.overridden(LOG_LEVEL="WARNING", TRAIN_SET_SIZE=4):
+        nodes = [Node(mlp_model(seed=i), parts[i], batch_size=32) for i in range(n)]
+        for nd in nodes:
+            nd.start()
+        try:
+            for i in range(1, n):
+                nodes[i].connect(nodes[0].addr)
+            assert _wait(
+                lambda: all(len(nd.get_neighbors()) == n - 1 for nd in nodes), 20
+            )
+            nodes[0].set_start_learning(rounds=rounds, epochs=1)
+            assert _wait(lambda: (nodes[0].state.round or 0) >= 1, 30)
+            half_a = [nodes[0].addr, nodes[1].addr]
+            half_b = [nodes[2].addr, nodes[3].addr]
+            CHAOS.partition(half_a, half_b)
+            base = nodes[0].state.round or 0
+            _wait(
+                lambda: (nodes[0].state.round or rounds) >= base + 2
+                or not nodes[0].learning_in_progress(),
+                60,
+            )
+            CHAOS.heal()
+            assert _wait(
+                lambda: all(
+                    not nd.learning_in_progress()
+                    and nd.learning_workflow is not None
+                    for nd in nodes
+                ),
+                150,
+            ), {nd.addr: nd.state.current_stage for nd in nodes}
+            heals = _metric("p2pfl_recovery_heals_total")
+            assert sum(heals.values()) >= 2, heals
+            rec = _metric("p2pfl_recovery_reconcile_total")
+            assert any(role == "ping_tx" for (_, role) in rec), rec
+            # one federation again: everyone saturates the synthetic task
+            accs = [nd.learner.evaluate().get("test_acc", 0.0) for nd in nodes]
+            assert min(accs) == max(accs) == 1.0, accs
+        finally:
+            for nd in nodes:
+                nd.stop()
+            CHAOS.reset()
+
+
+@pytest.mark.slow
+def test_partition_heal_reconciles_async():
+    """Same 2|2 split under the async scheduler: both halves keep closing
+    windows during the partition, and after the heal their contributions
+    merge through the staleness-weighted buffer — every node finishes with
+    the task saturated."""
+    from p2pfl_tpu.chaos import CHAOS
+    from p2pfl_tpu.node import Node
+    from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from p2pfl_tpu.models import mlp_model
+
+    REGISTRY.reset()
+    CHAOS.reset()
+    n, windows = 4, 5
+    data = synthetic_mnist(n_train=128 * n, n_test=64)
+    parts = data.generate_partitions(n, RandomIIDPartitionStrategy)
+    with Settings.overridden(LOG_LEVEL="WARNING", ASYNC_WINDOW_TIMEOUT=8.0):
+        nodes = [Node(mlp_model(seed=i), parts[i], batch_size=32) for i in range(n)]
+        for nd in nodes:
+            # pace windows so the partition spans more than one of them
+            orig = nd.learner.fit
+
+            def slow_fit(orig=orig):
+                time.sleep(0.5)
+                return orig()
+
+            nd.learner.fit = slow_fit
+            nd.start()
+        try:
+            for i in range(1, n):
+                nodes[i].connect(nodes[0].addr)
+            assert _wait(
+                lambda: all(len(nd.get_neighbors()) == n - 1 for nd in nodes), 20
+            )
+            nodes[0].set_start_learning(rounds=windows, epochs=1, mode="async")
+            assert _wait(lambda: (nodes[0].state.round or 0) >= 1, 30)
+            CHAOS.partition(
+                [nodes[0].addr, nodes[1].addr], [nodes[2].addr, nodes[3].addr]
+            )
+            base = nodes[0].state.round or 0
+            _wait(
+                lambda: (nodes[0].state.round or windows) >= base + 2
+                or not nodes[0].learning_in_progress(),
+                60,
+            )
+            CHAOS.heal()
+            assert _wait(
+                lambda: all(
+                    not nd.learning_in_progress()
+                    and nd.learning_workflow is not None
+                    for nd in nodes
+                ),
+                150,
+            ), {nd.addr: nd.state.current_stage for nd in nodes}
+            accs = [nd.learner.evaluate().get("test_acc", 0.0) for nd in nodes]
+            assert min(accs) == 1.0, accs
+            for nd in nodes:
+                assert nd.learning_workflow.history.count("AsyncWindowFinishedStage") >= 1
+        finally:
+            for nd in nodes:
+                nd.stop()
+            CHAOS.reset()

@@ -192,6 +192,11 @@ class ChaosPlane:
             )
         with self._lock:
             self._byzantine[addr] = _Byzantine(attack, float(scale), int(inflate_factor))
+        from p2pfl_tpu.telemetry.ledger import LEDGERS
+
+        LEDGERS.emit(
+            addr, "chaos_fault", fault="byzantine", peer=addr, attack=attack
+        )
         log.warning("chaos: %s turned byzantine (attack=%s)", addr, attack)
 
     def clear_byzantine(self, addr: Optional[str] = None) -> None:
@@ -295,6 +300,12 @@ class ChaosPlane:
         fault counter buckets them all under ``fault="recovery"``)."""
         with self._lock:
             self._count(label, "recovery")
+        from p2pfl_tpu.telemetry.ledger import LEDGERS
+
+        # Scenario-level chaos steps are trajectory-shaping facts and enter
+        # the ledger; per-frame link faults (drop/delay/duplicate) are
+        # environment noise whose counts are run-dependent — metrics only.
+        LEDGERS.emit(label, "chaos_fault", fault="recovery", peer=label, step=kind)
         log.warning("chaos: recovery event %s %s", kind, label)
 
     def link_blocked(self, src: str, dst: str) -> Optional[str]:
@@ -317,6 +328,9 @@ class ChaosPlane:
         all under ``fault="churn"``)."""
         with self._lock:
             self._count(addr, "churn")
+        from p2pfl_tpu.telemetry.ledger import LEDGERS
+
+        LEDGERS.emit(addr, "chaos_fault", fault="churn", peer=addr, step=kind)
         log.warning("chaos: churn event %s %s", kind, addr)
 
     def set_slow(self, addr: str, extra_delay_s: float) -> None:

@@ -118,6 +118,22 @@ class AdmissionController:
         _REJECTED.labels(self._addr, reason, source).inc()
         if self.recorder is not None:
             self.recorder.record("reject", reason=reason, source=source, cmd=cmd)
+        # Trajectory ledger: one admission fact per (round, sender, reason) —
+        # a gossip loop re-shipping the same bad frame every tick is ONE
+        # trajectory event, however many times the screen fired (the metric
+        # above keeps the raw count). Lazy import: admission must stay
+        # importable before the telemetry package finishes wiring.
+        from p2pfl_tpu.telemetry.ledger import LEDGERS
+
+        if LEDGERS.enabled():
+            led = LEDGERS.get(self._addr)
+            led.emit(
+                "admission_rejected",
+                round=led.current_round,  # best-effort: frames carry no round here
+                sender=source,
+                reason=reason,
+                dedup_key=("admission", led.current_round, source, reason),
+            )
         key = (source, reason)
         msg = "(%s) rejected %s frame from %s: reason=%s"
         if key in self._warned:

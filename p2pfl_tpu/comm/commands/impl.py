@@ -323,6 +323,24 @@ class FullModelCommand(Command):
             ):
                 node.learner.get_model().apply_frame(arrays, meta)
                 state.note_full_model_round(round)
+                from p2pfl_tpu.telemetry.ledger import (
+                    LEDGERS,
+                    canonical_params_hash,
+                )
+
+                if LEDGERS.enabled():
+                    # Non-trainers commit the round aggregate here — the
+                    # trainer-side analogue (own aggregate) is in TrainStage.
+                    adopted = node.learner.get_model()
+                    LEDGERS.get(node.addr).emit(
+                        "aggregate_committed",
+                        round=round,
+                        dedup_key=("commit", round),
+                        hash=canonical_params_hash(adopted.get_parameters()),
+                        contributors=sorted(adopted.contributors),
+                        num_samples=adopted.get_num_samples(),
+                        origin="full_model",
+                    )
                 # Rejoin/round-anchor resync: adopting a DENSE full model for
                 # round r means we now hold the exact model every in-phase
                 # node will anchor round r+1 against — so a crashed-and-

@@ -301,6 +301,26 @@ class Settings:
     # p2pfl_trace_spans_dropped_total) so multi-day experiments cannot grow
     # the span tree without limit.
     TRACE_MAX_SPANS: int = _env_int("TRACE_MAX_SPANS", 65536, 256, 1 << 22)
+    # Trajectory ledger (telemetry/ledger.py): deterministic, seed-stable,
+    # append-only structured events — round/window open+close, contribution
+    # folded, aggregate committed (content hash), membership transitions,
+    # chaos scenario steps, admission rejections — emitted identically by
+    # the wire path and the fused mesh so scripts/parity_diff.py can
+    # certify that both backends describe the same federation. Disabling
+    # turns every emission point into a cheap no-op; the ring is bounded by
+    # LEDGER_CAPACITY (oldest events evicted); LEDGER_SNAPSHOT_TAIL is how
+    # many recent events ride the observatory snapshot for fed_top's
+    # PARITY panel.
+    LEDGER_ENABLED: bool = _env_override("LEDGER_ENABLED", True)
+    LEDGER_CAPACITY: int = _env_int("LEDGER_CAPACITY", 4096, 16, 1 << 22)
+    LEDGER_SNAPSHOT_TAIL: int = _env_int("LEDGER_SNAPSHOT_TAIL", 8, 0, 1024)
+    # Sim↔real parity gate shape (scripts/parity_check.py): nodes/rounds of
+    # the seeded scenario run on BOTH backends; bench.py --parity uses its
+    # own 8-node shape.
+    PARITY_NODES: int = _env_int("PARITY_NODES", 3, 2, 64)
+    PARITY_ROUNDS: int = _env_int("PARITY_ROUNDS", 2, 1, 100)
+    PARITY_SEED: int = _env_int("PARITY_SEED", 1234, 0, 2**31 - 1)
+
     # Continuous performance profiling (management/profiler.py): when set,
     # the stage machine captures ONE windowed jax.profiler device trace of
     # a fit per process under this directory (capture-once, never-raising),

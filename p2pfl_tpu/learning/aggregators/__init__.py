@@ -5,7 +5,10 @@ from p2pfl_tpu.learning.aggregators.async_buffer import (  # noqa: F401
     staleness_weight,
 )
 from p2pfl_tpu.learning.aggregators.base import Aggregator  # noqa: F401
-from p2pfl_tpu.learning.aggregators.fedavg import FedAvg  # noqa: F401
+from p2pfl_tpu.learning.aggregators.fedavg import (  # noqa: F401
+    CanonicalFedAvg,
+    FedAvg,
+)
 from p2pfl_tpu.learning.aggregators.fedmedian import FedMedian  # noqa: F401
 from p2pfl_tpu.learning.aggregators.robust import (  # noqa: F401
     GeometricMedian,
@@ -16,7 +19,7 @@ from p2pfl_tpu.learning.aggregators.robust import (  # noqa: F401
 from p2pfl_tpu.learning.aggregators.scaffold import Scaffold  # noqa: F401
 
 __all__ = [
-    "Aggregator", "AsyncBufferedAggregator", "FedAvg", "FedMedian",
-    "GeometricMedian", "Krum", "MultiKrum", "TrimmedMean", "Scaffold",
-    "staleness_weight",
+    "Aggregator", "AsyncBufferedAggregator", "CanonicalFedAvg", "FedAvg",
+    "FedMedian", "GeometricMedian", "Krum", "MultiKrum", "TrimmedMean",
+    "Scaffold", "staleness_weight",
 ]

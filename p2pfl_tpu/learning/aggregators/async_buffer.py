@@ -36,6 +36,7 @@ from p2pfl_tpu.config import Settings
 from p2pfl_tpu.models.model_handle import ModelHandle
 from p2pfl_tpu.ops import aggregation as agg_ops
 from p2pfl_tpu.telemetry import REGISTRY
+from p2pfl_tpu.telemetry.ledger import LEDGERS
 from p2pfl_tpu.telemetry.sketches import SKETCHES
 
 _FOLDED = REGISTRY.counter(
@@ -154,11 +155,19 @@ class AsyncBufferedAggregator:
                 return False
             self._buffer[sender] = (model, lag)
             self.seen_contributors.setdefault(sender, self._window)
+            window_now = self._window
         if sender == self.addr:
             kind = "self"
         else:
             kind = "fresh" if lag == 0 else "stale"
         _FOLDED.labels(self.addr, kind).inc()
+        # Trajectory ledger: the async fold is the window's contribution
+        # event, lag included (the sync path's analogue lives in
+        # Aggregator.add_model with lag pinned to 0).
+        LEDGERS.emit(
+            self.addr, "contribution_folded", round=window_now,
+            sender=sender, lag=int(lag), num_samples=model.get_num_samples(),
+        )
         self._event.set()
         return True
 

@@ -29,6 +29,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 from p2pfl_tpu.config import Settings
 from p2pfl_tpu.models.model_handle import ModelHandle
 from p2pfl_tpu.telemetry import REGISTRY
+from p2pfl_tpu.telemetry.ledger import LEDGERS
 from p2pfl_tpu.telemetry.sketches import SKETCHES
 
 log = logging.getLogger("p2pfl_tpu")
@@ -74,6 +75,7 @@ class Aggregator:
         self._finish_event = threading.Event()
         self._train_set: List[str] = []
         self._models: List[ModelHandle] = []
+        self._round: Optional[int] = None  # ledger stamp for this round's folds
         # monotonic timestamp of the last round progress (a stored model, a
         # death-shrink, or the round opening) — drives the JIT stall patience.
         self._last_progress = time.monotonic()
@@ -95,14 +97,18 @@ class Aggregator:
 
     # --- round lifecycle -----------------------------------------------------
 
-    def set_nodes_to_aggregate(self, train_set: Sequence[str]) -> None:
+    def set_nodes_to_aggregate(
+        self, train_set: Sequence[str], round: Optional[int] = None
+    ) -> None:
         """Open the round: declare whose contributions we expect
-        (reference :66-81)."""
+        (reference :66-81). ``round`` stamps this round's trajectory-ledger
+        contribution events (None keeps the ledger's current round)."""
         with self._lock:
             if self._train_set:
                 raise RuntimeError("aggregation already in progress — clear() first")
             self._train_set = list(train_set)
             self._models = []
+            self._round = round
             self._finish_event.clear()
             self._last_progress = time.monotonic()
 
@@ -181,6 +187,19 @@ class Aggregator:
             ]
             self._models.append(model)
             self._last_progress = time.monotonic()
+            # Trajectory ledger: one fold event per model actually merged
+            # (dedup'd/subset frames returned above and never reach here),
+            # so the event stream is the round's contribution set, not the
+            # gossip traffic. Merged partials ledger as their sorted
+            # contributor tuple; sync folds are zero-lag by construction.
+            LEDGERS.emit(
+                self.node_addr,
+                "contribution_folded",
+                round=self._round,
+                sender="+".join(sorted(contributors)),
+                lag=0,
+                num_samples=model.get_num_samples(),
+            )
             agg = self.get_aggregated_models()
             if set(agg) >= set(self._train_set):
                 self._finish_event.set()

@@ -27,3 +27,26 @@ class FedAvg(Aggregator):
         out = agg_ops.fedavg(stacked, weights)
         contributors, total = self._merge_metadata(models)
         return models[0].build_copy(params=out, contributors=contributors, num_samples=total)
+
+
+class CanonicalFedAvg(FedAvg):
+    """FedAvg with a run-independent float reduction order — the wire-side
+    aggregation rule of the sim↔real parity harness (:mod:`p2pfl_tpu.parity`).
+
+    Plain :class:`FedAvg` merges partial aggregates eagerly en route, so the
+    float reduction TREE depends on gossip arrival order: two runs of the
+    same seeded scenario (or two nodes within one run) legitimately differ
+    in final-bit rounding. This variant makes the aggregate a pure function
+    of the contribution set: partial merging is disabled (raw per-sender
+    models ride the gossip) and the stack is sorted by contributor before
+    the jitted ``fedavg`` reduction — the same kernel, in node-name order,
+    which is exactly the node-index order the fused mesh reduces in under
+    ``canonical_committee=True``. Bit-exact cross-backend aggregates follow.
+    """
+
+    partial_aggregation = False
+
+    def aggregate(self, models: List[ModelHandle]) -> ModelHandle:
+        return super().aggregate(
+            sorted(models, key=lambda m: sorted(m.contributors))
+        )

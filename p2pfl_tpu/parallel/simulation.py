@@ -57,6 +57,103 @@ from p2pfl_tpu.parallel.mesh import make_mesh
 Pytree = Any
 
 
+def poison_delta(new: jax.Array, old: jax.Array, attack: str, scale: float = 10.0) -> jax.Array:
+    """Byzantine model-poisoning transform on one leaf's round delta,
+    computed in float32: ``signflip`` reflects the trained update around the
+    round start (``old - (new - old)``), ``scaled`` multiplies it. Shared by
+    the fused round body and the wire-side parity adversary
+    (:mod:`p2pfl_tpu.parity`) so both backends corrupt with bit-identical
+    math — the parity ledger certifies the corruption itself."""
+    delta = new.astype(jnp.float32) - old.astype(jnp.float32)
+    if attack == "signflip":
+        return old.astype(jnp.float32) - delta
+    return old.astype(jnp.float32) + scale * delta
+
+
+def local_train_step(
+    params: Pytree,
+    opt_state: Pytree,
+    key: jax.Array,
+    x: jax.Array,
+    y: jax.Array,
+    w: jax.Array,
+    c_i: Pytree,
+    *,
+    c_global: Pytree,
+    epochs: int,
+    batch_loss: Callable[[Pytree, jax.Array, jax.Array, jax.Array], jax.Array],
+    optimizer: optax.GradientTransformation,
+    batch_size: int,
+    lr: float = 0.0,
+    fedprox_mu: float = 0.0,
+    dp_clip_norm: float = 0.0,
+    dp_noise_multiplier: float = 0.0,
+    scaffold: bool = False,
+) -> Tuple[Pytree, Pytree, jax.Array]:
+    """One node's local training: ``epochs`` x scan over shuffled
+    fixed-shape batches. This is the ONE local-train kernel both execution
+    backends run — :meth:`MeshSimulation._local_train` vmaps it over the
+    committee inside the fused round program, and the wire-side
+    :class:`~p2pfl_tpu.parity.ParityLearner` jits it per node — which is
+    what makes ``bench.py --parity``'s bit-exact aggregate comparison
+    possible (one execution substrate, two coordination layers; ROADMAP
+    item 5 / Papaya's shared sim-production path)."""
+    steps = x.shape[0] // batch_size
+    anchor = params  # round-start model (for the FedProx proximal term)
+
+    def epoch(carry, ekey):
+        p, s = carry
+        if dp_clip_norm > 0.0:
+            kperm, kdp = jax.random.split(ekey)
+        else:
+            # Non-DP runs keep the historical permutation stream: ekey
+            # feeds the shuffle directly, so checkpoints written before
+            # DP existed still resume bit-identically.
+            kperm = kdp = ekey
+        perm = jax.random.permutation(kperm, x.shape[0])
+        xb = x[perm][: steps * batch_size].reshape(steps, batch_size, *x.shape[1:])
+        yb = y[perm][: steps * batch_size].reshape(steps, batch_size)
+        wb = w[perm][: steps * batch_size].reshape(steps, batch_size)
+        skeys = jax.random.split(kdp, steps)
+
+        def step(carry, batch):
+            p, s = carry
+            bx, by, bw, bk = batch
+
+            def loss_fn(pp):
+                loss = batch_loss(pp, bx, by, bw)
+                if fedprox_mu > 0.0:
+                    loss = loss + fedprox_penalty(pp, anchor, fedprox_mu)
+                return loss
+
+            if dp_clip_norm > 0.0:
+                loss, grads = dp_grads(
+                    batch_loss, p, bx, by, bw, bk,
+                    dp_clip_norm, dp_noise_multiplier,
+                )
+                if fedprox_mu > 0.0:  # proximal pull after the DP mean
+                    loss = loss + fedprox_penalty(p, anchor, fedprox_mu)
+                    grads = fedprox_grad(grads, p, anchor, fedprox_mu)
+            else:
+                loss, grads = jax.value_and_grad(loss_fn)(p)
+            if scaffold:  # drift correction: g + c - c_i
+                grads = jax.tree.map(
+                    lambda g, c, ci: g + c.astype(g.dtype) - ci.astype(g.dtype),
+                    grads,
+                    c_global,
+                    c_i,
+                )
+            updates, s2 = optimizer.update(grads, s, p)
+            return (optax.apply_updates(p, updates), s2), loss
+
+        (p, s), losses = jax.lax.scan(step, (p, s), (xb, yb, wb, skeys))
+        return (p, s), jnp.mean(losses)
+
+    ekeys = jax.random.split(key, epochs)
+    (params, opt_state), losses = jax.lax.scan(epoch, (params, opt_state), ekeys)
+    return params, opt_state, jnp.mean(losses)
+
+
 @dataclass
 class SimulationResult:
     """Per-round metrics + final population state."""
@@ -140,6 +237,12 @@ class MeshSimulation:
             health model (:meth:`fleet_health` — round lag, step time) so a
             population-scale run produces a real observatory snapshot with
             seeded stragglers in it.
+        canonical_committee: sort the elected committee by node index inside
+            the round body (the SET is unchanged; gather order, per-member
+            RNG key assignment and the FedAvg reduction order become
+            node-index-canonical). The sim↔real parity harness
+            (:mod:`p2pfl_tpu.parity`) requires it — the wire backend can
+            only reproduce a deterministic ordering.
     """
 
     def __init__(
@@ -167,6 +270,7 @@ class MeshSimulation:
         server_lr: float = 1.0,
         clip_update_norm: float = 0.0,
         node_speed: Optional[np.ndarray] = None,
+        canonical_committee: bool = False,
     ) -> None:
         if task not in ("classification", "lm"):
             raise ValueError(f"unknown task {task!r}")
@@ -243,6 +347,10 @@ class MeshSimulation:
                 "scaffold's control variates assume unclipped deltas"
             )
         self.clip_update_norm = float(clip_update_norm)
+        self.canonical_committee = bool(canonical_committee)
+        # Trajectory-ledger attachment (attach_ledger): None = no emission.
+        self._ledger = None
+        self._ledger_names: Optional[List[str]] = None
         self.task = task
         self.algorithm = algorithm
         self.scaffold_global_lr = float(scaffold_global_lr)
@@ -503,63 +611,23 @@ class MeshSimulation:
         self, params: Pytree, opt_state: Pytree, key: jax.Array, x: jax.Array,
         y: jax.Array, w: jax.Array, c_i: Pytree, *, c_global: Pytree, epochs: int
     ) -> Tuple[Pytree, Pytree, jax.Array]:
-        """One committee member's local training: ``epochs`` x scan over
-        shuffled fixed-shape batches (same math as JaxLearner._train_epoch,
-        including the in-jit SCAFFOLD drift correction when enabled)."""
-        steps = x.shape[0] // self.batch_size
-        anchor = params  # round-start model (for the FedProx proximal term)
-
-        def epoch(carry, ekey):
-            p, s = carry
-            if self.dp_clip_norm > 0.0:
-                kperm, kdp = jax.random.split(ekey)
-            else:
-                # Non-DP runs keep the historical permutation stream: ekey
-                # feeds the shuffle directly, so checkpoints written before
-                # DP existed still resume bit-identically.
-                kperm = kdp = ekey
-            perm = jax.random.permutation(kperm, x.shape[0])
-            xb = x[perm][: steps * self.batch_size].reshape(steps, self.batch_size, *x.shape[1:])
-            yb = y[perm][: steps * self.batch_size].reshape(steps, self.batch_size)
-            wb = w[perm][: steps * self.batch_size].reshape(steps, self.batch_size)
-            skeys = jax.random.split(kdp, steps)
-
-            def step(carry, batch):
-                p, s = carry
-                bx, by, bw, bk = batch
-
-                def loss_fn(pp):
-                    loss = self._batch_loss(pp, bx, by, bw)
-                    if self.fedprox_mu > 0.0:
-                        loss = loss + fedprox_penalty(pp, anchor, self.fedprox_mu)
-                    return loss
-
-                if self.dp_clip_norm > 0.0:
-                    loss, grads = dp_grads(
-                        self._batch_loss, p, bx, by, bw, bk,
-                        self.dp_clip_norm, self.dp_noise_multiplier,
-                    )
-                    if self.fedprox_mu > 0.0:  # proximal pull after the DP mean
-                        loss = loss + fedprox_penalty(p, anchor, self.fedprox_mu)
-                        grads = fedprox_grad(grads, p, anchor, self.fedprox_mu)
-                else:
-                    loss, grads = jax.value_and_grad(loss_fn)(p)
-                if self.algorithm == "scaffold":  # drift correction: g + c - c_i
-                    grads = jax.tree.map(
-                        lambda g, c, ci: g + c.astype(g.dtype) - ci.astype(g.dtype),
-                        grads,
-                        c_global,
-                        c_i,
-                    )
-                updates, s2 = self.optimizer.update(grads, s, p)
-                return (optax.apply_updates(p, updates), s2), loss
-
-            (p, s), losses = jax.lax.scan(step, (p, s), (xb, yb, wb, skeys))
-            return (p, s), jnp.mean(losses)
-
-        ekeys = jax.random.split(key, epochs)
-        (params, opt_state), losses = jax.lax.scan(epoch, (params, opt_state), ekeys)
-        return params, opt_state, jnp.mean(losses)
+        """One committee member's local training (same math as
+        JaxLearner._train_epoch, including the in-jit SCAFFOLD drift
+        correction when enabled) — delegates to the shared
+        :func:`local_train_step` kernel the wire-side parity learner also
+        runs, so the two backends train with one code path."""
+        return local_train_step(
+            params, opt_state, key, x, y, w, c_i,
+            c_global=c_global,
+            epochs=epochs,
+            batch_loss=self._batch_loss,
+            optimizer=self.optimizer,
+            batch_size=self.batch_size,
+            fedprox_mu=self.fedprox_mu,
+            dp_clip_norm=self.dp_clip_norm,
+            dp_noise_multiplier=self.dp_noise_multiplier,
+            scaffold=(self.algorithm == "scaffold"),
+        )
 
     def _round_body(self, carry, key: jax.Array, do_eval: jax.Array, data, epochs: int):
         params_stack, opt_stack, c_stack, c_global = carry
@@ -567,6 +635,13 @@ class MeshSimulation:
         kv, kt = jax.random.split(key)
 
         committee = vote_committee(kv, self.num_nodes, self.train_set_size)  # [K]
+        if self.canonical_committee:
+            # Parity mode: node-index-canonical committee ORDER (the set is
+            # unchanged). Gather order, per-member key assignment and the
+            # FedAvg reduction order all become deterministic functions of
+            # the node index — the wire backend can reproduce them exactly,
+            # which is what makes cross-backend aggregates bit-comparable.
+            committee = jnp.sort(committee)
 
         # Gather committee state/data (XLA all_gather over the nodes axis).
         p_k = jax.tree.map(lambda a: a[committee], params_stack)
@@ -587,11 +662,7 @@ class MeshSimulation:
             bz = self._byz[committee]  # [K] 0/1
 
             def corrupt(new, old):
-                delta = new.astype(jnp.float32) - old.astype(jnp.float32)
-                if self._byz_attack == "signflip":
-                    attacked = old.astype(jnp.float32) - delta
-                else:  # "scaled"
-                    attacked = old.astype(jnp.float32) + 10.0 * delta
+                attacked = poison_delta(new, old, self._byz_attack)
                 sel = bz.reshape((-1,) + (1,) * (new.ndim - 1)) > 0
                 return jnp.where(sel, attacked, new.astype(jnp.float32)).astype(new.dtype)
 
@@ -880,6 +951,11 @@ class MeshSimulation:
                 test_loss.append(tl)
                 test_acc.append(ta)
                 done += chunk
+                # Trajectory-ledger emission (host-callback-free: assembled
+                # from the chunk's already-materialized committee array and
+                # the post-chunk population state, never from inside jit).
+                if self._ledger is not None:
+                    self._ledger_emit_chunk(comm, start + done - chunk, params_stack)
                 # Per chunk, not per run: a later chunk failing must not
                 # erase the noise already injected by completed chunks.
                 # (Replayed rounds after a checkpoint resume re-count,
@@ -986,6 +1062,89 @@ class MeshSimulation:
             "bytes_accessed_per_round": float(ca.get("bytes accessed", 0.0))
             / rounds_per_call,
         }
+
+    # --- trajectory ledger (sim↔real parity observability) -------------------
+
+    def attach_ledger(
+        self,
+        node: str = "mesh-sim",
+        node_names: Optional[Sequence[str]] = None,
+        run_id: Optional[str] = None,
+    ) -> Any:
+        """Emit the canonical trajectory-ledger event stream
+        (:mod:`p2pfl_tpu.telemetry.ledger`) from this simulation's round
+        step — the SAME schema the wire path emits, which is what
+        ``scripts/parity_diff.py`` aligns.
+
+        ``node_names`` maps virtual node indices to the names used in
+        ``round_open.members`` / ``contribution_folded.sender`` (the parity
+        bench passes the wire federation's addresses so the two ledgers
+        compare by name); default ``vnode/<i>``. Events are assembled
+        host-side from the per-chunk summary arrays ``run()`` already
+        materializes — no host callback enters the jitted round program.
+        The per-round ``aggregate_committed`` content hash requires the
+        post-round population state, so it is emitted for the LAST round of
+        each compiled chunk (every round when ``rounds_per_call=1``, the
+        parity-bench setting); intermediate rounds' commit events omit the
+        hash, which the parity differ treats as "present but unhashed".
+        Returns the attached :class:`TrajectoryLedger`.
+        """
+        from p2pfl_tpu.telemetry.ledger import LEDGERS
+
+        if node_names is not None:
+            names = [str(s) for s in node_names]
+            if len(names) != self.num_nodes:
+                raise ValueError(
+                    f"node_names has {len(names)} entries for "
+                    f"{self.num_nodes} virtual nodes"
+                )
+        else:
+            names = [f"vnode/{i:05d}" for i in range(self.num_nodes)]
+        if run_id is not None:
+            LEDGERS.configure(run_id)
+        self._ledger = LEDGERS.get(node)
+        self._ledger_names = names
+        if self._byz is not None:
+            byz = np.asarray(self._byz)
+            for i in np.flatnonzero(byz > 0):
+                self._ledger.emit(
+                    "chaos_fault", fault="byzantine", peer=names[int(i)],
+                    attack=self._byz_attack,
+                )
+        return self._ledger
+
+    def _ledger_emit_chunk(self, committees, first_round: int, params_stack) -> None:
+        """Emit round events for one completed chunk (see attach_ledger)."""
+        led, names = self._ledger, self._ledger_names
+        if led is None or names is None:
+            return
+        comm = np.asarray(committees)
+        samples = np.asarray(self.num_samples)
+        for ri in range(comm.shape[0]):
+            r = first_round + ri
+            members = [names[int(i)] for i in comm[ri]]
+            led.emit("round_open", round=r, members=sorted(members))
+            total = 0
+            for i in comm[ri]:
+                n_i = int(samples[int(i)])
+                total += n_i
+                led.emit(
+                    "contribution_folded", round=r, sender=names[int(i)],
+                    lag=0, num_samples=n_i,
+                )
+            commit: Dict[str, Any] = {
+                "contributors": sorted(members),
+                "num_samples": total,
+                "origin": "mesh",
+            }
+            if ri == comm.shape[0] - 1:
+                from p2pfl_tpu.telemetry.ledger import canonical_params_hash
+
+                commit["hash"] = canonical_params_hash(
+                    jax.tree.map(lambda a: a[0], params_stack)
+                )
+            led.emit("aggregate_committed", round=r, **commit)
+            led.emit("round_close", round=r)
 
     # --- fused-mesh observability --------------------------------------------
 

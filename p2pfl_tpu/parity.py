@@ -1,0 +1,433 @@
+"""Sim↔real parity harness: one seeded scenario, two execution backends.
+
+ROADMAP item 5's certification problem: the fused-mesh simulation
+(:class:`~p2pfl_tpu.parallel.simulation.MeshSimulation`) and the real wire
+federation (:class:`~p2pfl_tpu.node.Node` over gossip) are two coordination
+layers that are *supposed* to run the same federation. This module makes
+that checkable: it defines a deterministic :class:`ParityScenario` and runs
+it on BOTH backends such that every divergence is a bug, not noise — then
+the trajectory ledgers (:mod:`p2pfl_tpu.telemetry.ledger`) the two runs emit
+are compared event-by-event by ``scripts/parity_diff.py``, down to bit-exact
+``aggregate_committed`` content hashes.
+
+What makes bit-exactness possible (and honest):
+
+* **one local-train kernel** — the wire-side :class:`ParityLearner` jits the
+  same :func:`~p2pfl_tpu.parallel.simulation.local_train_step` the fused
+  round body vmaps, with the mesh's exact per-(round, node) RNG key
+  derivation (:func:`round_member_keys`); Papaya's argument (arxiv
+  2111.04877) that a simulator is trustworthy iff it shares the production
+  execution path, applied to the learner math;
+* **canonical reduction order** — the wire runs
+  :class:`~p2pfl_tpu.learning.aggregators.CanonicalFedAvg` (raw per-sender
+  contributions, contributor-sorted stack) and the mesh runs
+  ``canonical_committee=True`` (node-index-sorted committee), so both sides
+  reduce the same float vector in the same order through the same jitted
+  ``fedavg`` kernel;
+* **full committee** — the scenario pins ``TRAIN_SET_SIZE = n``: every vote
+  outcome elects everyone, so the wire's vote RNG (Python ``random``) and
+  the mesh's jitted vote kernel agree on the committee SET by construction.
+  The vote barrier itself is exercised; its RNG outcome is not — a scoped
+  limit documented in docs/components/parity.md;
+* **deterministic adversaries** — the scenario's signflip/scaled Byzantine
+  node poisons its own trained update through the shared
+  :func:`~p2pfl_tpu.parallel.simulation.poison_delta` transform (identical
+  math to the mesh's in-program corruption), so both backends fold the same
+  corrupted contribution and the ledger certifies it; the straggler is a
+  pure wall-clock delay (sync rounds absorb it) and the chaos drop trace is
+  wire-only *recoverable* loss (gossip retries) — perturbations that must
+  leave the trajectory invariant, which is exactly what the gate asserts.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.learning.learner import Learner, softmax_cross_entropy
+from p2pfl_tpu.models.model_handle import ModelHandle
+
+
+@dataclass
+class ParityScenario:
+    """One seeded federation scenario both backends can execute."""
+
+    seed: int = 1234
+    n_nodes: int = 8
+    rounds: int = 3
+    samples_per_node: int = 64
+    batch_size: int = 16
+    lr: float = 0.05
+    epochs: int = 1
+    hidden: Tuple[int, ...] = (32,)
+    #: node index -> attack ("signflip" | "scaled"): poisons its update via
+    #: the shared poison_delta transform on BOTH backends.
+    byzantine: Dict[int, str] = field(default_factory=dict)
+    #: node index -> extra seconds per fit (wire: a real sleep; mesh: the
+    #: node_speed virtual tier) — trajectory-invariant by design.
+    straggler: Dict[int, float] = field(default_factory=dict)
+    #: wire-only seeded chaos drop rate (recoverable loss; 0 disables).
+    drop_rate: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.samples_per_node % self.batch_size:
+            raise ValueError(
+                "samples_per_node must be a multiple of batch_size — a "
+                "ragged tail would be silently dropped by one backend's "
+                "batching and not the other's"
+            )
+        if len(self.byzantine) > 1 and len(set(self.byzantine.values())) > 1:
+            raise ValueError(
+                "MeshSimulation applies one attack kind per run — use a "
+                "single attack for all adversaries"
+            )
+
+    @property
+    def run_id(self) -> str:
+        return f"parity-s{self.seed}-n{self.n_nodes}-r{self.rounds}"
+
+    @property
+    def node_names(self) -> List[str]:
+        # Lexicographic order == node-index order: the wire's contributor
+        # sort and the mesh's index sort must agree.
+        return [f"parity-{i:03d}" for i in range(self.n_nodes)]
+
+    def data(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Stacked per-node arrays ``(x [N,S,28,28], y [N,S], mask [N,S])``
+        — the same bytes feed the mesh's stacked partitions and each wire
+        node's learner (class-template + gaussian noise, the
+        ``synthetic_mnist`` recipe, sized by the scenario)."""
+        rng = np.random.default_rng(self.seed)
+        n, s = self.n_nodes, self.samples_per_node
+        templates = rng.uniform(0.0, 1.0, size=(10, 28, 28)).astype(np.float32)
+        y = rng.integers(0, 10, size=(n, s)).astype(np.int32)
+        x = templates[y] + rng.normal(0.0, 0.35, size=(n, s, 28, 28)).astype(
+            np.float32
+        )
+        x = np.clip(x, 0.0, 1.0).astype(np.float32)
+        return x, y, np.ones((n, s), np.float32)
+
+    def template_model(self) -> ModelHandle:
+        from p2pfl_tpu.models import mlp_model
+
+        return mlp_model(seed=self.seed, hidden_sizes=self.hidden)
+
+
+def round_member_keys(seed: int, round_abs: int, k: int):
+    """The fused round body's per-member training keys, reproduced exactly:
+    ``base = key(seed); kv, kt = split(fold_in(base, round)); split(kt, k)``
+    (``kv`` feeds the vote kernel). Under ``canonical_committee`` member
+    ``i`` of the sorted committee — node ``i`` when the committee is the
+    whole population — trains with ``keys[i]``."""
+    import jax
+
+    rk = jax.random.fold_in(jax.random.key(int(seed)), int(round_abs))
+    _kv, kt = jax.random.split(rk)
+    return jax.random.split(kt, int(k))
+
+
+def build_train_fn(apply_fn, lr: float, batch_size: int, epochs: int):
+    """One jitted single-node trainer per scenario, shared by every wire
+    node (one compile, and — more importantly — ONE executable, so every
+    node's update is produced by the same program the mesh's vmapped kernel
+    traces)."""
+    import jax
+    import optax
+
+    from p2pfl_tpu.parallel.simulation import local_train_step
+
+    optimizer = optax.sgd(lr)
+
+    def batch_loss(p, bx, by, bw):
+        return softmax_cross_entropy(apply_fn(p, bx), by, bw)
+
+    @jax.jit
+    def train(params, x, y, w, key):
+        new_params, _opt, loss = local_train_step(
+            params, optimizer.init(params), key, x, y, w, {},
+            c_global={}, epochs=epochs, batch_loss=batch_loss,
+            optimizer=optimizer, batch_size=batch_size,
+        )
+        return new_params, loss
+
+    return train
+
+
+class ParityLearner(Learner):
+    """Wire-side learner of the parity scenario: trains with the shared
+    mesh kernel and the mesh's key schedule, so node ``i``'s round-``r``
+    update is bit-identical across backends. The scenario's Byzantine
+    node applies :func:`poison_delta` to its own update (model poisoning at
+    the source — deterministic, unlike per-frame chaos corruption); the
+    straggler sleeps (trajectory-invariant in a sync round)."""
+
+    def __init__(
+        self,
+        model: Optional[ModelHandle] = None,
+        data=None,
+        self_addr: str = "unknown-node",
+        node_idx: int = 0,
+        scenario: Optional[ParityScenario] = None,
+        arrays: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None,
+        train_fn=None,
+        **_: Any,
+    ) -> None:
+        super().__init__(model, data, self_addr)
+        if scenario is None or arrays is None:
+            raise ValueError("ParityLearner needs scenario= and arrays=")
+        self.node_idx = int(node_idx)
+        self.scenario = scenario
+        self._x, self._y, self._w = arrays
+        self._train_fn = train_fn or build_train_fn(
+            self.get_model().apply_fn, scenario.lr,
+            scenario.batch_size, scenario.epochs,
+        )
+        self._fits = 0
+        self._attack = scenario.byzantine.get(self.node_idx)
+        self._delay_s = float(scenario.straggler.get(self.node_idx, 0.0))
+
+    def get_framework(self) -> str:
+        return "jax"
+
+    def interrupt_fit(self) -> None:  # parity fits are short and atomic
+        pass
+
+    def fit(self) -> ModelHandle:
+        import jax
+
+        from p2pfl_tpu.parallel.simulation import poison_delta
+
+        r = self._fits
+        self._fits += 1
+        if self._delay_s > 0.0:
+            time.sleep(self._delay_s)
+        scn = self.scenario
+        keys = round_member_keys(scn.seed, r, scn.n_nodes)
+        model = self.get_model()
+        start = model.params
+        new_params, _loss = self._train_fn(
+            start, self._x, self._y, self._w, keys[self.node_idx]
+        )
+        if self._attack:
+            new_params = jax.tree.map(
+                lambda new, old: poison_delta(new, old, self._attack).astype(
+                    new.dtype
+                ),
+                new_params,
+                start,
+            )
+        model.set_parameters(new_params)
+        model.set_contribution([self._self_addr], int(self._w.sum()))
+        return model
+
+    def evaluate(self) -> Dict[str, float]:
+        return {}
+
+
+# --- backend runners ----------------------------------------------------------
+
+
+def run_wire(
+    scn: ParityScenario,
+    ledger_dir: Optional[str] = None,
+    timeout_s: float = 600.0,
+) -> Dict[str, Any]:
+    """Run the scenario on the REAL wire (in-memory transport, full
+    Node/gossip/admission/aggregator stack), dump every node's trajectory
+    ledger, and return ``{"ledgers": {addr: path-or-None}, "hashes":
+    {addr: {round: hash}}, "events": {addr: [...]}}``."""
+    from p2pfl_tpu.chaos import CHAOS
+    from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+    from p2pfl_tpu.learning.aggregators import CanonicalFedAvg
+    from p2pfl_tpu.learning.dataset.dataset import FederatedDataset
+    from p2pfl_tpu.node import Node
+    from p2pfl_tpu.telemetry.ledger import LEDGERS
+    from p2pfl_tpu.utils.utils import set_test_settings, wait_convergence
+
+    snap = Settings.snapshot()
+    names = scn.node_names
+    x, y, w = scn.data()
+    template = scn.template_model()
+    train_fn = build_train_fn(
+        template.apply_fn, scn.lr, scn.batch_size, scn.epochs
+    )
+    nodes: List[Node] = []
+    try:
+        set_test_settings()
+        Settings.LOG_LEVEL = "WARNING"
+        Settings.RESOURCE_MONITOR_PERIOD = 0
+        Settings.LEDGER_ENABLED = True
+        Settings.TRAIN_SET_SIZE = scn.n_nodes  # full committee (module doc)
+        Settings.WIRE_COMPRESSION = "none"  # lossless frames only
+        Settings.VOTE_TIMEOUT = 20.0
+        Settings.AGGREGATION_TIMEOUT = 120.0
+        # The seeded straggler must NOT trip partial aggregation — a partial
+        # fold would be a real (and correctly detected) divergence.
+        Settings.AGGREGATION_STALL_PATIENCE = 60.0
+        # CanonicalFedAvg ships RAW per-sender models (no merged partials),
+        # so full diffusion leans on peers' models_aggregated reports
+        # advancing the gossip status. While peers are still fitting (first
+        # jit compile + the seeded straggler's delay) that status is
+        # legitimately frozen — the default 20-equal-ticks exit would
+        # abandon the partial gossip before the round even warms up. Give
+        # the loop a stalled-status budget that outlasts any fit, and fan
+        # out to every candidate per tick (n is small in parity scenarios).
+        Settings.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS = 400
+        Settings.GOSSIP_MODELS_PER_ROUND = scn.n_nodes
+        CHAOS.reset()
+        if scn.drop_rate > 0.0:
+            Settings.CHAOS_ENABLED = True
+            Settings.CHAOS_SEED = scn.seed
+            Settings.CHAOS_DROP_RATE = float(scn.drop_rate)
+        LEDGERS.reset()
+        LEDGERS.configure(scn.run_id)
+
+        for i, name in enumerate(names):
+            data = FederatedDataset.from_arrays(x[i], y[i])
+            nodes.append(
+                Node(
+                    template.build_copy(),
+                    data,
+                    addr=name,
+                    learner=ParityLearner,
+                    aggregator=CanonicalFedAvg(),
+                    executor=False,
+                    node_idx=i,
+                    scenario=scn,
+                    arrays=(x[i], y[i], w[i]),
+                    train_fn=train_fn,
+                )
+            )
+        for nd in nodes:
+            nd.start()
+        for i in range(1, len(nodes)):
+            nodes[i].connect(nodes[0].addr)
+        wait_convergence(nodes, scn.n_nodes - 1, wait=30)
+        nodes[0].set_start_learning(rounds=scn.rounds, epochs=scn.epochs)
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if all(
+                not nd.learning_in_progress()
+                and nd.learning_workflow is not None
+                for nd in nodes
+            ):
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError("parity wire federation did not finish")
+
+        out: Dict[str, Any] = {"ledgers": {}, "hashes": {}, "events": {}}
+        for name in names:
+            led = LEDGERS.peek(name)
+            events = led.canonical_events() if led is not None else []
+            out["events"][name] = events
+            out["hashes"][name] = {
+                ev["round"]: ev["hash"]
+                for ev in events
+                if ev["kind"] == "aggregate_committed" and "hash" in ev
+            }
+            path = None
+            if ledger_dir is not None and led is not None:
+                path = led.dump(
+                    os.path.join(ledger_dir, f"ledger_{name}.jsonl")
+                )
+            out["ledgers"][name] = path
+        return out
+    finally:
+        for nd in nodes:
+            try:
+                nd.stop()
+            except Exception:  # noqa: BLE001 — teardown must not mask results
+                pass
+        InMemoryRegistry.reset()
+        CHAOS.reset()
+        Settings.restore(snap)
+
+
+def run_fused(
+    scn: ParityScenario, ledger_dir: Optional[str] = None, mesh=None
+) -> Dict[str, Any]:
+    """Run the scenario on the fused mesh (:class:`MeshSimulation`,
+    ``canonical_committee=True``, ledger attached with the wire's node
+    names, one compiled round per call so every round's aggregate hash
+    materializes). Returns ``{"ledger": path-or-None, "events": [...],
+    "hashes": {round: hash}}``."""
+    import optax
+
+    from p2pfl_tpu.parallel.simulation import MeshSimulation
+    from p2pfl_tpu.telemetry.ledger import LEDGERS
+
+    snap = Settings.snapshot()
+    names = scn.node_names
+    x, y, w = scn.data()
+    byz_mask = None
+    attack = "signflip"
+    if scn.byzantine:
+        byz_mask = np.zeros(scn.n_nodes, np.float32)
+        for idx, att in scn.byzantine.items():
+            byz_mask[int(idx)] = 1.0
+            attack = att
+    speed = None
+    if scn.straggler:
+        speed = np.ones(scn.n_nodes, np.float32)
+        for idx, delay in scn.straggler.items():
+            speed[int(idx)] = 1.0 + float(delay)
+    sim = None
+    try:
+        Settings.LEDGER_ENABLED = True
+        LEDGERS.configure(scn.run_id)
+        sim = MeshSimulation(
+            model=scn.template_model(),
+            partitions=(x, y, w),
+            test_data=None,
+            train_set_size=scn.n_nodes,
+            batch_size=scn.batch_size,
+            lr=scn.lr,
+            optimizer=optax.sgd(scn.lr),
+            seed=scn.seed,
+            byzantine_mask=byz_mask,
+            byzantine_attack=attack,
+            node_speed=speed,
+            canonical_committee=True,
+            mesh=mesh,
+        )
+        led = sim.attach_ledger(node="mesh-sim", node_names=names)
+        sim.run(
+            scn.rounds, epochs=scn.epochs, warmup=False, rounds_per_call=1
+        )
+        events = led.canonical_events()
+        path = None
+        if ledger_dir is not None:
+            path = led.dump(os.path.join(ledger_dir, "ledger_mesh-sim.jsonl"))
+        return {
+            "ledger": path,
+            "events": events,
+            "hashes": {
+                ev["round"]: ev["hash"]
+                for ev in events
+                if ev["kind"] == "aggregate_committed" and "hash" in ev
+            },
+        }
+    finally:
+        if sim is not None:
+            # Drop the population's device buffers; the jit-cache entry
+            # keyed on this sim would otherwise pin them for the process
+            # (MeshSimulation.close docstring). Cache clearing is safe for
+            # callers — later jits recompile.
+            sim.close()
+        Settings.restore(snap)
+
+
+__all__ = [
+    "ParityScenario",
+    "ParityLearner",
+    "build_train_fn",
+    "round_member_keys",
+    "run_wire",
+    "run_fused",
+]

@@ -46,6 +46,7 @@ from p2pfl_tpu.config import Settings
 from p2pfl_tpu.stages.base_node import TrainStage, establish_initial_model
 from p2pfl_tpu.stages.stage import Stage, check_early_stop
 from p2pfl_tpu.telemetry import REGISTRY, TRACER
+from p2pfl_tpu.telemetry.ledger import LEDGERS, canonical_params_hash
 
 if TYPE_CHECKING:  # pragma: no cover
     from p2pfl_tpu.node import Node
@@ -175,6 +176,12 @@ class AsyncWindowStage(Stage):
         t0 = time.perf_counter()
         agg.open_window(w)
         solicit, _ = select_participants(node)
+        # Trajectory ledger: async windows are the scheduler's rounds — the
+        # fill target's peer set is the closest analogue of a committee.
+        LEDGERS.emit(
+            node.addr, "window_open", round=w,
+            members=sorted(solicit + [node.addr]),
+        )
 
         with TRACER.span("fit", node=node.addr, round=w):
             with device_trace_window(Settings.PERF_TRACE_DIR, label="fit"):
@@ -233,6 +240,17 @@ class AsyncWindowStage(Stage):
         ):
             pass
 
+        if LEDGERS.enabled():
+            LEDGERS.get(node.addr).emit(
+                "aggregate_committed",
+                round=w,
+                dedup_key=("commit", w),
+                hash=canonical_params_hash(aggregated.params),
+                contributors=sorted(aggregated.contributors),
+                num_samples=aggregated.get_num_samples(),
+                origin="window",
+                reason=agg.last_close_reason,
+            )
         model = node.learner.get_model()
         model.set_parameters(aggregated.params)
         model.set_contribution(aggregated.contributors, aggregated.get_num_samples())
@@ -265,6 +283,7 @@ class AsyncWindowFinishedStage(Stage):
             node.log_metric(
                 "async_window_staleness", float(node.async_agg.last_mean_lag)
             )
+        LEDGERS.emit(node.addr, "window_close", round=finished)
         state.increase_round()
         state.wire.set_anchor(
             node.learner.get_model().get_parameters(), state.round or 0

@@ -27,6 +27,7 @@ from p2pfl_tpu.comm.envelope import Envelope
 from p2pfl_tpu.config import Settings
 from p2pfl_tpu.stages.stage import Stage, check_early_stop
 from p2pfl_tpu.telemetry import TRACER
+from p2pfl_tpu.telemetry.ledger import LEDGERS, canonical_params_hash
 
 if TYPE_CHECKING:  # pragma: no cover
     from p2pfl_tpu.node import Node
@@ -210,6 +211,12 @@ class VoteTrainSetStage(Stage):
         live = set(node.protocol.get_neighbors(only_direct=False)) | {node.addr}
         state.train_set = [n for n in train_set if n in live]
         log.info("%s: round %s trainset %s", node.addr, state.round, state.train_set)
+        # Trajectory ledger: the round opens with its elected committee —
+        # the first event parity_diff aligns a round on.
+        LEDGERS.emit(
+            node.addr, "round_open", round=state.round or 0,
+            members=sorted(state.train_set),
+        )
 
         if check_early_stop(node):
             return None
@@ -225,7 +232,7 @@ class TrainStage(Stage):
     @staticmethod
     def execute(node: "Node") -> Optional[Type[Stage]]:
         state = node.state
-        node.aggregator.set_nodes_to_aggregate(state.train_set)
+        node.aggregator.set_nodes_to_aggregate(state.train_set, round=state.round or 0)
 
         # Evaluate + share metrics (reference :102-116).
         TrainStage._evaluate_and_broadcast(node)
@@ -286,6 +293,21 @@ class TrainStage(Stage):
         # closes the window where a Byzantine peer's corrupted full model
         # could clobber an honest aggregate post-aggregation).
         state.note_full_model_round(state.round or 0)
+        if LEDGERS.enabled():
+            # Content hash of the committed round aggregate: the value the
+            # parity gate compares bit-for-bit against the fused mesh.
+            # dedup: ONE commit per round, first wins — mirrors the
+            # note_full_model_round adoption contract (a racing full_model
+            # frame that beat us to adoption already committed this round).
+            LEDGERS.get(node.addr).emit(
+                "aggregate_committed",
+                round=state.round or 0,
+                dedup_key=("commit", state.round or 0),
+                hash=canonical_params_hash(aggregated.params),
+                contributors=sorted(aggregated.contributors),
+                num_samples=aggregated.get_num_samples(),
+                origin="train",
+            )
         state.aggregated_model_event.set()
         node.protocol.broadcast(
             node.protocol.build_msg(ModelsReadyCommand.get_name(), round=state.round or 0)
@@ -503,6 +525,7 @@ class RoundFinishedStage(Stage):
         node.log_metric(
             "wire_tx_bytes", float(node.protocol.gossiper.bytes_for_round(finished))
         )
+        LEDGERS.emit(node.addr, "round_close", round=finished)
         node.aggregator.clear()
         state.increase_round()
         # New round, new delta anchor: every node enters round r holding the

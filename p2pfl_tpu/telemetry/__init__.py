@@ -27,7 +27,11 @@ The federation observatory builds on both halves:
   derived straggler / suspect / link scores (``p2pfl_fed_*`` section),
   TTL eviction and bounded population-overflow tracking,
 * :mod:`p2pfl_tpu.telemetry.flight_recorder` — the bounded postmortem
-  event ring dumped to ``artifacts/flightrec_<node>.json`` on failure.
+  event ring dumped to ``artifacts/flightrec_<node>.json`` on failure,
+* :mod:`p2pfl_tpu.telemetry.ledger` — the deterministic trajectory ledger
+  both execution backends (wire and fused mesh) emit identically; the
+  sim↔real parity gate (``scripts/parity_diff.py``, ``bench.py --parity``)
+  is built on its canonical dumps.
 
 The performance attribution plane builds on the tracer:
 
@@ -54,6 +58,11 @@ from p2pfl_tpu.telemetry.sketches import (  # noqa: F401
     QuantileSketch,
     SKETCHES,
 )
+from p2pfl_tpu.telemetry.ledger import (  # noqa: F401
+    LEDGERS,
+    TrajectoryLedger,
+    canonical_params_hash,
+)
 
 __all__ = [
     "Counter",
@@ -61,10 +70,13 @@ __all__ = [
     "DistinctEstimator",
     "Gauge",
     "Histogram",
+    "LEDGERS",
     "MetricsRegistry",
     "QuantileSketch",
     "REGISTRY",
     "SKETCHES",
     "TRACER",
     "Tracer",
+    "TrajectoryLedger",
+    "canonical_params_hash",
 ]

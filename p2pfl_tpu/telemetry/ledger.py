@@ -1,0 +1,352 @@
+"""Trajectory ledger — the canonical event stream both backends emit.
+
+The fused mesh (``parallel/simulation.py``) and the real gRPC/in-memory wire
+are two execution paths that agree only by convention: nothing *certified*
+that an n=512 fused result describes the same federation an 8-node wire run
+does. This module is the observable half of that certification (ROADMAP
+item 5; Papaya — arxiv 2111.04877 — trusts its simulator precisely because
+sim and production share one recorded execution path): a deterministic,
+seed-stable, append-only ledger of **versioned structured events**
+
+========================  =====================================================
+kind                      fields (beyond ``v``/``kind``/``round``)
+========================  =====================================================
+``round_open``            ``members`` — the elected committee, sorted
+``window_open``           async: the window index in ``round``
+``contribution_folded``   ``sender``, ``lag``, ``num_samples``
+``aggregate_committed``   ``hash`` (content hash of the adopted params),
+                          ``contributors`` (sorted), ``num_samples``;
+                          optional ``origin`` (``train``/``full_model``/
+                          ``window``) and ``reason`` (async close reason)
+``round_close``           —
+``window_close``          —
+``membership``            ``event`` (join/rejoin/leave/evict/recover),
+                          ``peer``
+``chaos_fault``           ``fault`` (churn/recovery/byzantine), ``peer``,
+                          step detail fields
+``admission_rejected``    ``sender``, ``reason`` (deduped per
+                          (round, sender, reason) — a gossip loop
+                          re-shipping one bad frame is one trajectory fact)
+========================  =====================================================
+
+emitted from the sync and async schedulers, the aggregators, wire admission,
+the membership/observatory plane, the chaos plane AND the fused-mesh round
+step — same schema, either backend. Events carry **no wall-clock**: the
+ledger records *what the federation did*, not when, which is what makes the
+same seeded scenario produce byte-identical ledgers across runs and across
+backends (timing lives in the tracer / flight recorder).
+
+Each per-node ledger is an append-only bounded ring with monotonic live
+sequence numbers; :meth:`TrajectoryLedger.dump` writes
+``artifacts/ledger_<node>.jsonl`` in **canonical** form — events sorted by
+``(round, kind rank, sender, …)`` with canonical sequence numbers — so two
+runs that produced the same event *set* produce byte-identical files
+regardless of transport-thread interleaving (``canonical=False`` preserves
+arrival order + live seq for debugging). ``scripts/parity_diff.py`` aligns
+two dumps and localizes the first divergent event; ``bench.py --parity``
+and ``make parity-check`` are the gates built on it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.telemetry.metrics import REGISTRY
+
+#: bump when an event's field semantics change; readers tolerate (and skip)
+#: versions they don't know.
+LEDGER_SCHEMA_VERSION = 1
+
+#: canonical within-round ordering of event kinds (scenario facts before the
+#: contributions they shaped, contributions before the aggregate they fed).
+KIND_RANK = {
+    "round_open": 0,
+    "window_open": 0,
+    "chaos_fault": 1,
+    "membership": 2,
+    "admission_rejected": 3,
+    "contribution_folded": 4,
+    "aggregate_committed": 5,
+    "window_close": 6,
+    "round_close": 6,
+}
+
+#: kinds parity_diff compares by default — the trajectory proper. The rest
+#: (chaos faults, admission rejections, membership) are environment /
+#: defense facts that legitimately differ between backends (the fused mesh
+#: has no wire to drop frames from) and are compared only on request.
+TRAJECTORY_KINDS = (
+    "round_open",
+    "window_open",
+    "contribution_folded",
+    "aggregate_committed",
+    "window_close",
+    "round_close",
+)
+
+#: provenance fields stripped from CANONICAL events/dumps: which code path
+#: committed first (``origin``: own aggregate vs adopted full model — the
+#: values are bit-identical, first wins) and why an async window closed
+#: (``reason``) are timing facts, not trajectory facts; keeping them would
+#: break byte-identical dumps across reruns. Raw events keep them.
+NONCANONICAL_FIELDS = ("origin", "reason")
+
+_EVENTS = REGISTRY.counter(
+    "p2pfl_ledger_events_total",
+    "Trajectory-ledger events appended, by node and event kind",
+    labels=("node", "kind"),
+)
+
+
+def canonical_params_hash(params: Any) -> str:
+    """Content hash of a parameter pytree, stable across backends.
+
+    Canonicalization rules (documented in docs/components/parity.md):
+
+    * leaves are taken in ``jax.tree.leaves`` order (the tree's flatten
+      order — identical for a :class:`ModelHandle` params tree and the
+      fused mesh's per-node slice of the stacked population);
+    * float leaves are cast to little-endian float32, ``-0.0`` is
+      normalized to ``+0.0`` and every NaN payload collapses to the one
+      canonical quiet NaN — a hash difference always means a *value*
+      difference;
+    * integer/bool leaves are cast to little-endian int64 / uint8;
+    * each leaf contributes its index, shape and dtype class, so a
+      reshape can never alias a value change.
+
+    Returns ``"sha256:<hex>"``.
+    """
+    import numpy as np
+
+    if isinstance(params, (list, tuple)):
+        leaves = list(params)
+    else:
+        import jax
+
+        leaves = jax.tree.leaves(params)
+    h = hashlib.sha256()
+    h.update(f"pfl-ledger-hash-v1:{len(leaves)};".encode())
+    for i, leaf in enumerate(leaves):
+        a = np.asarray(leaf)
+        if np.issubdtype(a.dtype, np.floating):
+            a = np.ascontiguousarray(a, dtype="<f4") + np.float32(0.0)
+            a = np.where(np.isnan(a), np.float32(np.nan), a)
+            kind = "f"
+        elif np.issubdtype(a.dtype, np.bool_):
+            a = np.ascontiguousarray(a, dtype="u1")
+            kind = "b"
+        else:
+            a = np.ascontiguousarray(a, dtype="<i8")
+            kind = "i"
+        h.update(f"{i}:{kind}:{a.shape};".encode())
+        h.update(a.tobytes(order="C"))
+    return f"sha256:{h.hexdigest()}"
+
+
+def _canonical_sort_key(ev: Dict[str, Any]):
+    rnd = ev.get("round")
+    return (
+        rnd if isinstance(rnd, (int, float)) else -1,
+        KIND_RANK.get(ev.get("kind"), 9),
+        str(ev.get("kind", "")),
+        str(ev.get("sender", ev.get("peer", ""))),
+        json.dumps(
+            {k: v for k, v in ev.items() if k != "seq"},
+            sort_keys=True, separators=(",", ":"),
+        ),
+    )
+
+
+class TrajectoryLedger:
+    """One node's append-only event ring (bounded by LEDGER_CAPACITY)."""
+
+    def __init__(self, node: str, run_id: str = "") -> None:
+        self.node = node
+        self.run_id = run_id
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=max(16, int(Settings.LEDGER_CAPACITY)))
+        self._seq = 0
+        self._dropped = 0
+        #: last round/window opened — stamps events whose emitter doesn't
+        #: know the round (membership transitions, admission rejections).
+        self.current_round: Optional[int] = None
+        #: dedup keys already emitted (admission rejections collapse to one
+        #: trajectory fact per (round, sender, reason)).
+        self._dedup: set = set()
+
+    def emit(
+        self,
+        kind: str,
+        round: Optional[int] = None,
+        dedup_key: Optional[tuple] = None,
+        **fields: Any,
+    ) -> bool:
+        """Append one event; returns False when deduped. ``round`` stays
+        None when the emitter has no round context (membership transitions,
+        pre-session chaos steps) — a timing-dependent guess here would
+        break the byte-identical-across-runs guarantee the canonical dump
+        makes. ``current_round`` (updated by round/window_open) is offered
+        to emitters that WANT a best-effort stamp (wire admission)."""
+        with self._lock:
+            if dedup_key is not None:
+                if dedup_key in self._dedup:
+                    return False
+                self._dedup.add(dedup_key)
+            if kind in ("round_open", "window_open") and round is not None:
+                self.current_round = int(round)
+            ev: Dict[str, Any] = {
+                "v": LEDGER_SCHEMA_VERSION,
+                "seq": self._seq,
+                "kind": kind,
+                "round": int(round) if round is not None else None,
+            }
+            ev.update(fields)
+            if len(self._events) == self._events.maxlen:
+                self._dropped += 1
+            self._events.append(ev)
+            self._seq += 1
+        _EVENTS.labels(self.node, kind).inc()
+        return True
+
+    # --- reading -------------------------------------------------------------
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e) for e in self._events]
+
+    def tail(self, n: int) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e) for e in list(self._events)[-max(0, int(n)):]]
+
+    def canonical_events(self) -> List[Dict[str, Any]]:
+        """Events in canonical order (round, kind rank, sender, payload)
+        with canonical sequence numbers — byte-stable across runs that
+        produced the same event set."""
+        evs = sorted(
+            (
+                {k: v for k, v in ev.items() if k not in NONCANONICAL_FIELDS}
+                for ev in self.events()
+            ),
+            key=_canonical_sort_key,
+        )
+        out = []
+        for i, ev in enumerate(evs):
+            ev["seq"] = i
+            out.append(ev)
+        return out
+
+    # --- dumping -------------------------------------------------------------
+
+    def dump(self, path: str, canonical: bool = True) -> str:
+        """Write the ledger as JSONL (header line + one event per line).
+        Canonical mode (default) re-orders deterministically and re-numbers
+        ``seq``; ``canonical=False`` keeps arrival order + live seq."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        header = {
+            "ledger": "trajectory",
+            "v": LEDGER_SCHEMA_VERSION,
+            "node": self.node,
+            "run_id": self.run_id,
+            "canonical": bool(canonical),
+            "dropped": self._dropped,
+        }
+        evs = self.canonical_events() if canonical else self.events()
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(header, sort_keys=True, separators=(",", ":")) + "\n")
+            for ev in evs:
+                f.write(json.dumps(ev, sort_keys=True, separators=(",", ":")) + "\n")
+        os.replace(tmp, path)
+        return path
+
+
+def _safe_name(node: str) -> str:
+    return re.sub(r"[^A-Za-z0-9._-]+", "_", node)
+
+
+class LedgerHub:
+    """Process-wide per-node ledger registry (the REGISTRY/SKETCHES
+    pattern): emission points address ledgers by node name, tests and the
+    dump path enumerate them. Every method is a cheap no-op while
+    ``Settings.LEDGER_ENABLED`` is off."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._ledgers: Dict[str, TrajectoryLedger] = {}
+        self._run_id = ""
+
+    @staticmethod
+    def enabled() -> bool:
+        return bool(Settings.LEDGER_ENABLED)
+
+    def configure(self, run_id: str) -> None:
+        """Set the experiment-wide run id stamped into every ledger created
+        (or already live) in this process — the parity benches derive it
+        from the scenario seed so both backends' dumps carry the same id."""
+        with self._lock:
+            self._run_id = str(run_id)
+            for led in self._ledgers.values():
+                led.run_id = self._run_id
+
+    def get(self, node: str) -> TrajectoryLedger:
+        with self._lock:
+            led = self._ledgers.get(node)
+            if led is None:
+                led = TrajectoryLedger(node, run_id=self._run_id)
+                self._ledgers[node] = led
+            return led
+
+    def peek(self, node: str) -> Optional[TrajectoryLedger]:
+        with self._lock:
+            return self._ledgers.get(node)
+
+    def emit(self, node: str, kind: str, **fields: Any) -> bool:
+        if not self.enabled():
+            return False
+        return self.get(node).emit(kind, **fields)
+
+    def nodes(self) -> List[str]:
+        with self._lock:
+            return sorted(self._ledgers)
+
+    def dump_all(self, directory: str, canonical: bool = True) -> List[str]:
+        """Write ``ledger_<node>.jsonl`` per live ledger; returns paths."""
+        paths = []
+        for node in self.nodes():
+            led = self.peek(node)
+            if led is None:
+                continue
+            paths.append(
+                led.dump(
+                    os.path.join(directory, f"ledger_{_safe_name(node)}.jsonl"),
+                    canonical=canonical,
+                )
+            )
+        return paths
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ledgers.clear()
+            self._run_id = ""
+
+
+#: process-wide hub every emission point writes through.
+LEDGERS = LedgerHub()
+
+
+__all__ = [
+    "LEDGER_SCHEMA_VERSION",
+    "KIND_RANK",
+    "TRAJECTORY_KINDS",
+    "TrajectoryLedger",
+    "LedgerHub",
+    "LEDGERS",
+    "canonical_params_hash",
+]

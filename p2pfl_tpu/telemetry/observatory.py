@@ -186,6 +186,12 @@ class Observatory:
                 rec.record("membership", event=event, peer=peer)
             except Exception:  # noqa: BLE001 — observability must not raise
                 pass
+        # Trajectory ledger: this method is THE membership choke point —
+        # join/rejoin/leave/evict/recover all pass through here, so the
+        # ledger's membership stream needs exactly one emission site.
+        from p2pfl_tpu.telemetry.ledger import LEDGERS
+
+        LEDGERS.emit(self._addr, "membership", event=event, peer=peer)
 
     # --- ingest --------------------------------------------------------------
 
@@ -601,7 +607,7 @@ class Observatory:
                     self._overflow_top.items(), key=lambda kv: kv[1][0]
                 )[:_TOP_CANDIDATES]
             ]
-        return {
+        doc = {
             "observer": self._addr,
             "written_at": time.time(),
             "peers": peers,
@@ -616,6 +622,20 @@ class Observatory:
             "top_straggler": self.top("straggler"),
             "top_suspect": self.top("suspect"),
         }
+        # Trajectory-ledger tail: the observer's last few canonical events
+        # ride the snapshot so fed_top's PARITY panel shows what the
+        # federation just DID (rounds opened, contributions folded,
+        # aggregates committed) next to how it is doing.
+        from p2pfl_tpu.telemetry.ledger import LEDGERS
+
+        led = LEDGERS.peek(self._addr)
+        tail_n = int(Settings.LEDGER_SNAPSHOT_TAIL)
+        if led is not None and tail_n > 0:
+            doc["ledger"] = {
+                "run_id": led.run_id,
+                "events": led.tail(tail_n),
+            }
+        return doc
 
     def write_snapshot(self, path: str) -> str:
         """Atomically write :meth:`snapshot` as JSON to ``path`` (the file

@@ -52,7 +52,42 @@ def _short(addr: str, width: int = 22) -> str:
     return addr if len(addr) <= width else "…" + addr[-(width - 1):]
 
 
-def render(snap: Dict[str, Any], color: bool = True) -> str:
+def _parity_banner(parity: Dict[str, Any]) -> str:
+    """One-line OK/DIVERGED summary of an ``artifacts/parity_diff.json``
+    report (scripts/parity_diff.py)."""
+    if parity.get("status") == "OK":
+        return (
+            f"PARITY OK — {parity.get('compared_events', 0)} events aligned, "
+            f"{parity.get('hashes_compared', 0)} aggregate hashes bit-exact"
+        )
+    fd = parity.get("first_divergence") or {}
+    ev = fd.get("a") or fd.get("b") or {}
+    where = f"{ev.get('kind', '?')}@round {ev.get('round', '?')}"
+    return f"PARITY DIVERGED @ {where}: {fd.get('problem', '?')}"
+
+
+def _ledger_line(ev: Dict[str, Any]) -> str:
+    kind = ev.get("kind", "?")
+    rnd = ev.get("round")
+    bits = [f"r{rnd}" if rnd is not None else "r-", f"{kind:<20}"]
+    if "sender" in ev:
+        bits.append(_short(str(ev["sender"]), 18))
+    if "peer" in ev:
+        bits.append(f"{ev.get('event', '')} {_short(str(ev['peer']), 18)}".strip())
+    if "members" in ev:
+        bits.append(f"{len(ev['members'])} members")
+    if "hash" in ev:
+        bits.append(str(ev["hash"])[:23] + "…")
+    if "lag" in ev and ev.get("lag"):
+        bits.append(f"lag {ev['lag']}")
+    return "  ".join(bits)
+
+
+def render(
+    snap: Dict[str, Any],
+    color: bool = True,
+    parity: "Dict[str, Any] | None" = None,
+) -> str:
     def paint(code: str, s: str) -> str:
         return f"{code}{s}{_RESET}" if color else s
 
@@ -139,6 +174,20 @@ def render(snap: Dict[str, Any], color: bool = True) -> str:
                     f"({age:.0f}s ago)",
                 )
             )
+    ledger = snap.get("ledger") or {}
+    tail = ledger.get("events") or []
+    if tail or parity is not None:
+        title = "PARITY / trajectory ledger"
+        if ledger.get("run_id"):
+            title += f" (run {ledger['run_id']})"
+        lines.append(paint(_BOLD, title + ":"))
+        if parity is not None:
+            banner = _parity_banner(parity)
+            lines.append(
+                paint(_RED if "DIVERGED" in banner else _DIM, f"  {banner}")
+            )
+        for ev in tail[-8:]:
+            lines.append(paint(_DIM, f"  {_ledger_line(ev)}"))
     written = snap.get("written_at")
     if written:
         lines.append(
@@ -158,11 +207,22 @@ def main() -> int:
     args = ap.parse_args()
 
     color = sys.stdout.isatty() or not args.once
+    # The parity report (scripts/parity_diff.py --out) lives next to the
+    # snapshot; when present its OK/DIVERGED banner heads the ledger panel.
+    parity_path = os.path.join(
+        os.path.dirname(args.path) or ".", "parity_diff.json"
+    )
     while True:
+        parity = None
+        try:
+            with open(parity_path) as f:
+                parity = json.load(f)
+        except (OSError, ValueError):
+            parity = None
         try:
             with open(args.path) as f:
                 snap = json.load(f)
-            frame = render(snap, color=color and not args.once)
+            frame = render(snap, color=color and not args.once, parity=parity)
         except FileNotFoundError:
             frame = (
                 f"waiting for {args.path} — run a federation that writes the "

@@ -1,0 +1,147 @@
+"""parity-check — the sim↔real parity gate (fast CI shape, ~25 s).
+
+Runs one seeded :class:`~p2pfl_tpu.parity.ParityScenario` at
+``Settings.PARITY_NODES`` / ``Settings.PARITY_ROUNDS`` (default 3 nodes, 2
+rounds — no chaos, no adversary: the quick gate certifies the clean
+trajectory; the adversarial shape is ``bench.py --parity``) on BOTH
+execution backends:
+
+1. the real wire — in-memory transport, full Node / gossip / admission /
+   aggregator stack, the shared parity-learner kernel,
+2. the fused mesh — ``MeshSimulation(canonical_committee=True)``.
+
+and asserts, via ``scripts/parity_diff.py`` over the emitted trajectory
+ledgers:
+
+* every wire node's per-round aggregate hashes agree,
+* the wire trajectory aligns event-for-event with the mesh trajectory,
+* every round's aggregate content hash is BIT-EXACT across backends,
+* a deliberately perturbed event is localized (negative control — the
+  differ must prove it can fail).
+
+Exit 0 on pass, 1 on failure. ``make parity-check`` wires it next to the
+other plane gates.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _load_parity_diff():
+    spec = importlib.util.spec_from_file_location(
+        "parity_diff", os.path.join(REPO, "scripts", "parity_diff.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from p2pfl_tpu.config import Settings
+    from p2pfl_tpu.parity import ParityScenario, run_fused, run_wire
+
+    parity_diff = _load_parity_diff()
+    scn = ParityScenario(
+        seed=Settings.PARITY_SEED,
+        n_nodes=Settings.PARITY_NODES,
+        rounds=Settings.PARITY_ROUNDS,
+        samples_per_node=32,
+        batch_size=16,
+        hidden=(16,),
+    )
+    tmp = tempfile.mkdtemp(prefix="parity_check_")
+    t0 = time.monotonic()
+    print(
+        f"parity-check: scenario seed={scn.seed} n={scn.n_nodes} "
+        f"rounds={scn.rounds} — wire arm...",
+        file=sys.stderr,
+    )
+    wire = run_wire(scn, ledger_dir=tmp, timeout_s=180.0)
+    print(
+        f"parity-check: wire done ({time.monotonic() - t0:.1f}s) — fused arm...",
+        file=sys.stderr,
+    )
+    fused = run_fused(scn, ledger_dir=tmp)
+
+    names = scn.node_names
+    ref = wire["hashes"][names[0]]
+    if len(ref) != scn.rounds:
+        print(
+            f"FAIL: wire node0 committed rounds {sorted(ref)} "
+            f"(wanted {scn.rounds})",
+            file=sys.stderr,
+        )
+        return 1
+    for n in names:
+        if wire["hashes"][n] != ref:
+            print(
+                f"FAIL: wire nodes disagree — {n}: {wire['hashes'][n]} vs "
+                f"{names[0]}: {ref}",
+                file=sys.stderr,
+            )
+            return 1
+    print("PASS: all wire nodes committed identical per-round hashes", file=sys.stderr)
+
+    report = parity_diff.compare_ledgers(
+        wire["events"][names[0]], fused["events"]
+    )
+    if report["status"] != "OK":
+        print(
+            "FAIL: wire vs fused DIVERGED: "
+            f"{json.dumps(report['first_divergence'])}",
+            file=sys.stderr,
+        )
+        return 1
+    if report["hashes_compared"] != scn.rounds:
+        print(
+            f"FAIL: only {report['hashes_compared']}/{scn.rounds} aggregate "
+            "hashes bit-compared",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"PASS: {report['compared_events']} events aligned, "
+        f"{report['hashes_compared']} aggregate hashes bit-exact across "
+        "backends",
+        file=sys.stderr,
+    )
+
+    # Negative control: the differ must be able to FAIL.
+    perturbed = [dict(e) for e in fused["events"]]
+    for e in perturbed:
+        if e["kind"] == "aggregate_committed" and e.get("hash"):
+            e["hash"] = "sha256:" + "0" * 64
+            break
+    neg = parity_diff.compare_ledgers(wire["events"][names[0]], perturbed)
+    if neg["status"] != "DIVERGED" or "hash differs" not in (
+        (neg["first_divergence"] or {}).get("problem", "")
+    ):
+        print(
+            f"FAIL: negative control not localized: {json.dumps(neg['first_divergence'])}",
+            file=sys.stderr,
+        )
+        return 1
+    print("PASS: perturbed event localized (negative control)", file=sys.stderr)
+    print(
+        f"parity-check PASSED in {time.monotonic() - t0:.1f}s "
+        f"(ledgers under {tmp})",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

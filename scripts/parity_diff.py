@@ -1,0 +1,290 @@
+"""parity_diff — align two trajectory ledgers; localize the first divergence.
+
+The sim↔real parity gate's comparator: given two
+``ledger_<node>.jsonl`` dumps (telemetry/ledger.py — one from the real wire
+federation, one from the fused mesh, or any two runs of one backend),
+
+    python scripts/parity_diff.py A.jsonl B.jsonl [--out artifacts/parity_diff.json]
+
+aligns their canonical event streams by ``(round, event kind, sender)`` and
+compares field-wise, reporting the FIRST divergent event with surrounding
+context. Exit codes: ``0`` parity OK, ``1`` DIVERGED, ``2`` usage/unreadable.
+
+What is compared (per kind; unknown fields are ignored so schema growth
+stays forward-compatible):
+
+* ``round_open`` / ``window_open`` — the member set,
+* ``contribution_folded`` — sender, lag, num_samples,
+* ``aggregate_committed`` — contributors, num_samples, and the content
+  ``hash`` bit-for-bit WHEN BOTH SIDES CARRY ONE (a missing hash — e.g. a
+  fused chunk's intermediate round — is reported as a note, not a diff),
+* ``round_close`` / ``window_close`` — presence.
+
+Environment/defense kinds (``chaos_fault``, ``admission_rejected``,
+``membership``) legitimately differ between backends — the fused mesh has
+no wire to drop frames from — and are compared only under ``--all-kinds``.
+
+Hostile-input tolerance (exercised by tests/test_ledger.py): truncated
+files stop at the torn line with a note, events of unknown schema versions
+are skipped with a note, non-JSON lines and missing fields never raise.
+Stdlib-only, like ``fed_top`` — runs anywhere the repo does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+#: schema versions this differ knows how to compare.
+KNOWN_VERSIONS = (1,)
+
+#: canonical within-round kind order — mirror of telemetry/ledger.KIND_RANK
+#: (kept in sync by tests; duplicated so this script stays stdlib-only and
+#: importable without the package).
+KIND_RANK = {
+    "round_open": 0,
+    "window_open": 0,
+    "chaos_fault": 1,
+    "membership": 2,
+    "admission_rejected": 3,
+    "contribution_folded": 4,
+    "aggregate_committed": 5,
+    "window_close": 6,
+    "round_close": 6,
+}
+
+TRAJECTORY_KINDS = (
+    "round_open",
+    "window_open",
+    "contribution_folded",
+    "aggregate_committed",
+    "window_close",
+    "round_close",
+)
+
+#: fields compared per kind (hash is special-cased: both sides must carry it).
+COMPARED_FIELDS = {
+    "round_open": ("members",),
+    "window_open": ("members",),
+    "contribution_folded": ("sender", "lag", "num_samples"),
+    "aggregate_committed": ("contributors", "num_samples"),
+    "round_close": (),
+    "window_close": (),
+    "membership": ("event", "peer"),
+    "chaos_fault": ("fault", "peer"),
+    "admission_rejected": ("sender", "reason"),
+}
+
+
+def read_ledger(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]], List[str]]:
+    """Tolerant JSONL reader: returns ``(header, events, notes)``. A torn /
+    non-JSON line ends the read with a note (crash-truncated dumps are a
+    first-class input); unknown event versions are skipped with a note."""
+    notes: List[str] = []
+    header: Dict[str, Any] = {}
+    events: List[Dict[str, Any]] = []
+    with open(path, "r", errors="replace") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                notes.append(
+                    f"{os.path.basename(path)}: truncated/corrupt at line "
+                    f"{lineno} — stopping there"
+                )
+                break
+            if not isinstance(doc, dict):
+                notes.append(
+                    f"{os.path.basename(path)}: line {lineno} is not an "
+                    "event object — skipped"
+                )
+                continue
+            if lineno == 1 and doc.get("ledger") == "trajectory":
+                header = doc
+                continue
+            v = doc.get("v")
+            if v not in KNOWN_VERSIONS:
+                notes.append(
+                    f"{os.path.basename(path)}: line {lineno} has unknown "
+                    f"event version {v!r} — skipped"
+                )
+                continue
+            if not isinstance(doc.get("kind"), str):
+                notes.append(
+                    f"{os.path.basename(path)}: line {lineno} has no kind — "
+                    "skipped"
+                )
+                continue
+            events.append(doc)
+    return header, events, notes
+
+
+def _align_key(ev: Dict[str, Any]) -> Tuple:
+    rnd = ev.get("round")
+    return (
+        rnd if isinstance(rnd, (int, float)) else -1,
+        KIND_RANK.get(ev.get("kind"), 9),
+        str(ev.get("kind", "")),
+        str(ev.get("sender", ev.get("peer", ""))),
+    )
+
+
+def _event_brief(ev: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    if ev is None:
+        return None
+    keep = ("kind", "round", "sender", "peer", "members", "contributors",
+            "num_samples", "lag", "hash", "event", "reason", "fault")
+    return {k: ev[k] for k in keep if k in ev}
+
+
+def compare_ledgers(
+    events_a: List[Dict[str, Any]],
+    events_b: List[Dict[str, Any]],
+    kinds: Tuple[str, ...] = TRAJECTORY_KINDS,
+    context: int = 3,
+) -> Dict[str, Any]:
+    """Pure comparison (importable by tests / bench): align by
+    ``(round, kind, sender)`` and report the first divergence."""
+    a = sorted((e for e in events_a if e.get("kind") in kinds), key=_align_key)
+    b = sorted((e for e in events_b if e.get("kind") in kinds), key=_align_key)
+    notes: List[str] = []
+    first: Optional[Dict[str, Any]] = None
+    compared = 0
+    hashes_compared = 0
+
+    for i in range(max(len(a), len(b))):
+        ea = a[i] if i < len(a) else None
+        eb = b[i] if i < len(b) else None
+        problem: Optional[str] = None
+        if ea is None or eb is None:
+            missing = "A" if ea is None else "B"
+            problem = f"event present in one ledger only (missing in {missing})"
+        elif _align_key(ea) != _align_key(eb):
+            problem = "alignment mismatch (round/kind/sender differ)"
+        else:
+            kind = ea["kind"]
+            for field in COMPARED_FIELDS.get(kind, ()):
+                if ea.get(field) != eb.get(field):
+                    problem = (
+                        f"field {field!r} differs: "
+                        f"{ea.get(field)!r} != {eb.get(field)!r}"
+                    )
+                    break
+            if problem is None and kind == "aggregate_committed":
+                ha, hb = ea.get("hash"), eb.get("hash")
+                if ha is not None and hb is not None:
+                    hashes_compared += 1
+                    if ha != hb:
+                        problem = f"aggregate hash differs: {ha} != {hb}"
+                elif ha is None and hb is None:
+                    notes.append(
+                        f"round {ea.get('round')}: neither commit carries a "
+                        "hash — values not certified"
+                    )
+                else:
+                    notes.append(
+                        f"round {ea.get('round')}: hash present on one side "
+                        "only — not compared"
+                    )
+        if problem is not None:
+            lo = max(0, i - context)
+            first = {
+                "index": i,
+                "problem": problem,
+                "a": _event_brief(ea),
+                "b": _event_brief(eb),
+                "context_a": [_event_brief(e) for e in a[lo: i + context + 1]],
+                "context_b": [_event_brief(e) for e in b[lo: i + context + 1]],
+            }
+            break
+        compared += 1
+
+    return {
+        "status": "OK" if first is None else "DIVERGED",
+        "compared_events": compared,
+        "hashes_compared": hashes_compared,
+        "events_a": len(a),
+        "events_b": len(b),
+        "kinds": list(kinds),
+        "first_divergence": first,
+        "notes": notes,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="align two trajectory ledgers; localize the first divergence"
+    )
+    ap.add_argument("ledger_a")
+    ap.add_argument("ledger_b")
+    ap.add_argument(
+        "--all-kinds", action="store_true",
+        help="also compare environment/defense events (chaos_fault, "
+        "admission_rejected, membership)",
+    )
+    ap.add_argument(
+        "--context", type=int, default=3,
+        help="events of context around the first divergence (default 3)",
+    )
+    ap.add_argument(
+        "--out", default=None,
+        help="write the JSON report here (e.g. artifacts/parity_diff.json)",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        ha, ea, na = read_ledger(args.ledger_a)
+        hb, eb, nb = read_ledger(args.ledger_b)
+    except OSError as e:
+        print(f"parity_diff: cannot read inputs: {e}", file=sys.stderr)
+        return 2
+
+    kinds = TRAJECTORY_KINDS
+    if args.all_kinds:
+        kinds = tuple(KIND_RANK)
+    report = compare_ledgers(ea, eb, kinds=kinds, context=args.context)
+    report["ledger_a"] = {"path": args.ledger_a, "header": ha}
+    report["ledger_b"] = {"path": args.ledger_b, "header": hb}
+    report["notes"] = na + nb + report["notes"]
+    if ha.get("run_id") and hb.get("run_id") and ha["run_id"] != hb["run_id"]:
+        report["notes"].append(
+            f"run ids differ: {ha['run_id']!r} vs {hb['run_id']!r} — "
+            "comparing different scenarios?"
+        )
+
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        tmp = f"{args.out}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+        os.replace(tmp, args.out)
+
+    fd = report["first_divergence"]
+    if fd is None:
+        print(
+            f"parity OK: {report['compared_events']} events aligned, "
+            f"{report['hashes_compared']} aggregate hashes bit-exact",
+            file=sys.stderr,
+        )
+    else:
+        print(
+            f"parity DIVERGED at event {fd['index']}: {fd['problem']}\n"
+            f"  a: {json.dumps(fd['a'])}\n  b: {json.dumps(fd['b'])}",
+            file=sys.stderr,
+        )
+    for note in report["notes"]:
+        print(f"  note: {note}", file=sys.stderr)
+    print(json.dumps({k: report[k] for k in (
+        "status", "compared_events", "hashes_compared", "notes"
+    )}))
+    return 0 if fd is None else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,7 +12,14 @@ Exit codes:
 * ``2`` — usage / unreadable input,
 * ``3`` — schema refusal: the two files carry different ``meta`` /
   ``perf`` schema versions (or a different metric name) and diffing them
-  would be comparing incomparable shapes.
+  would be comparing incomparable shapes; ALSO raised when the two runs
+  measured different backends (``meta.backend``, e.g. a TPU baseline vs a
+  CPU-fallback candidate — BENCH_r03–r05's silent degradations produced
+  exactly this shape). The refusal message names each side's
+  ``meta.fallback_reason`` when present, so "why did this run fall back"
+  is answered by the gate instead of reverse-engineered from timestamps.
+  Pass ``--allow-backend-mismatch`` to compare anyway (the numbers are
+  then cross-platform and NOT regression-gateable).
 
 What gets compared (dotted paths; ``*`` fans out over dict keys):
 
@@ -211,6 +218,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--allow-metric-mismatch", action="store_true",
         help="compare files whose top-level metric names differ",
     )
+    ap.add_argument(
+        "--allow-backend-mismatch", action="store_true",
+        help="compare runs measured on different backends (cross-platform "
+        "numbers are not regression-gateable; see module docstring)",
+    )
     args = ap.parse_args(argv)
 
     try:
@@ -239,6 +251,32 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(
             f"perf_diff: SCHEMA REFUSAL — metric {b_metric!r} vs "
             f"{c_metric!r} (pass --allow-metric-mismatch to override)",
+            file=sys.stderr,
+        )
+        return 3
+
+    def _backend_of(doc: Dict[str, Any]) -> Tuple[Any, Any]:
+        meta = doc.get("meta") or {}
+        return meta.get("backend"), meta.get("fallback_reason")
+
+    b_backend, b_why = _backend_of(base)
+    c_backend, c_why = _backend_of(cand)
+    if (
+        b_backend and c_backend and b_backend != c_backend
+        and not args.allow_backend_mismatch
+    ):
+        def _label(backend: Any, why: Any) -> str:
+            return f"{backend!r}" + (f" (fell back: {why})" if why else "")
+
+        # Refuse loudly instead of noise-gating: a TPU baseline diffed
+        # against a CPU-fallback candidate reports a 100x "regression" that
+        # is actually a platform change.
+        print(
+            "perf_diff: BACKEND REFUSAL — baseline measured on "
+            f"{_label(b_backend, b_why)} but candidate on "
+            f"{_label(c_backend, c_why)}; cross-platform timings are not "
+            "comparable. Re-run both sides on one backend, or pass "
+            "--allow-backend-mismatch to compare anyway (not gateable).",
             file=sys.stderr,
         )
         return 3

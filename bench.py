@@ -1297,15 +1297,20 @@ def run_cifar_bench() -> None:
 
 def run_wire_bench() -> None:
     """Subprocess-style mode ``--wire``: sparse delta gossip wire-bytes
-    benchmark. Runs the same in-memory MNIST FedAvg federation twice — dense
-    frames (``WIRE_COMPRESSION="none"``) vs the sparse delta path
-    (``"topk"``, error-feedback top-k at ``WIRE_TOPK_RATIO``) — over the
-    real Node/gossip/aggregator stack, and reports the bytes-per-round
-    counter (model-plane TX, counted at the gossip send point) next to
-    final accuracy. Prints ONE JSON line.
+    benchmark. Runs the same in-memory MNIST FedAvg federation three times —
+    dense frames (``WIRE_COMPRESSION="none"``), the PR 1 sparse baseline
+    (``"topk"`` with bf16 values, per-tensor frames, serialized stage
+    machine) and the quantized fast path (int4 values, coalesced+DEFLATEd
+    multi-tensor body, train<->diffuse overlap) — over the real
+    Node/gossip/aggregator stack, and reports the bytes-per-round counter
+    (model-plane TX, counted at the gossip send point, attributed per wire
+    codec) next to final accuracy and the PR 6 overlap report. Prints ONE
+    JSON line and stamps ``artifacts/WIRE_BENCH.json`` with the shared
+    versioned meta block so ``scripts/perf_diff.py`` can gate regressions.
 
     Shape overrides: P2PFL_TPU_WIRE_NODES (default 8), P2PFL_TPU_WIRE_ROUNDS
-    (default 3), P2PFL_TPU_WIRE_TOPK_RATIO (default 0.1).
+    (default 3), P2PFL_TPU_WIRE_TOPK_RATIO (default 0.1),
+    P2PFL_TPU_WIRE_QUANT (default "int4").
     """
     out: dict = {}
     try:
@@ -1321,11 +1326,13 @@ def run_wire_bench() -> None:
         )
         from p2pfl_tpu.models import mlp_model
         from p2pfl_tpu.node import Node
+        from p2pfl_tpu.telemetry import REGISTRY, TRACER, CriticalPathAnalyzer
         from p2pfl_tpu.utils.utils import set_test_settings, wait_convergence
 
         n_nodes = int(os.environ.get("P2PFL_TPU_WIRE_NODES", "8"))
         rounds = int(os.environ.get("P2PFL_TPU_WIRE_ROUNDS", "3"))
         ratio = float(os.environ.get("P2PFL_TPU_WIRE_TOPK_RATIO", "0.1"))
+        quant = os.environ.get("P2PFL_TPU_WIRE_QUANT", "int4")
         set_test_settings()
         Settings.RESOURCE_MONITOR_PERIOD = 0
         Settings.LOG_LEVEL = "WARNING"
@@ -1333,17 +1340,61 @@ def run_wire_bench() -> None:
         # partial-model gossip the sparse path compresses
         Settings.TRAIN_SET_SIZE = n_nodes
         Settings.WIRE_TOPK_RATIO = ratio
+        # Liveness bounds for a contended host (the critical-path bench's
+        # rationale): 8 concurrent fits on few cores starve daemon threads
+        # for seconds — the 1.5 s test heartbeat timeout then declares
+        # healthy peers dead mid-round and the write-off/heal cycle thrashes
+        # the byte counts this bench exists to measure.
+        Settings.HEARTBEAT_TIMEOUT = 10.0
+        Settings.VOTE_TIMEOUT = 30.0
+        Settings.AGGREGATION_TIMEOUT = 120.0
+        Settings.AGGREGATION_STALL_PATIENCE = 60.0
 
+        # One SHARED apply_fn across the fleet (per-node params via
+        # build_copy): one XLA program per process instead of 8
+        # identity-distinct compiles whose serialized first-fit cost
+        # desynchronizes round 0 into heartbeat write-offs.
+        from p2pfl_tpu.learning.learner import JaxLearner
+
+        template = mlp_model(seed=0)
+        _phase("wire bench: pre-warming the shared XLA programs")
+        warm_data = synthetic_mnist(n_train=256, n_test=64)
+        warm_parts = warm_data.generate_partitions(1, RandomIIDPartitionStrategy)
+        warm = JaxLearner(
+            template.build_copy(), warm_parts[0], self_addr="mem://warmup",
+            batch_size=32, seed=0,
+        )
+        warm.set_epochs(1)
+        warm.fit()
+        warm.evaluate()
+        del warm
+
+        # (scheme label, WIRE_COMPRESSION, values, coalesce, overlap)
+        arms = (
+            ("none", "none", "bf16", False, False),
+            ("topk", "topk", "bf16", False, False),  # the PR 1 baseline, verbatim
+            (f"topk-{quant}", "topk", quant, True, True),  # quant+coalesce+overlap
+        )
         runs: dict = {}
-        for scheme in ("none", "topk"):
+        overlap_reports: dict = {}
+        for label, scheme, values, coalesce, overlap in arms:
             Settings.WIRE_COMPRESSION = scheme
-            _phase(f"wire bench: {n_nodes}-node federation, scheme={scheme}")
+            Settings.WIRE_TOPK_VALUES = values
+            Settings.COALESCE_ENABLED = coalesce
+            Settings.OVERLAP_TRAIN_DIFFUSE = overlap
+            REGISTRY.reset()
+            TRACER.reset()
+            _phase(f"wire bench: {n_nodes}-node federation, arm={label}")
             data = synthetic_mnist(n_train=256 * n_nodes, n_test=256)
             parts = data.generate_partitions(n_nodes, RandomIIDPartitionStrategy)
             nodes = [
-                Node(mlp_model(seed=i), parts[i], batch_size=32)
+                Node(
+                    template.build_copy(params=mlp_model(seed=i).get_parameters()),
+                    parts[i], batch_size=32,
+                )
                 for i in range(n_nodes)
             ]
+            t0 = time.monotonic()
             for nd in nodes:
                 nd.start()
             try:
@@ -1361,7 +1412,8 @@ def run_wire_bench() -> None:
                         break
                     time.sleep(0.25)
                 else:
-                    raise TimeoutError(f"{scheme} federation did not finish")
+                    raise TimeoutError(f"{label} federation did not finish")
+                wall_s = time.monotonic() - t0
                 tx_bytes = sum(
                     nd.protocol.gossiper.total_tx_bytes() for nd in nodes
                 )
@@ -1369,44 +1421,101 @@ def run_wire_bench() -> None:
                     sum(f for f, _ in nd.protocol.gossiper.wire_stats().values())
                     for nd in nodes
                 )
+                by_codec: dict = {}
+                for nd in nodes:
+                    for codec, b in nd.protocol.gossiper.bytes_by_codec().items():
+                        by_codec[codec] = by_codec.get(codec, 0) + b
                 accs = [nd.learner.evaluate().get("test_acc", 0.0) for nd in nodes]
-                runs[scheme] = {
+                runs[label] = {
                     "model_tx_bytes_total": int(tx_bytes),
                     "model_tx_frames": int(tx_frames),
                     "bytes_per_round": round(tx_bytes / rounds, 1),
+                    "bytes_by_codec": {k: int(v) for k, v in sorted(by_codec.items())},
                     "final_test_acc_mean": round(sum(accs) / len(accs), 4),
                     "final_test_acc_min": round(min(accs), 4),
+                    "wall_s": round(wall_s, 2),
                 }
-                _phase(f"wire bench {scheme}: {json.dumps(runs[scheme])}")
+                _phase(f"wire bench {label}: {json.dumps(runs[label])}")
             finally:
                 for nd in nodes:
                     nd.stop()
                 InMemoryRegistry.reset()
-        ratio_measured = runs["none"]["bytes_per_round"] / max(
-            runs["topk"]["bytes_per_round"], 1.0
+            if scheme == "topk":
+                try:
+                    ov = CriticalPathAnalyzer.from_tracer(TRACER).overlap_report()
+                    overlap_reports[label] = {
+                        "train_diffuse_overlap_fraction": ov[
+                            "train_diffuse_overlap_fraction"
+                        ],
+                        "train_diffuse_overlap_s": ov["train_diffuse_overlap_s"],
+                        "serialized_diffuse_s": ov["serialized_diffuse_s"],
+                        "diffuse_under_any_fit_fraction": ov.get(
+                            "diffuse_under_any_fit_fraction"
+                        ),
+                    }
+                except Exception as exc:  # noqa: BLE001 — report is advisory here
+                    overlap_reports[label] = {"error": repr(exc)}
+        quant_label = f"topk-{quant}"
+        vs_dense = runs["none"]["bytes_per_round"] / max(
+            runs[quant_label]["bytes_per_round"], 1.0
+        )
+        # The acceptance ratio: FURTHER reduction of the quantized+coalesced
+        # arm vs the PR 1 topk baseline, on the sparse-codec bytes the new
+        # encoders actually own (dense init/fallback frames ride both arms
+        # identically and would otherwise floor the ratio).
+        base_sparse = sum(
+            b for c, b in runs["topk"]["bytes_by_codec"].items()
+            if c.startswith("topk")
+        )
+        quant_sparse = sum(
+            b for c, b in runs[quant_label]["bytes_by_codec"].items()
+            if c.startswith("topk")
+        )
+        further_sparse = base_sparse / max(quant_sparse, 1)
+        further_total = runs["topk"]["bytes_per_round"] / max(
+            runs[quant_label]["bytes_per_round"], 1.0
         )
         out = {
             "metric": "wire_bytes_per_round_8node_mnist_fedavg",
-            "value": runs["topk"]["bytes_per_round"],
+            "value": runs[quant_label]["bytes_per_round"],
             "unit": "bytes/round",
-            "vs_baseline": round(ratio_measured, 2),
+            "vs_baseline": round(vs_dense, 2),
+            "meta": _bench_meta(seed=0, backend="cpu"),
             "extra": {
                 "nodes": n_nodes,
                 "rounds": rounds,
                 "topk_ratio": ratio,
+                "quant": quant,
                 "runs": runs,
-                "acc_delta_pp": round(
+                "further_vs_topk_sparse_bytes": round(further_sparse, 2),
+                "further_vs_topk_total_bytes": round(further_total, 2),
+                "overlap": overlap_reports,
+                "acc_delta_pp_vs_dense": round(
                     100.0
                     * (
                         runs["none"]["final_test_acc_mean"]
-                        - runs["topk"]["final_test_acc_mean"]
+                        - runs[quant_label]["final_test_acc_mean"]
                     ),
                     2,
                 ),
-                "note": "vs_baseline = dense bytes/round over sparse "
-                "bytes/round (error-feedback top-k delta gossip)",
+                "acc_delta_pp_vs_topk": round(
+                    100.0
+                    * (
+                        runs["topk"]["final_test_acc_mean"]
+                        - runs[quant_label]["final_test_acc_mean"]
+                    ),
+                    2,
+                ),
+                "note": "vs_baseline = dense bytes/round over quantized "
+                "bytes/round; further_vs_topk_sparse_bytes = PR 1 topk "
+                "sparse-codec bytes over the int-quantized coalesced codec's "
+                "(the >=3x acceptance ratio — dense init frames ride every "
+                "arm identically and are excluded by the codec attribution)",
             },
         }
+        os.makedirs("artifacts", exist_ok=True)
+        with open(os.path.join("artifacts", "WIRE_BENCH.json"), "w") as f:
+            json.dump(out, f, indent=1)
     except Exception as e:  # noqa: BLE001
         traceback.print_exc(file=sys.stderr)
         out["error"] = f"{type(e).__name__}: {e}"
@@ -3760,6 +3869,14 @@ def run_critical_path_bench() -> None:
         # honest node queued behind it — that node's "fit" span then
         # inherits the straggle and steals the gating attribution.
         Settings.EXECUTOR_MAX_WORKERS = n_nodes
+        # This bench measures the ATTRIBUTION contract (the seeded straggler
+        # must gate >= 80% of round critical paths) against the serialized
+        # reference stage machine — pin train<->diffuse overlap OFF so
+        # background drains and vote-RTT prefit threads don't smear the
+        # early rounds' gating on a contended 1-core host. The overlap
+        # measurement itself is owned by bench --wire (overlap section in
+        # WIRE_BENCH.json) and the make wire-check gate.
+        Settings.OVERLAP_TRAIN_DIFFUSE = False
         # Continuous profiling: the windowed device trace is captured
         # around the WARMUP fit below, not inside the measured federation
         # (PERF_TRACE_DIR stays unset) — an open jax.profiler window traces

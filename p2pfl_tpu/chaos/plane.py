@@ -452,11 +452,36 @@ class ChaosPlane:
             )
 
         arrays, meta = deserialize_arrays(bytes(env.payload))
+        # Quantized / coalesced sparse frames (comm/delta.py) carry their
+        # float values as int grids + per-tensor scales or as raw byte
+        # planes — a Byzantine sender attacks THOSE, not bare float arrays
+        # (which such frames no longer contain). Still a pure function of
+        # (payload, attack): no randomness, replay-deterministic.
+        from p2pfl_tpu.comm.delta import COALESCE_META_KEY
+        from p2pfl_tpu.ops.compression import CODEC_META_KEY
+
+        spec = meta.get(CODEC_META_KEY) or []
+        quantized = [
+            s
+            for s in spec
+            if isinstance(s, dict) and s.get("values") in ("int8", "int4")
+        ]
+        for s in quantized:
+            scale = float(s.get("scale", 1.0))
+            if byz.attack == "signflip":
+                s["scale"] = -scale  # negates every dequantized value
+            elif byz.attack == "scaled":
+                s["scale"] = scale * byz.scale
+            else:  # "nan"
+                s["scale"] = float("nan")
+        co = meta.get(COALESCE_META_KEY)
+        if co is not None:
+            arrays = ChaosPlane._corrupt_value_plane(list(arrays), meta, spec, byz)
         out = []
         for a in arrays:
             a = np.asarray(a)
             if not floatlike(a.dtype):
-                out.append(a)  # sparse index tensors etc. stay intact
+                out.append(a)  # sparse index tensors / byte planes stay intact
                 continue
             if byz.attack == "signflip":
                 out.append(-a)
@@ -465,6 +490,50 @@ class ChaosPlane:
             else:  # "nan"
                 out.append(np.full_like(a, np.nan))
         return _dc_replace(env, payload=serialize_arrays(out, meta))
+
+    @staticmethod
+    def _corrupt_value_plane(arrays, meta, spec, byz):
+        """Apply the float attacks to the bf16/float32 values inside a
+        coalesced frame's shared value plane (quantized tensors were already
+        attacked through their scales). Mutates ``meta`` in place and
+        returns the array list with the rebuilt plane."""
+        import numpy as np
+
+        from p2pfl_tpu.comm.delta import (
+            COALESCE_META_KEY,
+            _bf16,
+            _deflate_plane,
+            _inflate_plane,
+        )
+
+        co = meta[COALESCE_META_KEY]
+        raw_len = [int(x) for x in co["raw_len"]]
+        deflate = [bool(x) for x in co["deflate"]]
+        plane_bytes = np.asarray(arrays[-1]).tobytes()
+        plane = bytearray(
+            _inflate_plane(plane_bytes, raw_len[1]) if deflate[1] else plane_bytes
+        )
+        vo = 0
+        for s in spec:
+            if not (isinstance(s, dict) and s.get("codec") == "topk-c"):
+                continue
+            vb = int(s.get("val_bytes", 0))
+            kind = s.get("values", "bf16")
+            if kind in ("bf16", "float32"):
+                dt = _bf16() if kind == "bf16" else np.dtype(np.float32)
+                vals = np.frombuffer(bytes(plane[vo : vo + vb]), dt)
+                if byz.attack == "signflip":
+                    vals = (-vals.astype(np.float32)).astype(dt)
+                elif byz.attack == "scaled":
+                    vals = (vals.astype(np.float32) * byz.scale).astype(dt)
+                else:  # "nan"
+                    vals = np.full(vals.shape, np.nan, np.float32).astype(dt)
+                plane[vo : vo + vb] = vals.tobytes()
+            vo += vb
+        packed, was_deflated = _deflate_plane(bytes(plane), 6 if deflate[1] else 0)
+        co["deflate"][1] = was_deflated
+        arrays[-1] = np.frombuffer(packed, np.uint8)
+        return arrays
 
     # --- scoped configuration ----------------------------------------------
 

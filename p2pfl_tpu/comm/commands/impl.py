@@ -115,6 +115,11 @@ class ModelsAggregatedCommand(Command):
         state = self._node.state
         if state.round is not None and round == state.round:
             state.models_aggregated[source] = list(args)
+        elif round == state.prev_coverage_round:
+            # Train<->diffuse overlap: a laggard still in the round we just
+            # closed reports progress — the background drain reads this
+            # retired coverage table, so its candidate set keeps shrinking.
+            state.models_aggregated_prev[source] = list(args)
 
 
 class ModelsReadyCommand(Command):
@@ -250,7 +255,11 @@ class PartialModelCommand(Command):
             model = node.learner.get_model().build_copy(
                 params=arrays, contributors=contributors, num_samples=num_samples
             )
-            agg = node.aggregator.add_model(model)
+            # Round-scoped: under overlap the previous round's table stays
+            # populated (retired) while peers gossip the new round — the
+            # aggregator drops a frame whose round is not the OPEN one
+            # (the sender's gossip loop re-ships until we open it).
+            agg = node.aggregator.add_model(model, round=round)
             if agg:
                 node.protocol.broadcast(
                     node.protocol.build_msg(

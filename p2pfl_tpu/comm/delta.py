@@ -52,7 +52,11 @@ from p2pfl_tpu.ops.compression import (
     CODEC_META_KEY,
     decompress_arrays,
     ef_topk_encode,
+    ef_topk_quant_encode,
+    pack_nibbles,
     topk_count,
+    topk_select,
+    unpack_nibbles,
 )
 from p2pfl_tpu.ops.serialization import (
     decode_sparse_indices,
@@ -67,10 +71,24 @@ log = logging.getLogger("p2pfl_tpu")
 #: Reserved metadata key marking a frame as a round-anchored sparse delta.
 DELTA_META_KEY = "__delta__"
 
+#: Reserved metadata key describing a coalesced multi-tensor frame body: all
+#: sparse tensors ride TWO shared byte planes (concatenated packed indices,
+#: concatenated packed values — each optionally DEFLATEd) instead of two
+#: PFLT arrays per tensor, so per-tensor header/alignment overhead is paid
+#: once per frame. Per-tensor byte extents live in the ``__codec__`` spec
+#: (``topk-c`` entries), making the body length-prefixed and verifiable
+#: before any value is dequantized.
+COALESCE_META_KEY = "__coalesce__"
+
+#: Codec labels (telemetry + gossiper TX attribution). ``dense`` is every
+#: non-sparse frame (init, fallback, catch-up, reconcile).
+CODEC_LABELS = ("topk", "topk-int8", "topk-int4", "dense")
+
 _COMPRESSION_RATIO = REGISTRY.gauge(
     "p2pfl_wire_compression_ratio",
-    "Dense float32 bytes over sparse frame bytes for the last encoded frame",
-    labels=("node",),
+    "Dense float32 bytes over sparse frame bytes for the last encoded "
+    "frame, by value codec (topk = bf16/f32 values)",
+    labels=("node", "codec"),
 )
 _RESIDUAL_L2 = REGISTRY.gauge(
     "p2pfl_wire_residual_l2",
@@ -96,6 +114,95 @@ def _leaf_crc(leaves: Sequence[np.ndarray]) -> int:
     for a in leaves:
         crc = zlib.crc32(np.ascontiguousarray(a, dtype=np.float32).tobytes(), crc)
     return crc
+
+
+def codec_label(value_dtype: Optional[str] = None) -> str:
+    """Telemetry/TX codec label for the active sparse value dtype."""
+    vd = Settings.WIRE_TOPK_VALUES if value_dtype is None else value_dtype
+    return {"int8": "topk-int8", "int4": "topk-int4"}.get(vd, "topk")
+
+
+def _bf16() -> np.dtype:
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+def _deflate_plane(raw: bytes, level: int) -> Tuple[bytes, bool]:
+    """DEFLATE one coalesced byte plane; returns (bytes, deflated?). Skipped
+    when it would not shrink (int4 value planes are near-incompressible)."""
+    if level <= 0 or not raw:
+        return raw, False
+    packed = zlib.compress(raw, level)
+    return (packed, True) if len(packed) < len(raw) else (raw, False)
+
+
+def _inflate_plane(blob: bytes, raw_len: int) -> bytes:
+    """Bounded INFLATE of a coalesced plane: a hostile frame cannot expand
+    past its declared length (zip-bomb guard) or under-deliver silently."""
+    if raw_len < 0 or raw_len > Settings.MAX_MESSAGE_BYTES:
+        raise DecodingParamsError("coalesced plane length out of bounds")
+    d = zlib.decompressobj()
+    out = d.decompress(bytes(blob), raw_len)
+    if len(out) != raw_len or d.decompress(b"", 1):
+        raise DecodingParamsError("coalesced plane length mismatch")
+    return out
+
+
+def _encode_values(vals: Any, value_dtype: str) -> Tuple[bytes, Dict[str, Any]]:
+    """Pack selected (or already-quantized) wire values into raw bytes plus
+    the spec fields a receiver needs to invert them. For the integer layouts
+    ``vals`` is the int8 grid from the quant kernel and the caller supplies
+    ``scale``/``zero_point`` via the returned dict update."""
+    a = np.asarray(vals)
+    if value_dtype == "int4":
+        return pack_nibbles(a).tobytes(), {"values": "int4"}
+    if value_dtype == "int8":
+        return a.astype(np.int8).tobytes(), {"values": "int8"}
+    if value_dtype == "float32":
+        return a.astype(np.float32).tobytes(), {"values": "float32"}
+    return a.astype(_bf16()).tobytes(), {"values": "bf16"}
+
+
+def _decode_values(buf: bytes, entry: Dict[str, Any], count: int) -> np.ndarray:
+    """Invert :func:`_encode_values` with the pre-dequantize sanity checks:
+    scale/zero-point finiteness and integer-range bounds are validated
+    BEFORE any arithmetic touches the anchor, so a hostile quantized frame
+    dies here as a ``DecodingParamsError`` (counted ``reason="corrupt"`` by
+    the command handlers) instead of poisoning the reconstruction."""
+    kind = entry.get("values", "bf16")
+    if kind in ("int8", "int4"):
+        scale = entry.get("scale")
+        zp = entry.get("zero_point", 0)
+        if (
+            not isinstance(scale, (int, float))
+            or not np.isfinite(scale)
+            or not scale > 0
+            or not isinstance(zp, (int, float))
+            or not np.isfinite(zp)
+        ):
+            raise DecodingParamsError("quantized tensor has a hostile scale/zero-point")
+        qmax = 127 if kind == "int8" else 7
+        if abs(float(zp)) > qmax:
+            raise DecodingParamsError("quantized zero-point outside the int range")
+        if kind == "int4":
+            q = unpack_nibbles(np.frombuffer(buf, np.uint8), count)
+        else:
+            if len(buf) < count:
+                raise DecodingParamsError("int8 value plane shorter than declared")
+            q = np.frombuffer(buf[:count], np.int8)
+            if (np.abs(q.astype(np.int16)) > qmax).any():
+                raise DecodingParamsError("int8 value outside the symmetric grid")
+        return (q.astype(np.float32) - np.float32(zp)) * np.float32(scale)
+    if kind == "float32":
+        if len(buf) < 4 * count:
+            raise DecodingParamsError("float32 value plane shorter than declared")
+        return np.frombuffer(buf[: 4 * count], np.float32).copy()
+    if kind == "bf16":
+        if len(buf) < 2 * count:
+            raise DecodingParamsError("bf16 value plane shorter than declared")
+        return np.frombuffer(buf[: 2 * count], _bf16()).astype(np.float32)
+    raise DecodingParamsError(f"unknown value codec {kind!r}")
 
 
 class DeltaWireCodec:
@@ -259,28 +366,55 @@ class DeltaWireCodec:
         for ``round``, structure mismatch). ``model`` is a
         :class:`~p2pfl_tpu.models.model_handle.ModelHandle`.
         """
+        tagged = self.encode_tagged(model, round)
+        return None if tagged is None else tagged[0]
+
+    def encode_tagged(self, model: Any, round: int) -> Optional[Tuple[bytes, str]]:
+        """Like :meth:`encode_model` but returns ``(payload, codec_label)``
+        so send paths can attribute bytes per codec ("topk" / "topk-int8" /
+        "topk-int4"; dense fallbacks return ``None`` and the caller labels
+        the dense frame itself).
+
+        Anchor selection: the CURRENT anchor round encodes through the
+        error-feedback kernels (residuals persist — the point of EF). A
+        round still in the anchor HISTORY (an overlap drain serving laggards
+        after the boundary, or an async window already advanced past) encodes
+        STATELESSLY against the retired anchor: those are late re-sends of a
+        finished generation, and mutating the live residual stream against a
+        dead anchor would corrupt the EF accounting of the current round.
+        """
         if Settings.WIRE_COMPRESSION != "topk":
             return None
         with self._lock:
-            if self._anchor is None or self._anchor_round != int(round):
+            ef_path = self._anchor is not None and self._anchor_round == int(round)
+            if ef_path:
+                anchor, shapes, crc = self._anchor, self._shapes, self._anchor_crc
+            elif int(round) in self._history:
+                anchor, shapes, crc = self._history[int(round)]
+            else:
                 self.dense_fallback_frames += 1
                 _DENSE_FALLBACK.labels(self._addr).inc()
                 return None
             leaves = model.get_parameters()
-            if len(leaves) != len(self._anchor) or any(
-                tuple(l.shape) != s for l, s in zip(leaves, self._shapes)
+            if len(leaves) != len(anchor) or any(
+                tuple(l.shape) != s for l, s in zip(leaves, shapes)
             ):
                 self.dense_fallback_frames += 1
                 _DENSE_FALLBACK.labels(self._addr).inc()
                 return None
-            if self._residual is None:
-                self._residual = [np.zeros((a.size,), np.float32) for a in self._anchor]
+            if ef_path and self._residual is None:
+                self._residual = [np.zeros((a.size,), np.float32) for a in anchor]
 
             ratio = Settings.WIRE_TOPK_RATIO
             value_dtype = Settings.WIRE_TOPK_VALUES
+            coalesce = Settings.COALESCE_ENABLED
+            label = codec_label(value_dtype)
             parts: List[np.ndarray] = []
             spec: List[Dict[str, Any]] = []
-            for i, (leaf, anchor_flat) in enumerate(zip(leaves, self._anchor)):
+            idx_plane = bytearray()
+            val_plane = bytearray()
+            sparse_tensors = 0
+            for i, (leaf, anchor_flat) in enumerate(zip(leaves, anchor)):
                 leaf = np.asarray(leaf)
                 if not np.issubdtype(leaf.dtype, np.floating) or leaf.size == 0:
                     parts.append(leaf)
@@ -299,22 +433,74 @@ class DeltaWireCodec:
                     spec.append({"codec": "raw"})
                     continue
                 k = topk_count(delta.size, ratio)
-                idx, wire_vals, new_resid = ef_topk_encode(
-                    delta, self._residual[i], k, value_dtype
+                # Per-tensor quantization floor: a handful of values is not
+                # worth a scale header or the coarser grid — ship bf16.
+                vd = value_dtype
+                if vd in ("int8", "int4") and k < Settings.QUANT_MIN_VALUES:
+                    vd = "bf16"
+                extra: Dict[str, Any] = {}
+                if ef_path:
+                    if vd in ("int8", "int4"):
+                        idx, q, scale, new_resid = ef_topk_quant_encode(
+                            delta, self._residual[i], k, 8 if vd == "int8" else 4
+                        )
+                        wire_vals: Any = np.asarray(q)
+                        extra = {"scale": scale, "zero_point": 0}
+                    else:
+                        idx, wire_vals, new_resid = ef_topk_encode(
+                            delta, self._residual[i], k, vd
+                        )
+                    self._residual[i] = new_resid
+                else:
+                    idx, vals = topk_select(delta, k)
+                    if vd in ("int8", "int4"):
+                        qmax = 127 if vd == "int8" else 7
+                        absmax = float(np.max(np.abs(vals))) if vals.size else 0.0
+                        scale = absmax / qmax if absmax > 0 else 1.0
+                        wire_vals = np.clip(
+                            np.rint(vals / np.float32(scale)), -qmax, qmax
+                        ).astype(np.int8)
+                        extra = {"scale": scale, "zero_point": 0}
+                    else:
+                        wire_vals = vals
+                # gap8 only inside the coalesced v2 body — the per-tensor
+                # legacy layout stays decodable by pre-gap8 peers.
+                packed, index_codec = encode_sparse_indices(
+                    np.asarray(idx), allow_gap8=coalesce
                 )
-                self._residual[i] = new_resid
-                packed, index_codec = encode_sparse_indices(np.asarray(idx))
-                parts.append(packed)
-                parts.append(np.asarray(wire_vals))
-                spec.append(
-                    {
+                val_bytes, val_entry = _encode_values(wire_vals, vd)
+                val_entry.update(extra)
+                sparse_tensors += 1
+                if coalesce:
+                    entry = {
+                        "codec": "topk-c",
+                        "dtype": leaf.dtype.str,
+                        "shape": list(leaf.shape),
+                        "index_codec": index_codec,
+                        "parts": 0,
+                        "k": int(np.asarray(idx).size),
+                        "idx_bytes": int(packed.nbytes),
+                        "val_bytes": len(val_bytes),
+                    }
+                    entry.update(val_entry)
+                    spec.append(entry)
+                    idx_plane += packed.tobytes()
+                    val_plane += val_bytes
+                else:
+                    entry = {
                         "codec": "topk",
                         "dtype": leaf.dtype.str,
                         "shape": list(leaf.shape),
                         "index_codec": index_codec,
                         "parts": 2,
                     }
-                )
+                    entry.update(val_entry)
+                    spec.append(entry)
+                    parts.append(packed)
+                    if val_entry["values"] in ("int8", "int4"):
+                        parts.append(np.frombuffer(val_bytes, np.uint8))
+                    else:
+                        parts.append(np.asarray(wire_vals))
             meta: Dict[str, Any] = {
                 "contributors": list(model.contributors),
                 "num_samples": int(model.num_samples),
@@ -322,9 +508,19 @@ class DeltaWireCodec:
                 CODEC_META_KEY: spec,
                 DELTA_META_KEY: {
                     "round": int(round),
-                    "anchor_crc": self._anchor_crc,
+                    "anchor_crc": crc,
                 },
             }
+            if coalesce and sparse_tensors:
+                level = Settings.COALESCE_DEFLATE_LEVEL
+                ib, i_defl = _deflate_plane(bytes(idx_plane), level)
+                vb, v_defl = _deflate_plane(bytes(val_plane), level)
+                meta[COALESCE_META_KEY] = {
+                    "deflate": [i_defl, v_defl],
+                    "raw_len": [len(idx_plane), len(val_plane)],
+                }
+                parts.append(np.frombuffer(ib, np.uint8))
+                parts.append(np.frombuffer(vb, np.uint8))
             # Span context rides the frame header (the gRPC weights oneof
             # has no args slot for Envelope.trace — tracing module docstring).
             wire_ctx = tracing.current_wire()
@@ -333,16 +529,19 @@ class DeltaWireCodec:
             self.sparse_frames += 1
             _SPARSE_FRAMES.labels(self._addr).inc()
             payload = serialize_arrays(parts, meta)
-            dense_bytes = sum(a.size * 4 for a in self._anchor) or 1
-            _COMPRESSION_RATIO.labels(self._addr).set(dense_bytes / max(len(payload), 1))
-            _RESIDUAL_L2.labels(self._addr).set(
-                float(
-                    np.sqrt(
-                        sum(float(np.dot(np.asarray(r), np.asarray(r))) for r in self._residual)
+            dense_bytes = sum(a.size * 4 for a in anchor) or 1
+            _COMPRESSION_RATIO.labels(self._addr, label).set(
+                dense_bytes / max(len(payload), 1)
+            )
+            if ef_path:
+                _RESIDUAL_L2.labels(self._addr).set(
+                    float(
+                        np.sqrt(
+                            sum(float(np.dot(np.asarray(r), np.asarray(r))) for r in self._residual)
+                        )
                     )
                 )
-            )
-            return payload
+            return payload, label
 
     # --- decode -------------------------------------------------------------
 
@@ -400,7 +599,7 @@ class DeltaWireCodec:
                     crc & 0xFFFFFFFF,
                 )
             try:
-                return self._reconstruct(arrays, spec, anchor, shapes), meta
+                return self._reconstruct(arrays, spec, meta, anchor, shapes), meta
             except DecodingParamsError:
                 raise
             except Exception as exc:
@@ -412,10 +611,18 @@ class DeltaWireCodec:
         self,
         arrays: Sequence[np.ndarray],
         spec: Sequence[Dict[str, Any]],
+        meta: Dict[str, Any],
         anchor: List[np.ndarray],
         shapes: List[tuple],
     ) -> List[np.ndarray]:
-        """anchor + scatter(delta) per leaf (caller holds the lock)."""
+        """anchor + scatter(delta) per leaf (caller holds the lock).
+
+        Every structural fact a hostile frame controls — plane lengths,
+        per-tensor byte extents, integer ranges, scale/zero-point
+        finiteness, index bounds — is validated BEFORE the first value is
+        dequantized or scattered, so corruption surfaces as a counted
+        ``corrupt`` rejection and never perturbs the anchor or residuals.
+        """
         import jax.numpy as jnp
 
         from p2pfl_tpu.ops.aggregation import sparse_delta_apply
@@ -424,38 +631,108 @@ class DeltaWireCodec:
             raise DecodingParamsError(
                 f"delta frame has {len(spec)} tensors, model has {len(anchor)}"
             )
+        arrays = list(arrays)
+        co = meta.get(COALESCE_META_KEY)
+        idx_plane = val_plane = b""
+        if co is not None:
+            # Coalesced body: the LAST two arrays are the shared byte planes.
+            try:
+                raw_len = [int(x) for x in co["raw_len"]]
+                deflate = [bool(x) for x in co["deflate"]]
+            except Exception as exc:
+                raise DecodingParamsError(f"malformed coalesce header: {exc}") from exc
+            if len(arrays) < 2 or len(raw_len) != 2 or len(deflate) != 2:
+                raise DecodingParamsError("coalesced frame missing its byte planes")
+            planes = [np.asarray(a).tobytes() for a in arrays[-2:]]
+            arrays = arrays[:-2]
+            try:
+                idx_plane = (
+                    _inflate_plane(planes[0], raw_len[0]) if deflate[0] else planes[0]
+                )
+                val_plane = (
+                    _inflate_plane(planes[1], raw_len[1]) if deflate[1] else planes[1]
+                )
+            except zlib.error as exc:
+                raise DecodingParamsError(f"coalesced plane inflate failed: {exc}") from exc
+            if len(idx_plane) != raw_len[0] or len(val_plane) != raw_len[1]:
+                raise DecodingParamsError("coalesced plane length mismatch")
+            declared_idx = sum(
+                int(s.get("idx_bytes", 0)) for s in spec if s.get("codec") == "topk-c"
+            )
+            declared_val = sum(
+                int(s.get("val_bytes", 0)) for s in spec if s.get("codec") == "topk-c"
+            )
+            if declared_idx != len(idx_plane) or declared_val != len(val_plane):
+                raise DecodingParamsError(
+                    "coalesced tensor extents disagree with the plane lengths"
+                )
         expected = sum(int(s.get("parts", 1)) for s in spec)
         if expected != len(arrays):
             raise DecodingParamsError("delta frame part count mismatch")
         out: List[np.ndarray] = []
         pos = 0
+        io = vo = 0  # plane cursors (coalesced tensors)
         for i, s in enumerate(spec):
             codec = s.get("codec", "raw")
             if codec == "raw":
                 out.append(np.asarray(arrays[pos]))
                 pos += 1
                 continue
-            if codec != "topk":
+            if codec not in ("topk", "topk-c"):
                 raise DecodingParamsError(
                     f"unexpected tensor codec {codec!r} in delta frame"
                 )
-            packed, vals = arrays[pos], arrays[pos + 1]
-            pos += 2
             shape = tuple(s["shape"])
             if shape != shapes[i]:
                 raise DecodingParamsError(
                     f"delta tensor {i} shape {shape} != model {shapes[i]}"
                 )
-            idx = decode_sparse_indices(np.asarray(packed), s["index_codec"])
+            if codec == "topk-c":
+                if co is None:
+                    raise DecodingParamsError("topk-c tensor without a coalesce header")
+                k = int(s["k"])
+                ib, vb = int(s["idx_bytes"]), int(s["val_bytes"])
+                if k < 0 or ib < 0 or vb < 0:
+                    raise DecodingParamsError("negative coalesced tensor extent")
+                idx_bytes = idx_plane[io : io + ib]
+                val_bytes = val_plane[vo : vo + vb]
+                io += ib
+                vo += vb
+                icodec = s["index_codec"]
+                try:
+                    dt = {"gap8": np.uint8, "gap16": np.uint16, "abs32": np.uint32}[
+                        icodec
+                    ]
+                except KeyError:
+                    raise DecodingParamsError(
+                        f"unknown sparse index codec {icodec!r}"
+                    ) from None
+                if ib != k * np.dtype(dt).itemsize:
+                    raise DecodingParamsError("index extent disagrees with k")
+                packed = np.frombuffer(idx_bytes, dt)
+                vals32 = _decode_values(val_bytes, s, k)
+            else:
+                packed, vals = arrays[pos], arrays[pos + 1]
+                pos += 2
+                if s.get("values") in ("int8", "int4"):
+                    vals32 = None  # resolved below once idx is decoded
+                else:
+                    vals32 = np.asarray(vals).astype(np.float32)
+                icodec = s["index_codec"]
+            idx = decode_sparse_indices(np.asarray(packed), icodec)
+            if codec == "topk" and vals32 is None:
+                # Quantized uncoalesced layout: the value array is the raw
+                # int8/uint8 plane; idx.size is the value count.
+                vals32 = _decode_values(np.asarray(vals).tobytes(), s, idx.size)
             size = anchor[i].size
-            if idx.size != np.asarray(vals).size:
+            if idx.size != np.asarray(vals32).size:
                 raise DecodingParamsError("sparse index/values length mismatch")
             if idx.size and (int(idx[-1]) >= size or int(idx[0]) < 0):
                 raise DecodingParamsError("sparse index out of tensor bounds")
             dense = sparse_delta_apply(
                 jnp.asarray(anchor[i]),
                 jnp.asarray(idx, jnp.int32),
-                jnp.asarray(np.asarray(vals).astype(np.float32)),
+                jnp.asarray(np.asarray(vals32, dtype=np.float32)),
             )
             out.append(
                 np.asarray(dense).reshape(shape).astype(np.dtype(s["dtype"]))

@@ -41,6 +41,12 @@ class Envelope:
     # absent, and absent digests MUST be tolerated by every receiver —
     # digest-free (older or opted-out) nodes share the wire.
     digest: str = ""
+    # SENDER-LOCAL codec attribution for weights payloads ("topk" /
+    # "topk-int8" / "topk-int4" / "dense"; comm/delta.py CODEC_LABELS).
+    # Never serialized onto the wire — the frame itself is self-describing;
+    # this tag only feeds the gossiper's TX accounting and the per-codec
+    # compression metrics at the send choke point.
+    codec: str = "dense"
 
     @property
     def is_weights(self) -> bool:
@@ -68,6 +74,7 @@ class Envelope:
         payload: bytes,
         contributors: List[str],
         num_samples: int,
+        codec: str = "dense",
     ) -> "Envelope":
         """Model-plane message (reference grpc_client.py:90-123). Not
         TTL-gossiped; routed point-to-point by the model gossip loop."""
@@ -83,4 +90,5 @@ class Envelope:
             contributors=list(contributors),
             num_samples=int(num_samples),
             trace=tracing.current_wire(),
+            codec=codec or "dense",
         )

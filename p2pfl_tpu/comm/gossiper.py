@@ -35,13 +35,14 @@ log = logging.getLogger("p2pfl_tpu")
 # label — would bleed across tests that reuse an address.
 _TX_BYTES = REGISTRY.counter(
     "p2pfl_gossip_tx_bytes_total",
-    "Model-plane payload bytes sent, by command and round",
-    labels=("node", "cmd", "round"),
+    "Model-plane payload bytes sent, by command, round and wire codec "
+    "(topk / topk-int8 / topk-int4 / dense)",
+    labels=("node", "cmd", "round", "codec"),
 )
 _TX_FRAMES = REGISTRY.counter(
     "p2pfl_gossip_tx_frames_total",
-    "Model-plane frames sent, by command and round",
-    labels=("node", "cmd", "round"),
+    "Model-plane frames sent, by command, round and wire codec",
+    labels=("node", "cmd", "round", "codec"),
 )
 _MSGS_SENT = REGISTRY.counter(
     "p2pfl_gossip_msgs_sent_total",
@@ -84,13 +85,13 @@ class Gossiper:
         self._processed_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        # Model-plane TX accounting: (cmd, round) -> [frames, payload bytes].
+        # Model-plane TX accounting: (cmd, round, codec) -> [frames, bytes].
         # The sparse delta wire path's bytes-per-round metric reads this
         # (surfaced per round by RoundFinishedStage and by bench.py --wire);
         # the registry mirror (module-level counters above) is the process-
         # wide exposition surface.
         self._tx_lock = threading.Lock()
-        self._tx: Dict[Tuple[str, int], List[int]] = {}
+        self._tx: Dict[Tuple[str, int, str], List[int]] = {}
         self._msgs_sent = _MSGS_SENT.labels(self_addr)
         self._queue_depth = _QUEUE_DEPTH.labels(self_addr)
 
@@ -114,27 +115,40 @@ class Gossiper:
     def _record_tx(self, env: Envelope, nei: str = "") -> None:
         if env.payload is None:
             return
+        codec = getattr(env, "codec", "") or "dense"
         with self._tx_lock:
-            row = self._tx.setdefault((env.cmd, env.round), [0, 0])
+            row = self._tx.setdefault((env.cmd, env.round, codec), [0, 0])
             row[0] += 1
             row[1] += len(env.payload)
-        _TX_FRAMES.labels(self._self_addr, env.cmd, env.round).inc()
-        _TX_BYTES.labels(self._self_addr, env.cmd, env.round).inc(len(env.payload))
+        _TX_FRAMES.labels(self._self_addr, env.cmd, env.round, codec).inc()
+        _TX_BYTES.labels(self._self_addr, env.cmd, env.round, codec).inc(
+            len(env.payload)
+        )
         if self._recorder is not None:
             self._recorder.record(
                 "send", cmd=env.cmd, peer=nei,
-                round=env.round, bytes=len(env.payload),
+                round=env.round, bytes=len(env.payload), codec=codec,
             )
 
-    def wire_stats(self) -> Dict[Tuple[str, int], Tuple[int, int]]:
-        """Copy of the model-plane TX table: (cmd, round) -> (frames, bytes)."""
+    def wire_stats(self) -> Dict[Tuple[str, int, str], Tuple[int, int]]:
+        """Copy of the model-plane TX table:
+        (cmd, round, codec) -> (frames, bytes)."""
         with self._tx_lock:
             return {k: (v[0], v[1]) for k, v in self._tx.items()}
 
     def bytes_for_round(self, round: int) -> int:
         """Total model-plane payload bytes sent for ``round``."""
         with self._tx_lock:
-            return sum(v[1] for (_, r), v in self._tx.items() if r == round)
+            return sum(v[1] for (_, r, _c), v in self._tx.items() if r == round)
+
+    def bytes_by_codec(self) -> Dict[str, int]:
+        """Model-plane payload bytes per wire codec — the per-encoder
+        attribution ``bench.py --wire`` and ``fed_top`` surface."""
+        with self._tx_lock:
+            out: Dict[str, int] = {}
+            for (_, _, codec), v in self._tx.items():
+                out[codec] = out.get(codec, 0) + v[1]
+            return out
 
     def total_tx_bytes(self) -> int:
         with self._tx_lock:

@@ -386,9 +386,11 @@ class CommunicationProtocol:
         serialized_model: bytes,
         contributors: Optional[List[str]] = None,
         num_samples: int = 1,
+        codec: str = "dense",
     ) -> Envelope:
         return Envelope.weights(
-            self._addr, cmd, round, serialized_model, list(contributors or []), num_samples
+            self._addr, cmd, round, serialized_model, list(contributors or []),
+            num_samples, codec=codec,
         )
 
     @running
@@ -499,6 +501,13 @@ class CommunicationProtocol:
         """Send to every direct neighbor (reference grpc_client.py:194-208)."""
         for nei in node_list if node_list is not None else self.neighbors.get_all(only_direct=True):
             self.send(nei, env, raise_error=False, remove_on_error=True)
+            if env.payload is not None:
+                # Model-plane accounting for broadcast weights (async window
+                # contributions): the sync model gossip counts at its own
+                # send point in gossip_weights — this is the only other
+                # weights choke point, so bytes_for_round and the per-codec
+                # TX attribution cover both schedulers.
+                self.gossiper._record_tx(env, nei)
 
     # --- command wiring -----------------------------------------------------
 

@@ -148,14 +148,42 @@ class Settings:
             f"P2PFL_TPU_WIRE_TOPK_RATIO={WIRE_TOPK_RATIO!r} must be in (0, 1]"
         )
     # Wire dtype of the transmitted top-k values: "bf16" (default, 2 bytes,
-    # quantization error is absorbed by the error-feedback residual) or
-    # "float32" (exact values, bigger frames).
+    # quantization error is absorbed by the error-feedback residual),
+    # "float32" (exact values, bigger frames), or the linear-quantized
+    # "int8" / "int4" layouts (1 byte / packed half-byte per value, symmetric
+    # per-tensor scale + zero-point in the PFLT header; the same EF residual
+    # absorbs the quantization error bit-exactly — comm/delta.py).
     WIRE_TOPK_VALUES: str = _env_override("WIRE_TOPK_VALUES", "bf16")
-    if WIRE_TOPK_VALUES not in ("bf16", "float32"):
+    if WIRE_TOPK_VALUES not in ("bf16", "float32", "int8", "int4"):
         raise ValueError(
             f"P2PFL_TPU_WIRE_TOPK_VALUES={WIRE_TOPK_VALUES!r} is not one of "
-            "('bf16', 'float32')"
+            "('bf16', 'float32', 'int8', 'int4')"
         )
+    # Quantization floor: tensors whose top-k selection keeps fewer than this
+    # many values ship bf16 instead of int8/int4 — on a handful of values the
+    # scale header plus the coarser grid costs more than it saves (biases,
+    # scalar leaves). Validated at load like every other wire knob.
+    QUANT_MIN_VALUES: int = _env_int("QUANT_MIN_VALUES", 16, 1, 1 << 20)
+    # Frame coalescing: pack all of a model's sparse tensors into ONE
+    # length-prefixed multi-tensor body (two byte planes: indices + values)
+    # instead of two PFLT arrays per tensor, so per-tensor header/alignment
+    # overhead is paid once per frame — and DEFLATE the planes (stdlib zlib,
+    # COALESCE_DEFLATE_LEVEL; 0 disables) so gap-packed index bytes compress
+    # toward their entropy. Sender-local like WIRE_COMPRESSION: the frame is
+    # self-describing, receivers need no configuration.
+    COALESCE_ENABLED: bool = _env_override("COALESCE_ENABLED", True)
+    COALESCE_DEFLATE_LEVEL: int = _env_int("COALESCE_DEFLATE_LEVEL", 6, 0, 9)
+    # Train<->diffuse overlap (stages/base_node.py): model diffusion
+    # (partial-model + full-model gossip drains) runs on background threads
+    # while the stage machine proceeds to the aggregation wait and the NEXT
+    # round's local training — the serialized-gossip headroom PR 6 measured
+    # as overlap_fraction ~0. The aggregator retires each round's model
+    # table as an immutable snapshot so a draining round can keep serving
+    # laggards after the boundary; sparse encodes against the retired round
+    # come from the codec's anchor history.
+    OVERLAP_TRAIN_DIFFUSE: bool = _env_override("OVERLAP_TRAIN_DIFFUSE", True)
+    # Bounded join on leftover diffusion drains at teardown/finish (seconds).
+    OVERLAP_DRAIN_JOIN_S: float = _env_float("OVERLAP_DRAIN_JOIN_S", 5.0, 0.0, 300.0)
 
     # --- elastic async federation (stages/async_node.py) --------------------
     # Buffered asynchronous aggregation in the Papaya/FedBuff style (arxiv

@@ -76,6 +76,9 @@ class Aggregator:
         self._train_set: List[str] = []
         self._models: List[ModelHandle] = []
         self._round: Optional[int] = None  # ledger stamp for this round's folds
+        # Retired round snapshot (round, train_set, models) kept after
+        # retire_round() so overlap drains can serve laggards post-boundary.
+        self._retired: Optional[tuple] = None
         # monotonic timestamp of the last round progress (a stored model, a
         # death-shrink, or the round opening) — drives the JIT stall patience.
         self._last_progress = time.monotonic()
@@ -116,7 +119,31 @@ class Aggregator:
         with self._lock:
             self._train_set = []
             self._models = []
+            self._retired = None
             self._finish_event.clear()
+
+    def retire_round(self) -> None:
+        """Close the round for NEW contributions but keep an immutable
+        snapshot of its model table (train<->diffuse overlap,
+        stages/base_node.py): the background partial-model drain keeps
+        serving laggards out of the retired snapshot while the live side is
+        already collecting the next round. Replacing an earlier snapshot
+        implicitly ends any drain still reading it
+        (:meth:`get_partial_model_for_round` starts returning ``None``)."""
+        with self._lock:
+            if self._train_set or self._models:
+                self._retired = (self._round, list(self._train_set), list(self._models))
+            self._train_set = []
+            self._models = []
+            self._finish_event.clear()
+
+    def serves_round(self, round: int) -> bool:
+        """True while this aggregator can still produce partials for
+        ``round`` (it is the live round or the retired snapshot)."""
+        with self._lock:
+            if self._train_set and self._round == round:
+                return True
+            return self._retired is not None and self._retired[0] == round
 
     def get_aggregated_models(self) -> List[str]:
         """Addresses whose contributions have been merged so far."""
@@ -160,18 +187,28 @@ class Aggregator:
 
     # --- feeding models ------------------------------------------------------
 
-    def add_model(self, model: ModelHandle) -> List[str]:
+    def add_model(self, model: ModelHandle, round: Optional[int] = None) -> List[str]:
         """Merge a (possibly partially-aggregated) model into the round.
 
         Returns the updated list of aggregated contributors (the caller
         broadcasts it as round progress — reference train_stage.py:79-85).
         Duplicate/subset contributions and contributors outside the trainset
-        are ignored, matching reference :113-175.
+        are ignored, matching reference :113-175. When the caller knows the
+        frame's round (the wire handlers do), a mismatch against the OPEN
+        round is dropped — under train<->diffuse overlap the table for round
+        r stays populated while peers already gossip r+1 frames, and merging
+        across generations would corrupt both.
         """
         contributors = set(model.contributors)
         if not contributors:
             return []  # anonymous model: nothing to account it against
         with self._lock:
+            if (
+                round is not None
+                and self._round is not None
+                and round != self._round
+            ):
+                return []  # cross-round frame: the sender's gossip re-ships
             if not self._train_set:
                 # Round not open yet (e.g. model gossip raced ahead of the
                 # vote result) — the caller may retry; reference logs this.
@@ -269,17 +306,34 @@ class Aggregator:
         """
         except_set = set(except_nodes)
         with self._lock:
-            unseen = [
-                m for m in self._models if not (set(m.contributors) & except_set)
-            ]
-            if not unseen:
-                return None
-            if not self.partial_aggregation:
-                return unseen[0]
-            if len(unseen) == 1:
-                return unseen[0]
-            merged = self.aggregate(unseen)
-            return merged
+            return self._partial_from(self._models, except_set)
+
+    def get_partial_model_for_round(
+        self, round: int, except_nodes: Sequence[str]
+    ) -> Optional[ModelHandle]:
+        """Round-scoped :meth:`get_partial_model` for overlap drains: serves
+        the live table while ``round`` is open, the retired snapshot after
+        the boundary, and ``None`` once the aggregator has moved on."""
+        except_set = set(except_nodes)
+        with self._lock:
+            if self._train_set and self._round == round:
+                return self._partial_from(self._models, except_set)
+            if self._retired is not None and self._retired[0] == round:
+                return self._partial_from(self._retired[2], except_set)
+            return None
+
+    def _partial_from(
+        self, models: List[ModelHandle], except_set: set
+    ) -> Optional[ModelHandle]:
+        unseen = [m for m in models if not (set(m.contributors) & except_set)]
+        if not unseen:
+            return None
+        if not self.partial_aggregation:
+            return unseen[0]
+        if len(unseen) == 1:
+            return unseen[0]
+        merged = self.aggregate(unseen)
+        return merged
 
     # --- rule ---------------------------------------------------------------
 

@@ -203,9 +203,13 @@ class Node:
             if self.learning_in_progress():
                 self.stop_learning_locally()
             # Join the workflow thread before tearing down the protocol so a
-            # stage can't broadcast into a stopped transport.
+            # stage can't broadcast into a stopped transport. Diffusion
+            # drains (train<->diffuse overlap) observe the cleared experiment
+            # via their early-stop predicate within one gossip tick — the
+            # bounded join below keeps their last sends off a dead protocol.
             if self._learning_thread is not None:
                 self._learning_thread.join(timeout=5.0)
+            self.state.join_drains(timeout=2.0)
             self.protocol.stop()
         finally:
             self._running = False
@@ -579,6 +583,9 @@ class Node:
             state.train_set = [n for n in state.train_set if n != addr]
         shrunk = self.aggregator.remove_node(addr)
         state.models_aggregated.pop(addr, None)
+        # The retired coverage table too: an overlap drain must stop trying
+        # to serve a dead laggard (its candidate filter reads this).
+        state.models_aggregated_prev.pop(addr, None)
         # Wake the vote wait: it recomputes its expected-voter set from live
         # membership, which no longer includes the dead peer.
         state.votes_ready_event.set()

@@ -98,6 +98,26 @@ class NodeState:
 
         # Learning info (populated by commands / stages).
         self.models_aggregated: Dict[str, List[str]] = {}
+        # Previous-round partial-aggregation coverage: under train<->diffuse
+        # overlap (Settings.OVERLAP_TRAIN_DIFFUSE) the round-r partial-model
+        # drain keeps serving laggards after increase_round() replaced the
+        # live coverage table — their progress announcements (round r, our
+        # round r+1) land here so the drain's candidate set still shrinks to
+        # empty instead of stalling out.
+        self.models_aggregated_prev: Dict[str, List[str]] = {}
+        self.prev_coverage_round: int = -1
+        # Background diffusion drains (stages/base_node.py): the partial- and
+        # full-model gossip loops the overlap path runs off the stage thread.
+        # Threads deregister themselves implicitly (join_drains prunes dead
+        # ones); joined bounded at experiment finish and node stop.
+        self._drains_lock = threading.Lock()
+        self._drains: List[threading.Thread] = []
+        # Pre-dispatched training segment (train<->diffuse overlap): when the
+        # committee election is deterministic (TRAIN_SET_SIZE covers every
+        # candidate), VoteTrainSetStage dispatches the round's fit during the
+        # vote RTT — overlapped with the previous round's diffusion drains —
+        # and TrainStage joins it before touching the aggregator.
+        self.prefit: Optional[tuple] = None  # (round, threading.Thread)
         self.nei_status: Dict[str, int] = {}
         self.train_set: List[str] = []
         self.train_set_votes: Dict[str, Dict[str, int]] = {}
@@ -207,8 +227,62 @@ class NodeState:
     def increase_round(self) -> None:
         if self.experiment is None:
             raise ValueError("no experiment in progress")
+        finished = self.round
         self.experiment.increase_round()
+        # Retire (don't discard) the finished round's coverage table: the
+        # overlap drain for that round reads it until its candidates empty.
+        self.models_aggregated_prev = self.models_aggregated
+        self.prev_coverage_round = -1 if finished is None else int(finished)
         self.models_aggregated = {}
+
+    def coverage(self, round: int) -> Dict[str, List[str]]:
+        """Partial-aggregation coverage table for ``round``: the live table
+        for the current round, the retired one for the round just finished
+        (the overlap drain's view), empty otherwise."""
+        if self.round is not None and round == self.round:
+            return self.models_aggregated
+        if round == self.prev_coverage_round:
+            return self.models_aggregated_prev
+        return {}
+
+    def take_prefit(self, round: int) -> Optional[threading.Thread]:
+        """Pop the pre-dispatched fit thread iff it belongs to ``round``.
+        A STALE one (reconcile fast-forward, abandoned round) is aborted and
+        joined here — its thread mutates the learner model, and letting it
+        run unowned would race whatever adoption superseded the round."""
+        p, self.prefit = self.prefit, None
+        if p is None:
+            return None
+        if p[0] != round:
+            try:
+                if self.learner is not None:
+                    self.learner.interrupt_fit()
+            except Exception:  # noqa: BLE001 — cleanup must not break the stage
+                pass
+            p[1].join(timeout=30.0)
+            return None
+        return p[1]
+
+    # --- diffusion drains (train<->diffuse overlap) --------------------------
+
+    def add_drain(self, thread: threading.Thread) -> None:
+        with self._drains_lock:
+            self._drains = [t for t in self._drains if t.is_alive()]
+            self._drains.append(thread)
+
+    def join_drains(self, timeout: Optional[float] = None) -> None:
+        """Bounded join of outstanding diffusion drains (each terminates on
+        its own via empty candidates / stall exit / early stop — the join
+        only bounds how long a finish or stop waits for that)."""
+        with self._drains_lock:
+            drains, self._drains = self._drains, []
+        for t in drains:
+            if t.is_alive():
+                t.join(timeout)
+        alive = [t for t in drains if t.is_alive()]
+        if alive:
+            with self._drains_lock:
+                self._drains.extend(alive)
 
     def clear(self) -> None:
         """Reset to the post-construction state (reference :125-127)."""

@@ -137,6 +137,92 @@ def _ef_encode_impl(delta, residual, *, k: int, quantize_bf16: bool):
     return idx, wire, new_residual
 
 
+def _ef_quant_encode_impl(delta, residual, *, k: int, qmax: int):
+    import jax
+    import jax.numpy as jnp
+
+    acc = delta + residual  # error feedback: add back what was never sent
+    _, idx = jax.lax.top_k(jnp.abs(acc), k)
+    idx = jnp.sort(idx)
+    vals = acc[idx]
+    # Symmetric per-tensor linear quantization of the SELECTED values only:
+    # the grid is sized to the surviving top-k range, not the whole tensor,
+    # so the worst-case per-value error is absmax(selected)/(2*qmax) — and
+    # the EF residual absorbs exactly that error (returned residual holds
+    # acc - dequant at transmitted positions, one f32 subtraction).
+    absmax = jnp.max(jnp.abs(vals))
+    scale = jnp.where(absmax > 0, absmax / qmax, jnp.float32(1.0))
+    q = jnp.clip(jnp.round(vals / scale), -qmax, qmax).astype(jnp.int8)
+    dequant = q.astype(jnp.float32) * scale
+    new_residual = acc.at[idx].add(-dequant)
+    return idx, q, scale, new_residual
+
+
+def ef_topk_quant_encode(
+    delta: "Any", residual: "Any", k: int, bits: int
+) -> Tuple["Any", "Any", float, "Any"]:
+    """Fused error-feedback top-k selection + integer value quantization.
+
+    Like :func:`ef_topk_encode` but the wire values are symmetric linear
+    int8 (``bits=8``, grid ±127) or int4 (``bits=4``, grid ±7, packed to
+    nibbles by the caller via :func:`pack_nibbles`). Returns
+    ``(indices, q_int8, scale, new_residual)``; the conservation contract is
+    the bf16 one: ``new_residual[idx] == (delta+residual)[idx] - q*scale``
+    element-exactly (one float32 subtraction per transmitted value), so the
+    quantization error is never lost — it ships in a later round.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if bits not in (4, 8):
+        raise ValueError(f"quantized top-k supports 4 or 8 bits, got {bits}")
+    fn = _topk_kernel_cache.get("ef_quant")
+    if fn is None:
+        fn = jax.jit(_ef_quant_encode_impl, static_argnames=("k", "qmax"))
+        _topk_kernel_cache["ef_quant"] = fn
+    idx, q, scale, new_residual = fn(
+        jnp.asarray(delta, jnp.float32),
+        jnp.asarray(residual, jnp.float32),
+        k=k,
+        qmax=127 if bits == 8 else 7,
+    )
+    return idx, q, float(scale), new_residual
+
+
+def pack_nibbles(q: np.ndarray) -> np.ndarray:
+    """Pack int4-range values (each in [-7, 7]) two-per-byte (uint8).
+
+    Wire form is unsigned: ``q + 8`` occupies [1, 15], reserving nibble 0 as
+    an invalid sentinel so a hostile frame full of zero bytes fails the
+    range check at decode. Odd tails are padded with the encoding of 0
+    (``8``); the decoder slices to the spec's value count.
+    """
+    u = (np.asarray(q, np.int64) + 8).astype(np.uint8)
+    if (u < 1).any() or (u > 15).any():
+        raise ValueError("int4 value out of [-7, 7] range")
+    if u.size % 2:
+        u = np.concatenate([u, np.array([8], np.uint8)])
+    return (u[0::2] | (u[1::2] << 4)).astype(np.uint8)
+
+
+def unpack_nibbles(packed: np.ndarray, count: int) -> np.ndarray:
+    """Invert :func:`pack_nibbles` back to ``count`` int8 values in [-7, 7].
+
+    Raises ``ValueError`` on the reserved 0 nibble or a short buffer — the
+    pre-dequantize sanity check for hostile int4 frames.
+    """
+    packed = np.asarray(packed, np.uint8).reshape(-1)
+    if packed.size * 2 < count:
+        raise ValueError("int4 plane shorter than the declared value count")
+    u = np.empty(packed.size * 2, np.uint8)
+    u[0::2] = packed & 0x0F
+    u[1::2] = packed >> 4
+    u = u[:count]
+    if (u < 1).any() or (u > 15).any():
+        raise ValueError("int4 nibble outside the [1, 15] wire range")
+    return (u.astype(np.int16) - 8).astype(np.int8)
+
+
 def ef_topk_encode(
     delta: "Any", residual: "Any", k: int, value_dtype: str = "bf16"
 ) -> Tuple["Any", "Any", "Any"]:

@@ -107,19 +107,26 @@ def _pad(n: int) -> int:
 # one ``__codec__`` spec entry describing both (ops/compression.py). Indices
 # are sorted ascending and packed as either:
 #
-# * ``gap16`` — uint16 deltas between consecutive indices (first entry is the
-#   absolute first index). At ~10% density the mean gap is ~10, so 2 bytes per
-#   index; chosen whenever every gap (and the first index) fits in 16 bits.
+# * ``gap8`` — uint8 deltas between consecutive indices (first entry is the
+#   absolute first index). At ~10% density the mean gap is ~10 and gaps above
+#   255 are vanishingly rare, so 1 byte per index — and the byte stream
+#   DEFLATEs close to its entropy inside a coalesced plane. Only emitted
+#   into the coalesced (v2) frame layout (``allow_gap8``): the per-tensor
+#   legacy layout stays byte-compatible with pre-gap8 decoders.
+# * ``gap16`` — uint16 deltas, chosen whenever every gap (and the first
+#   index) fits in 16 bits. The PR 1 default.
 # * ``abs32`` — absolute uint32 indices (4 bytes) as the general fallback.
 #
-# Both layouts are plain ndarrays, so they inherit the frame's 64-byte
+# All layouts are plain ndarrays, so they inherit the frame's 64-byte
 # alignment, zero-copy decode, and CRC32 coverage — a corrupted index or
 # values region fails the frame checksum exactly like dense weights.
 
-SPARSE_INDEX_CODECS = ("gap16", "abs32")
+SPARSE_INDEX_CODECS = ("gap8", "gap16", "abs32")
 
 
-def encode_sparse_indices(idx: np.ndarray) -> Tuple[np.ndarray, str]:
+def encode_sparse_indices(
+    idx: np.ndarray, allow_gap8: bool = False
+) -> Tuple[np.ndarray, str]:
     """Pack sorted ascending flat indices; returns (packed, index_codec)."""
     idx = np.asarray(idx, dtype=np.int64)
     if idx.size == 0:
@@ -127,7 +134,10 @@ def encode_sparse_indices(idx: np.ndarray) -> Tuple[np.ndarray, str]:
     gaps = np.diff(idx, prepend=0)
     if (gaps < 0).any():
         raise ValueError("sparse indices must be sorted ascending and unique")
-    if int(gaps.max()) <= np.iinfo(np.uint16).max:
+    max_gap = int(gaps.max())
+    if allow_gap8 and max_gap <= np.iinfo(np.uint8).max:
+        return gaps.astype(np.uint8), "gap8"
+    if max_gap <= np.iinfo(np.uint16).max:
         return gaps.astype(np.uint16), "gap16"
     if int(idx[-1]) > np.iinfo(np.uint32).max:
         raise ValueError("sparse index exceeds uint32 range")
@@ -136,7 +146,7 @@ def encode_sparse_indices(idx: np.ndarray) -> Tuple[np.ndarray, str]:
 
 def decode_sparse_indices(packed: np.ndarray, index_codec: str) -> np.ndarray:
     """Invert :func:`encode_sparse_indices` back to int64 flat indices."""
-    if index_codec == "gap16":
+    if index_codec in ("gap8", "gap16"):
         return np.cumsum(np.asarray(packed, dtype=np.int64))
     if index_codec == "abs32":
         return np.asarray(packed, dtype=np.int64)

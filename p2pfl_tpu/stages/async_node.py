@@ -200,16 +200,22 @@ class AsyncWindowStage(Stage):
         agg.fold(own, w, node.addr)
 
         # One frame for every solicited peer: sparse delta against this
-        # window's anchor when the codec is active, dense otherwise.
-        payload = state.wire.encode_model(own, w)
-        if payload is None:
-            payload = own.encode_parameters()
+        # window's anchor when the codec is active (the async wire gets the
+        # same int8/int4-quantized, coalesced codec as sync partials — a
+        # laggard's window may already be retired into the anchor history,
+        # which encode_tagged serves statelessly), dense otherwise.
+        tagged = state.wire.encode_tagged(own, w)
+        if tagged is None:
+            payload, codec = own.encode_parameters(), "dense"
+        else:
+            payload, codec = tagged
         env = node.protocol.build_weights(
             AsyncContributionCommand.get_name(),
             w,
             payload,
             [node.addr],
             own.get_num_samples(),
+            codec=codec,
         )
         with TRACER.span("diffuse:async_model", node=node.addr, round=w):
             node.protocol.broadcast(env, node_list=solicit)

@@ -10,8 +10,9 @@ from __future__ import annotations
 
 import logging
 import random
+import threading
 import time
-from typing import TYPE_CHECKING, List, Optional, Type
+from typing import TYPE_CHECKING, Callable, List, Optional, Type
 
 from p2pfl_tpu.comm.commands.impl import (
     FullModelCommand,
@@ -26,7 +27,7 @@ from p2pfl_tpu.comm.commands.impl import (
 from p2pfl_tpu.comm.envelope import Envelope
 from p2pfl_tpu.config import Settings
 from p2pfl_tpu.stages.stage import Stage, check_early_stop
-from p2pfl_tpu.telemetry import TRACER
+from p2pfl_tpu.telemetry import TRACER, tracing
 from p2pfl_tpu.telemetry.ledger import LEDGERS, canonical_params_hash
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -84,6 +85,14 @@ def establish_initial_model(node: "Node") -> bool:
     # via InitModelCommand otherwise), so deltas anchored here reconstruct
     # on every peer. Init frames themselves always ship dense — their
     # receivers have no anchor yet by definition.
+    #
+    # Train<->diffuse overlap keeps ONE retired anchor around (sync default
+    # is a single live anchor): a background drain still serving round r
+    # after the boundary encodes sparse against the retired r anchor instead
+    # of degrading to dense frames. The async scheduler raises the depth
+    # further (AsyncStartStage) — never lower it here.
+    if Settings.OVERLAP_TRAIN_DIFFUSE:
+        state.wire.anchor_history = max(state.wire.anchor_history, 2)
     state.wire.set_anchor(model.get_parameters(), state.round or 0)
     payload = model.encode_parameters()
     env = node.protocol.build_weights(
@@ -102,6 +111,32 @@ def establish_initial_model(node: "Node") -> bool:
             model_fn=lambda nei: env,
         )
     return not check_early_stop(node)
+
+
+def spawn_diffusion_drain(node: "Node", name: str, body: Callable[[], None]) -> None:
+    """Run a model-diffusion gossip loop on a background DRAIN thread
+    (train<->diffuse overlap, ROADMAP item 3): the stage machine proceeds to
+    the aggregation wait — and the next round's local training — while the
+    paced gossip loop keeps serving laggards. The caller's span context is
+    re-attached inside the thread so ``diffuse:*`` spans stay parented into
+    the experiment trace (the PR 6 overlap report measures exactly these
+    spans against ``fit`` spans). Drains terminate on their own (empty
+    candidates / gossip stall exit / early stop / the aggregator moving two
+    rounds on); ``NodeState.join_drains`` only bounds teardown."""
+    wire_ctx = tracing.current_wire()
+
+    def run() -> None:
+        try:
+            with tracing.attach_wire(wire_ctx):
+                body()
+        except Exception:  # noqa: BLE001 — a drain bug must not kill the node
+            log.exception("(%s) diffusion drain %s failed", node.addr, name)
+
+    t = threading.Thread(
+        target=run, name=f"drain-{name}-{node.addr}", daemon=True
+    )
+    node.state.add_drain(t)
+    t.start()
 
 
 class StartLearningStage(Stage):
@@ -163,6 +198,21 @@ class VoteTrainSetStage(Stage):
                     VoteTrainSetCommand.get_name(), args=flat, round=state.round or 0
                 )
             )
+
+            # Train<->diffuse overlap, compute half: when TRAIN_SET_SIZE
+            # covers every live candidate the election is DETERMINISTIC —
+            # every node is in the committee whatever the ballots say — so
+            # the round's local-training segment dispatches NOW, overlapped
+            # with the vote RTT and the previous round's still-draining
+            # diffusion (the jitted train step is async on TPU anyway; here
+            # the whole fit rides a thread). TrainStage joins it before the
+            # aggregator sees anything: "synchronize before aggregation".
+            if (
+                Settings.OVERLAP_TRAIN_DIFFUSE
+                and num_votes == len(candidates)
+                and state.prefit is None
+            ):
+                TrainStage._dispatch_prefit(node, state.round or 0)
 
             # --- aggregate votes (reference :108-168) -----------------------
             # The expected-voter set is recomputed from LIVE membership every
@@ -230,14 +280,15 @@ class TrainStage(Stage):
     name = "TrainStage"
 
     @staticmethod
-    def execute(node: "Node") -> Optional[Type[Stage]]:
+    def _train_segment(node: "Node") -> None:
+        """Evaluate + share metrics + fit (reference :102-116): the round's
+        local-training segment. Runs on the stage thread in the serialized
+        path, or pre-dispatched on a thread during the vote RTT when the
+        election is deterministic (train<->diffuse overlap)."""
         state = node.state
-        node.aggregator.set_nodes_to_aggregate(state.train_set, round=state.round or 0)
-
-        # Evaluate + share metrics (reference :102-116).
         TrainStage._evaluate_and_broadcast(node)
         if check_early_stop(node):
-            return None
+            return
 
         # Continuous profiling: with PERF_TRACE_DIR set, the first fit this
         # process runs is captured as a windowed XLA device trace (capture-
@@ -247,6 +298,38 @@ class TrainStage(Stage):
         with TRACER.span("fit", node=node.addr, round=state.round):
             with device_trace_window(Settings.PERF_TRACE_DIR, label="fit"):
                 node.learner.fit()
+
+    @staticmethod
+    def _dispatch_prefit(node: "Node", r: int) -> None:
+        """Dispatch the round-``r`` training segment on a background thread
+        (called from VoteTrainSetStage under a deterministic election).
+        The caller's span context is re-attached so the ``fit`` span stays
+        inside the experiment trace."""
+        wire_ctx = tracing.current_wire()
+
+        def run() -> None:
+            try:
+                with tracing.attach_wire(wire_ctx):
+                    TrainStage._train_segment(node)
+            except Exception:  # noqa: BLE001 — surfaces as a missed round, not a crash
+                log.exception("(%s) pre-dispatched fit failed", node.addr)
+
+        t = threading.Thread(target=run, name=f"prefit-{node.addr}", daemon=True)
+        node.state.prefit = (r, t)
+        t.start()
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        state = node.state
+        node.aggregator.set_nodes_to_aggregate(state.train_set, round=state.round or 0)
+
+        prefit = state.take_prefit(state.round or 0)
+        if prefit is not None:
+            # The training segment was dispatched during the vote RTT —
+            # SYNCHRONIZE here, before anything touches the aggregator.
+            prefit.join()
+        else:
+            TrainStage._train_segment(node)
         if check_early_stop(node):
             return None
 
@@ -268,7 +351,24 @@ class TrainStage(Stage):
             )
         )
 
-        TrainStage._gossip_partial_models(node)
+        if Settings.OVERLAP_TRAIN_DIFFUSE:
+            # Train<->diffuse overlap: the partial-model diffusion drains on
+            # a background thread while this thread proceeds straight to the
+            # aggregation wait — and, next round, to the next fit. The drain
+            # keeps serving laggards across the round boundary out of the
+            # aggregator's retired snapshot (RoundFinishedStage) against the
+            # codec's retired anchor.
+            r = state.round or 0
+            train_set = list(state.train_set)
+            spawn_diffusion_drain(
+                node,
+                f"partial-r{r}",
+                lambda: TrainStage._gossip_partial_models(node, r, train_set),
+            )
+        else:
+            TrainStage._gossip_partial_models(
+                node, state.round or 0, list(state.train_set)
+            )
         if check_early_stop(node):
             return None
 
@@ -328,52 +428,84 @@ class TrainStage(Stage):
                 )
             )
 
+    #: Drain re-delivery cadence: a byte-identical re-send to a peer whose
+    #: coverage has not changed is suppressed for this many gossip ticks
+    #: (lost-frame repair still happens, just not every 100 ms). Serialized
+    #: (non-overlap) gossip keeps the reference's every-tick behavior.
+    REDELIVER_TICKS = 4
+
     @staticmethod
-    def _gossip_partial_models(node: "Node") -> None:
+    def _gossip_partial_models(node: "Node", r: int, train_set: List[str]) -> None:
         """Partial-aggregation gossip to trainset peers
-        (reference train_stage.py:118-168)."""
+        (reference train_stage.py:118-168). ``r``/``train_set`` are captured
+        by value: under overlap this body runs on a drain thread that may
+        outlive the round boundary, and must keep describing round ``r``
+        while ``state.round`` moves on."""
         state = node.state
+        members = set(train_set)
+        drain = Settings.OVERLAP_TRAIN_DIFFUSE
+        # (peer -> (suppressed ticks, last content key)): the drain avoids
+        # re-shipping an IDENTICAL partial to a peer whose coverage hasn't
+        # moved — off the critical path, re-sends every tick only burn the
+        # bytes the quantized codec just saved.
+        sent_state: dict = {}
 
         def early_stop() -> bool:
             # Keep gossiping until every trainset peer reports full coverage —
             # exiting on own completion would starve peers a round behind
-            # (reference train_stage.py:118-168 loops on peer progress).
-            return check_early_stop(node)
+            # (reference train_stage.py:118-168 loops on peer progress). A
+            # drain additionally stops once the aggregator no longer holds
+            # round r (two boundaries passed: nothing left to serve).
+            return check_early_stop(node) or not node.aggregator.serves_round(r)
 
         def candidates() -> List[str]:
             # trainset peers that haven't reported merging everyone
+            cov = state.coverage(r)
             return [
                 n
-                for n in state.train_set
-                if n != node.addr
-                and set(state.models_aggregated.get(n, [])) < set(state.train_set)
+                for n in train_set
+                if n != node.addr and set(cov.get(n, [])) < members
             ]
 
         def status() -> list:
-            return sorted((n, tuple(sorted(state.models_aggregated.get(n, [])))) for n in state.train_set)
+            cov = state.coverage(r)
+            return sorted((n, tuple(sorted(cov.get(n, [])))) for n in train_set)
 
         def model_fn(nei: str) -> Optional[Envelope]:
-            partial = node.aggregator.get_partial_model(
-                except_nodes=state.models_aggregated.get(nei, [])
+            cov_nei = state.coverage(r).get(nei, [])
+            partial = node.aggregator.get_partial_model_for_round(
+                r, except_nodes=cov_nei
             )
             if partial is None:
                 return None
+            if drain:
+                key = (tuple(sorted(cov_nei)), tuple(sorted(partial.contributors)))
+                skipped, prev = sent_state.get(nei, (0, None))
+                if prev == key and skipped < TrainStage.REDELIVER_TICKS:
+                    sent_state[nei] = (skipped + 1, prev)
+                    return None
+                sent_state[nei] = (0, key)
             # Sparse delta wire path (WIRE_COMPRESSION="topk"): trainset
             # peers share this round's anchor, so partials ship as
-            # error-feedback top-k deltas; encode_model returns None on the
-            # dense-only schemes or when no anchor is set for this round.
-            payload = state.wire.encode_model(partial, state.round or 0)
-            if payload is None:
-                payload = partial.encode_parameters()
+            # error-feedback top-k deltas (int8/int4-quantized values and a
+            # coalesced multi-tensor body when enabled); encode_tagged
+            # returns None on the dense-only schemes or when no anchor —
+            # live or retired — exists for round r.
+            tagged = state.wire.encode_tagged(partial, r)
+            if tagged is None:
+                payload, codec = partial.encode_parameters(), "dense"
+            else:
+                payload, codec = tagged
             return node.protocol.build_weights(
                 PartialModelCommand.get_name(),
-                state.round or 0,
+                r,
                 payload,
                 partial.contributors,
                 partial.get_num_samples(),
+                codec=codec,
             )
 
-        with TRACER.span("diffuse:partial_model", node=node.addr, round=state.round):
+        with TRACER.span("diffuse:partial_model", node=node.addr, round=r):
             node.protocol.gossip_weights(
                 early_stopping_fn=early_stop,
                 get_candidates_fn=candidates,
@@ -392,6 +524,14 @@ class WaitAggregatedModelsStage(Stage):
     def execute(node: "Node") -> Optional[Type[Stage]]:
         state = node.state
         r = state.round if state.round is not None else 0
+        # Defensive: a pre-dispatched fit must never race a full-model
+        # adoption (it only exists when the election was deterministic, in
+        # which case this stage is unreachable — but a mid-vote membership
+        # change could in principle route here). Abort and join it.
+        stray = state.take_prefit(r)
+        if stray is not None:
+            node.learner.interrupt_fit()
+            stray.join(timeout=30.0)
         if state.last_full_model_round >= r:
             # The full model already arrived before this stage started
             # (clear-then-wait race) — nothing to wait for.
@@ -449,21 +589,49 @@ class GossipModelStage(Stage):
     @staticmethod
     def execute(node: "Node") -> Optional[Type[Stage]]:
         state = node.state
+        r = state.round or 0
+        if Settings.OVERLAP_TRAIN_DIFFUSE:
+            # Overlap: the drain may outlive this stage AND the round — the
+            # live learner handle mutates at the next adoption, so freeze a
+            # copy of the round-r full model for the drain to serve.
+            live = node.learner.get_model()
+            model = live.build_copy(
+                params=live.get_parameters(),
+                contributors=live.contributors or [node.addr],
+                num_samples=live.get_num_samples(),
+            )
+            spawn_diffusion_drain(
+                node,
+                f"full-r{r}",
+                lambda: GossipModelStage._gossip_full_model(node, model, r),
+            )
+        else:
+            GossipModelStage._gossip_full_model(node, node.learner.get_model(), r)
+        if check_early_stop(node):
+            return None
+        return RoundFinishedStage
+
+    @staticmethod
+    def _gossip_full_model(node: "Node", model, r: int) -> None:
+        state = node.state
+        drain = Settings.OVERLAP_TRAIN_DIFFUSE
+        sent_state: dict = {}  # peer -> suppressed ticks (content is constant)
 
         def candidates() -> List[str]:
-            r = state.round
-            if r is None:
-                return []
             return [
                 n
                 for n in node.protocol.get_neighbors(only_direct=True)
                 if state.nei_status.get(n, -1) < r
             ]
 
+        def early_stop() -> bool:
+            # Drains bound their own life: two boundaries past r, every
+            # laggard will be served by the round-r+1 diffusion instead.
+            cur = state.round
+            return check_early_stop(node) or (cur is not None and cur > r + 1)
+
         # Serialize the (stage-constant) dense full model once for all
         # ticks/peers; the sparse delta variant is chosen per neighbor.
-        model = node.learner.get_model()
-        r = state.round or 0
         dense_env: List[Optional[Envelope]] = [None]  # lazy: sparse runs may never need it
 
         def _dense() -> Envelope:
@@ -478,33 +646,41 @@ class GossipModelStage(Stage):
             return dense_env[0]
 
         def model_fn(nei: str) -> Optional[Envelope]:
+            if drain:
+                # The full model for round r never changes: suppress
+                # re-sends to an unresponsive peer to the re-delivery
+                # cadence (its models_ready ack is what ends the loop).
+                skipped = sent_state.get(nei, TrainStage.REDELIVER_TICKS)
+                if skipped < TrainStage.REDELIVER_TICKS:
+                    sent_state[nei] = skipped + 1
+                    return None
+                sent_state[nei] = 0
             # Sparse delta only for peers known to be in THIS round (they
             # reported finishing r-1, or announced an initialized model for
             # round 0) — a lagging peer holds an older anchor and must get
             # the dense frame it can always adopt.
             status = state.nei_status.get(nei)
             if status == r - 1 or (r == 0 and status == -1):
-                payload = state.wire.encode_model(model, r)
-                if payload is not None:
+                tagged = state.wire.encode_tagged(model, r)
+                if tagged is not None:
+                    payload, codec = tagged
                     return node.protocol.build_weights(
                         FullModelCommand.get_name(),
                         r,
                         payload,
                         model.contributors or [node.addr],
                         model.get_num_samples(),
+                        codec=codec,
                     )
             return _dense()
 
         with TRACER.span("diffuse:full_model", node=node.addr, round=r):
             node.protocol.gossip_weights(
-                early_stopping_fn=lambda: check_early_stop(node),
+                early_stopping_fn=early_stop,
                 get_candidates_fn=candidates,
                 status_fn=lambda: sorted(candidates()),
                 model_fn=model_fn,
             )
-        if check_early_stop(node):
-            return None
-        return RoundFinishedStage
 
 
 class RoundFinishedStage(Stage):
@@ -526,7 +702,13 @@ class RoundFinishedStage(Stage):
             "wire_tx_bytes", float(node.protocol.gossiper.bytes_for_round(finished))
         )
         LEDGERS.emit(node.addr, "round_close", round=finished)
-        node.aggregator.clear()
+        if Settings.OVERLAP_TRAIN_DIFFUSE:
+            # Keep the finished round's model table as an immutable retired
+            # snapshot: the background partial-model drain keeps serving
+            # laggards from it while the next round opens on a clean table.
+            node.aggregator.retire_round()
+        else:
+            node.aggregator.clear()
         state.increase_round()
         # New round, new delta anchor: every node enters round r holding the
         # round-(r-1) aggregate, which is what senders will delta against.
@@ -539,7 +721,11 @@ class RoundFinishedStage(Stage):
         if r is not None and total is not None and r < total:
             return VoteTrainSetStage
 
-        # Final evaluation + wrap-up (reference :60-91).
+        # Final evaluation + wrap-up (reference :60-91). Outstanding overlap
+        # drains get a bounded window to finish serving laggards BEFORE the
+        # experiment state is torn down (finish_learning flips the early-stop
+        # predicate, which would cut a laggard's last full-model delivery).
+        state.join_drains(Settings.OVERLAP_DRAIN_JOIN_S)
         TrainStage._evaluate_and_broadcast(node)
         node.finish_learning()
         return None

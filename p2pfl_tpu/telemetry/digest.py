@@ -88,6 +88,11 @@ class HealthDigest:
     tx_bytes: float = 0.0
     rx_bytes: float = 0.0
     queue_depth: float = 0.0
+    # Model-plane TX bytes split by wire codec (topk / topk-int8 / topk-int4
+    # / dense — comm/delta.py CODEC_LABELS): the attribution that tells the
+    # fleet which encoder is actually carrying the model plane. Empty for
+    # pre-codec-label (older) peers — always tolerated.
+    tx_by_codec: Dict[str, float] = field(default_factory=dict)
     # Aggregation.
     agg_waits: int = 0  # completed aggregation waits (histogram count)
     agg_wait_s: float = 0.0  # cumulative seconds spent waiting
@@ -130,6 +135,8 @@ class HealthDigest:
         sk = d.pop("sketches", None)
         if sk:
             d["sk"] = sk
+        if not d.get("tx_by_codec"):
+            d.pop("tx_by_codec", None)  # keep pre-codec-label beats byte-identical
         return json.dumps(d, separators=(",", ":"), sort_keys=True)
 
 
@@ -165,7 +172,7 @@ def decode(payload: str) -> Optional["HealthDigest"]:
             setattr(dig, name, kind(v))
         except (TypeError, ValueError):
             pass  # a newer version may have retyped the field — keep default
-    for name in ("rejections", "rejected_by_source"):
+    for name in ("rejections", "rejected_by_source", "tx_by_codec"):
         v = raw.get(name)
         if isinstance(v, dict):
             table = {}
@@ -257,6 +264,9 @@ def collect(addr: str, state: Any = None) -> HealthDigest:
         dig.steps_per_s = _gauge_value("p2pfl_learner_steps_per_second", addr)
         dig.jit_compile_s = _gauge_value("p2pfl_learner_jit_compile_seconds", addr)
         dig.tx_bytes = float(_series_sum("p2pfl_gossip_tx_bytes_total", addr))
+        dig.tx_by_codec = _series_sum(
+            "p2pfl_gossip_tx_bytes_total", addr, group_by="codec"
+        )
         dig.rx_bytes = float(_series_sum("p2pfl_gossip_rx_bytes_total", addr))
         dig.queue_depth = _gauge_value("p2pfl_gossip_queue_depth", addr)
         wait = REGISTRY.get("p2pfl_aggregation_wait_seconds")

@@ -584,6 +584,7 @@ class Observatory:
                 "steps_per_s": d.steps_per_s,
                 "jit_compile_s": d.jit_compile_s,
                 "tx_bytes": d.tx_bytes,
+                "tx_by_codec": dict(d.tx_by_codec),
                 "rx_bytes": d.rx_bytes,
                 "queue_depth": d.queue_depth,
                 "agg_waits": d.agg_waits,

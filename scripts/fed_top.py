@@ -147,6 +147,20 @@ def render(
     lines.append(
         f"top straggler: {top_straggler or '-'}    top suspect: {top_suspect or '-'}"
     )
+    # Fleet-wide model-plane bytes per wire codec (digest tx_by_codec —
+    # which encoder is actually carrying the model plane, and how much of
+    # the traffic still rides dense frames).
+    by_codec: dict = {}
+    for p in peers.values():
+        for codec, b in (p.get("tx_by_codec") or {}).items():
+            by_codec[codec] = by_codec.get(codec, 0.0) + float(b)
+    if by_codec:
+        total = sum(by_codec.values()) or 1.0
+        split = "  ".join(
+            f"{c} {_mib(b)} ({100.0 * b / total:.0f}%)"
+            for c, b in sorted(by_codec.items(), key=lambda kv: -kv[1])
+        )
+        lines.append(paint(_BOLD, f"wire TX by codec: {split}"))
     quantiles = fleet.get("quantiles") or {}
     if quantiles:
         lines.append(paint(_BOLD, f"fleet ({fleet_size} nodes) — merged sketch quantiles:"))

@@ -164,6 +164,28 @@ def compare(
         parts = key.split(".")
         base_vals = dict(_get_path(base, parts))
         cand_vals = dict(_get_path(cand, parts))
+        # Fanned-out per-node counts compare in AGGREGATE: which node
+        # absorbs a retrace is scheduler luck run to run (observed: the
+        # same fleet total landing 3/2/1… one run and 5/1/3… the next) —
+        # a recompile STORM shows up in the sum, not in any one label.
+        if "*" in parts:
+            bsum = sum(s[0] for s in map(_stats, base_vals.values()) if s)
+            csum = sum(s[0] for s in map(_stats, cand_vals.values()) if s)
+            flat = ".".join(parts[:-1]) + ".sum" if parts[-1] == "*" else key
+            regressed = csum > bsum + count_slack
+            rows.append(
+                {
+                    "key": flat,
+                    "kind": "count",
+                    "baseline": bsum,
+                    "candidate": csum,
+                    "allowed_slack": count_slack,
+                    "regressed": regressed,
+                }
+            )
+            if regressed:
+                regressions.append(flat)
+            continue
         for flat, cv in sorted(cand_vals.items()):
             cs = _stats(cv)
             if cs is None:

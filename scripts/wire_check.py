@@ -1,0 +1,213 @@
+"""CI gate: wire-path raw speed (quantized top-k deltas, coalesced frames,
+train<->diffuse overlap). One tiny 3-node MNIST federation runs twice:
+
+* **baseline** — the PR 1 sparse wire: top-k @ 10% with bf16 values, one
+  PFLT array pair per tensor, fully serialized stage machine
+  (``OVERLAP_TRAIN_DIFFUSE=False``);
+* **fast** — the same shape on the int4-quantized, coalesced+DEFLATEd codec
+  with train<->diffuse overlap on.
+
+Asserts (exit 0 when all pass; nonzero with a reason on stderr):
+
+1. the quantized run matches the baseline's accuracy on this tiny problem
+   (within ``ACC_TOL`` — the EF residual absorbs quantization noise),
+2. sparse-codec model-plane bytes shrink by >= ``BYTES_X`` (per-codec TX
+   attribution from the gossiper's codec-labeled table),
+3. the PR 6 overlap report measures ``train_diffuse_overlap_fraction > 0``
+   and a reduced serialized-diffuse total — diffusion is off the stage
+   thread, the next fit dispatches during the vote RTT.
+
+Fast, CPU-only, tier-1-safe — invoked by ``make wire-check``.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import time  # noqa: E402
+
+ROUNDS = 3  # EF needs a round or two to repay the int4 grid error
+ACC_TOL = 0.05  # tiny-problem accuracy tolerance between the two codecs
+BYTES_X = 2.0  # sparse-codec byte shrink floor (8-node bench measures ~3x+)
+FIT_FLOOR_S = 1.5  # a straggler keeps diffusion drains alive into the next fit
+LEG_BUDGET_S = 120.0
+
+
+def _stretch(node, floor_s):
+    orig = node.learner.fit
+
+    def fit(*a, **kw):
+        t0 = time.monotonic()
+        r = orig(*a, **kw)
+        extra = floor_s - (time.monotonic() - t0)
+        if extra > 0:
+            time.sleep(extra)
+        return r
+
+    node.learner.fit = fit
+
+
+def main() -> int:
+    from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+    from p2pfl_tpu.config import Settings
+    from p2pfl_tpu.learning.dataset import RandomIIDPartitionStrategy, synthetic_mnist
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.node import Node
+    from p2pfl_tpu.telemetry import REGISTRY, TRACER, CriticalPathAnalyzer
+    from p2pfl_tpu.utils.utils import set_test_settings, wait_convergence
+
+    set_test_settings()
+    Settings.RESOURCE_MONITOR_PERIOD = 0
+    Settings.LOG_LEVEL = "WARNING"
+    Settings.TRAIN_SET_SIZE = 3  # full committee: partial gossip dominates
+    Settings.EXECUTOR_MAX_WORKERS = 0  # inline fits: sleep floors must overlap
+
+    n = 3
+    data = synthetic_mnist(n_train=128 * n, n_test=256)
+    parts = data.generate_partitions(n, RandomIIDPartitionStrategy)
+
+    def run_leg(values, coalesce, overlap):
+        REGISTRY.reset()
+        TRACER.reset()
+        Settings.WIRE_COMPRESSION = "topk"
+        Settings.WIRE_TOPK_RATIO = 0.1
+        Settings.WIRE_TOPK_VALUES = values
+        Settings.COALESCE_ENABLED = coalesce
+        Settings.OVERLAP_TRAIN_DIFFUSE = overlap
+        nodes = [Node(mlp_model(seed=i), parts[i], batch_size=32) for i in range(n)]
+        _stretch(nodes[n - 1], FIT_FLOOR_S)
+        for nd in nodes:
+            nd.start()
+        try:
+            for i in range(1, n):
+                nodes[i].connect(nodes[0].addr)
+            wait_convergence(nodes, n - 1, wait=15)
+            t0 = time.monotonic()
+            nodes[0].set_start_learning(rounds=ROUNDS, epochs=1)
+            deadline = time.monotonic() + LEG_BUDGET_S
+            while time.monotonic() < deadline:
+                if all(
+                    not nd.learning_in_progress()
+                    and nd.learning_workflow is not None
+                    for nd in nodes
+                ):
+                    break
+                time.sleep(0.1)
+            else:
+                print("FAIL: leg did not finish in budget", file=sys.stderr)
+                return None
+            wall = time.monotonic() - t0
+            by_codec: dict = {}
+            for nd in nodes:
+                for codec, b in nd.protocol.gossiper.bytes_by_codec().items():
+                    by_codec[codec] = by_codec.get(codec, 0) + b
+            accs = [nd.learner.evaluate().get("test_acc", 0.0) for nd in nodes]
+            sparse_frames = sum(nd.state.wire.sparse_frames for nd in nodes)
+        finally:
+            for nd in nodes:
+                nd.stop()
+            InMemoryRegistry.reset()
+        overlap_rep = CriticalPathAnalyzer.from_tracer(TRACER).overlap_report()
+        return {
+            "wall": wall,
+            "by_codec": by_codec,
+            "acc": sum(accs) / len(accs),
+            "sparse_frames": sparse_frames,
+            "overlap": overlap_rep,
+        }
+
+    print("wire-check: baseline leg (bf16 topk, uncoalesced, serialized)...", file=sys.stderr)
+    base = run_leg("bf16", coalesce=False, overlap=False)
+    if base is None:
+        return 1
+    print(
+        f"wire-check: baseline done ({base['wall']:.1f}s, acc {base['acc']:.3f}, "
+        f"codec bytes {base['by_codec']}) — fast leg (int4 + coalesce + overlap)...",
+        file=sys.stderr,
+    )
+    fast = run_leg("int4", coalesce=True, overlap=True)
+    if fast is None:
+        return 1
+    print(
+        f"wire-check: fast leg done ({fast['wall']:.1f}s, acc {fast['acc']:.3f}, "
+        f"codec bytes {fast['by_codec']})",
+        file=sys.stderr,
+    )
+
+    for leg, name in ((base, "baseline"), (fast, "fast")):
+        if leg["sparse_frames"] == 0:
+            print(f"FAIL: {name} leg never engaged the sparse codec", file=sys.stderr)
+            return 1
+
+    # 1. accuracy parity on the tiny problem (EF absorbs quantization noise).
+    if fast["acc"] < base["acc"] - ACC_TOL:
+        print(
+            f"FAIL: quantized accuracy {fast['acc']:.3f} fell more than "
+            f"{ACC_TOL} below baseline {base['acc']:.3f}",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"PASS: accuracy {fast['acc']:.3f} vs baseline {base['acc']:.3f}", file=sys.stderr)
+
+    # 2. sparse-codec bytes shrink (per-codec TX attribution).
+    base_sparse = sum(b for c, b in base["by_codec"].items() if c.startswith("topk"))
+    fast_sparse = sum(b for c, b in fast["by_codec"].items() if c.startswith("topk"))
+    if "topk-int4" not in fast["by_codec"]:
+        print(
+            f"FAIL: no bytes attributed to topk-int4 (got {fast['by_codec']})",
+            file=sys.stderr,
+        )
+        return 1
+    ratio = base_sparse / max(fast_sparse, 1)
+    if ratio < BYTES_X:
+        print(
+            f"FAIL: sparse-codec bytes shrank only {ratio:.2f}x "
+            f"({base_sparse} -> {fast_sparse}), need >= {BYTES_X}x",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"PASS: sparse-codec bytes {base_sparse} -> {fast_sparse} ({ratio:.2f}x)",
+        file=sys.stderr,
+    )
+
+    # 3. measured train<->diffuse overlap > 0 and diffusion off the critical
+    # path: both legs pay identical fit floors, so a wall-clock reduction is
+    # exactly the serialized diffuse time the stage machine no longer waits
+    # out. (The summed serialized_diffuse_s is NOT compared across legs —
+    # background drains keep their spans open a gossip tick longer by
+    # design; the per-leg overlap fraction and the wall are the invariants.)
+    frac = fast["overlap"]["train_diffuse_overlap_fraction"]
+    if not frac > 0:
+        print(
+            f"FAIL: train_diffuse_overlap_fraction = {frac} (expected > 0); "
+            f"report: {fast['overlap']}",
+            file=sys.stderr,
+        )
+        return 1
+    if fast["wall"] >= base["wall"]:
+        print(
+            f"FAIL: overlapped wall {fast['wall']:.1f}s did not beat the "
+            f"serialized baseline {base['wall']:.1f}s",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"PASS: overlap_fraction {frac:.3f} > 0, wall {base['wall']:.1f}s -> "
+        f"{fast['wall']:.1f}s (serialized diffuse: baseline "
+        f"{base['overlap']['serialized_diffuse_s']:.2f}s, overlapped leg "
+        f"{fast['overlap']['serialized_diffuse_s']:.2f}s of which "
+        f"{fast['overlap']['train_diffuse_overlap_s']:.2f}s under own fit)",
+        file=sys.stderr,
+    )
+    print("wire-check PASSED", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

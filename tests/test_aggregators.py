@@ -200,3 +200,63 @@ def test_geometric_median_rule():
     out = GeometricMedian(iters=16).aggregate(honest + [bad])
     np.testing.assert_allclose(out.get_parameters()[0], np.full((4, 4), 2.0), atol=0.5)
     assert set(out.get_contributors()) == {"h0", "h1", "h2", "h3", "byz"}
+
+
+# --- train<->diffuse overlap: retired-round snapshots --------------------------
+
+
+def test_retire_round_keeps_snapshot_for_drains():
+    """retire_round closes the live table but keeps an immutable snapshot a
+    background diffusion drain can serve laggards from (stages/base_node.py
+    overlap path), until the NEXT retirement replaces it."""
+    agg = FedAvg()
+    agg.set_nodes_to_aggregate(["a", "b"], round=3)
+    agg.add_model(_model(1.0, ["a"]))
+    agg.add_model(_model(3.0, ["b"]))
+    agg.retire_round()
+    assert agg.serves_round(3)
+    # the retired snapshot still produces partials for a laggard
+    partial = agg.get_partial_model_for_round(3, except_nodes=["a"])
+    assert partial is not None and partial.get_contributors() == ["b"]
+    assert agg.get_partial_model_for_round(3, except_nodes=["a", "b"]) is None
+    # the live side reopened clean for the next round
+    agg.set_nodes_to_aggregate(["a", "b"], round=4)
+    agg.add_model(_model(5.0, ["a"]), round=4)
+    assert agg.get_partial_model_for_round(4, ["b"]) is not None
+    # next retirement replaces the snapshot: round 3 is gone
+    agg.retire_round()
+    assert not agg.serves_round(3) and agg.serves_round(4)
+    assert agg.get_partial_model_for_round(3, []) is None
+
+
+def test_add_model_round_gate_drops_cross_round_frames():
+    """Under overlap, a round-r+1 partial arriving while the table is still
+    open on round r must be DROPPED (the sender's gossip re-ships), never
+    merged across generations."""
+    agg = FedAvg()
+    agg.set_nodes_to_aggregate(["a", "b"], round=2)
+    assert agg.add_model(_model(1.0, ["a"]), round=3) == []
+    assert agg.get_aggregated_models() == []
+    assert agg.add_model(_model(1.0, ["a"]), round=2) == ["a"]
+    # round-less adds (the node's own model) keep working
+    assert agg.add_model(_model(2.0, ["b"])) == ["a", "b"]
+
+
+def test_node_state_prev_round_coverage_and_prefit():
+    from p2pfl_tpu.node_state import NodeState
+
+    st = NodeState("mem://unit")
+    st.set_experiment("exp", 3)
+    st.models_aggregated["peer"] = ["a"]
+    assert st.coverage(0) is st.models_aggregated
+    st.increase_round()
+    # the finished round's table retired; the live one is fresh
+    assert st.coverage(0) == {"peer": ["a"]}
+    assert st.coverage(1) == {} and st.coverage(1) is st.models_aggregated
+    assert st.coverage(7) == {}
+    # prefit handoff: only the matching round pops the thread
+    done = threading.Event()
+    t = threading.Thread(target=done.set)
+    st.prefit = (1, t)
+    assert st.take_prefit(1) is t
+    assert st.prefit is None and st.take_prefit(1) is None

@@ -352,6 +352,65 @@ def test_poisoned_sparse_frame_rejected_and_anchor_survives():
             assert np.isfinite(np.asarray(a, dtype=np.float32)).all()
 
 
+def test_hostile_quantized_frame_rejected_as_corrupt_before_anchor():
+    """Pre-dequantize sanity screen (wire-speed plane): a quantized top-k
+    frame with a hostile scale / zero-point / int range dies as a counted
+    ``reason="corrupt"`` rejection BEFORE any value touches the round anchor
+    — and the anchor keeps decoding honest frames afterwards."""
+    from p2pfl_tpu.comm.commands.impl import PartialModelCommand
+    from p2pfl_tpu.comm.delta import DeltaWireCodec
+    from p2pfl_tpu.ops.compression import CODEC_META_KEY
+    from p2pfl_tpu.ops.serialization import deserialize_arrays, serialize_arrays
+
+    with Settings.overridden(
+        EXECUTOR_MAX_WORKERS=0, WIRE_COMPRESSION="topk",
+        WIRE_TOPK_VALUES="int8", COALESCE_ENABLED=True,
+    ):
+        node = _make_node()
+        node.state.set_experiment("quant-poison", 3)
+        node.state.train_set = [node.addr, "evil"]
+        node.aggregator.set_nodes_to_aggregate([node.addr, "evil"], round=0)
+        anchor = node.learner.get_model().get_parameters()
+        node.state.wire.set_anchor(anchor, 0)
+
+        sender = DeltaWireCodec("evil")
+        sender.set_anchor(anchor, 0)
+        update = node.learner.get_model().build_copy(
+            params=[np.asarray(p) + 0.01 for p in anchor],
+            contributors=["evil"], num_samples=1,
+        )
+        blob, label = sender.encode_tagged(update, 0)
+        assert label == "topk-int8"
+
+        # Hostile sender: rewrite every per-tensor scale to NaN (valid CRC —
+        # this is a malicious frame, not line noise).
+        arrays, meta = deserialize_arrays(bytes(blob))
+        poisoned_any = False
+        for s in meta[CODEC_META_KEY]:
+            if s.get("values") in ("int8", "int4"):
+                s["scale"] = float("nan")
+                poisoned_any = True
+        assert poisoned_any
+        hostile = bytes(serialize_arrays([np.asarray(a) for a in arrays], meta))
+
+        before = _rejected("corrupt")
+        anchor_before = node.state.wire.export_state()
+        PartialModelCommand(node).execute(
+            "evil", 0, weights=hostile, contributors=["evil"], num_samples=1
+        )
+        assert _rejected("corrupt") - before == 1
+        assert node.aggregator.get_aggregated_models() == []
+        after = node.state.wire.export_state()
+        assert after["anchor_round"] == anchor_before["anchor_round"]
+        for a, b in zip(anchor_before["anchor"], after["anchor"]):
+            np.testing.assert_array_equal(a, b)
+
+        # honest frame still decodes against the untouched anchor
+        arrays2, _ = node.state.wire.decode_frame(bytes(blob))
+        for a in arrays2:
+            assert np.isfinite(np.asarray(a, dtype=np.float32)).all()
+
+
 # --- Krum / Multi-Krum ---------------------------------------------------------
 
 

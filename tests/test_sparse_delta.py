@@ -7,7 +7,12 @@ import time
 import numpy as np
 import pytest
 
-from p2pfl_tpu.comm.delta import DELTA_META_KEY, DeltaWireCodec
+from p2pfl_tpu.comm.delta import (
+    COALESCE_META_KEY,
+    DELTA_META_KEY,
+    DeltaWireCodec,
+    codec_label,
+)
 from p2pfl_tpu.config import Settings
 from p2pfl_tpu.exceptions import DecodingParamsError, DeltaAnchorError
 from p2pfl_tpu.ops.compression import (
@@ -15,9 +20,12 @@ from p2pfl_tpu.ops.compression import (
     compress_arrays,
     decompress_arrays,
     ef_topk_encode,
+    ef_topk_quant_encode,
+    pack_nibbles,
     scatter_dense,
     topk_count,
     topk_select,
+    unpack_nibbles,
 )
 from p2pfl_tpu.ops.serialization import (
     decode_sparse_indices,
@@ -162,6 +170,370 @@ def test_ef_bf16_quantization_error_lands_in_residual():
     dequant = np.asarray(vals).astype(np.float32)
     # residual at transmitted positions == exact quantization error
     np.testing.assert_array_equal(resid[idx], delta[idx] - dequant)
+
+
+# --- value quantization (int8 / int4) ----------------------------------------
+
+
+def test_nibble_pack_roundtrip_and_hostile_ranges():
+    rng = np.random.default_rng(7)
+    q = rng.integers(-7, 8, size=(33,)).astype(np.int8)  # odd length: padded
+    packed = pack_nibbles(q)
+    assert packed.dtype == np.uint8 and packed.size == 17
+    np.testing.assert_array_equal(unpack_nibbles(packed, q.size), q)
+    # reserved 0 nibble (a zero-filled hostile plane) fails the range check
+    with pytest.raises(ValueError, match="nibble"):
+        unpack_nibbles(np.zeros(4, np.uint8), 8)
+    # short buffer fails instead of silently truncating
+    with pytest.raises(ValueError, match="shorter"):
+        unpack_nibbles(packed[:2], q.size)
+    with pytest.raises(ValueError, match="range"):
+        pack_nibbles(np.array([9], np.int8))
+
+
+@pytest.mark.parametrize("bits,qmax", [(8, 127), (4, 7)])
+def test_ef_quant_residual_absorbs_quantization_error_exactly(bits, qmax):
+    """The EF-conservation contract under integer quantization: the residual
+    at transmitted positions is EXACTLY acc - q*scale (one f32 subtraction),
+    so encode(x) + residual' == x in the error-feedback sense — quantization
+    noise is never lost, it ships in a later round."""
+    rng = np.random.default_rng(8)
+    delta = rng.normal(size=(2048,)).astype(np.float32)
+    residual = rng.normal(scale=0.1, size=(2048,)).astype(np.float32)
+    k = 204
+    idx, q, scale, new_resid = ef_topk_quant_encode(delta, residual, k, bits)
+    idx, q, new_resid = np.asarray(idx), np.asarray(q), np.asarray(new_resid)
+    acc = delta + residual
+    assert q.dtype == np.int8 and (np.abs(q.astype(np.int16)) <= qmax).all()
+    dequant = q.astype(np.float32) * np.float32(scale)
+    np.testing.assert_array_equal(new_resid[idx], acc[idx] - dequant)
+    # untransmitted positions keep their accumulated mass untouched
+    mask = np.ones(acc.size, bool)
+    mask[idx] = False
+    np.testing.assert_array_equal(new_resid[mask], acc[mask])
+    # per-value quantization error bounded by scale/2 (+ rounding epsilon)
+    assert float(np.max(np.abs(acc[idx] - dequant))) <= float(scale) * 0.5 + 1e-6
+
+
+@pytest.mark.parametrize("values", ["int8", "int4"])
+@pytest.mark.parametrize("coalesce", [False, True])
+def test_quantized_codec_roundtrip(values, coalesce):
+    """int8/int4 frames (coalesced and per-tensor) reconstruct the model to
+    within the per-tensor quantization grid; codec labels attribute them."""
+    from p2pfl_tpu.models import mlp_model
+
+    rng = np.random.default_rng(9)
+    sender = mlp_model(seed=0)
+    anchor = sender.get_parameters()
+    cs, cr = DeltaWireCodec("s"), DeltaWireCodec("r")
+    cs.set_anchor(anchor, 1)
+    cr.set_anchor(anchor, 1)
+    sender.set_parameters(
+        [np.asarray(p) + 0.01 * rng.standard_normal(np.asarray(p).shape).astype(np.float32) for p in anchor]
+    )
+    sender.set_contribution(["s"], 7)
+    with Settings.overridden(
+        WIRE_COMPRESSION="topk", WIRE_TOPK_RATIO=1.0, WIRE_TOPK_VALUES=values,
+        COALESCE_ENABLED=coalesce,
+    ):
+        tagged = cs.encode_tagged(sender, 1)
+    assert tagged is not None
+    blob, label = tagged
+    assert label == codec_label(values) == f"topk-{values}"
+    arrays, meta = cr.decode_frame(blob)
+    assert meta["contributors"] == ["s"] and meta["num_samples"] == 7
+    assert (meta.get(COALESCE_META_KEY) is not None) == coalesce
+    for got, want, anc in zip(arrays, sender.get_parameters(), anchor):
+        got32 = np.asarray(got, dtype=np.float32)
+        want32 = np.asarray(want, dtype=np.float32)
+        # worst case = half a grid step of the per-tensor scale
+        delta = want32 - np.asarray(anc, dtype=np.float32)
+        qmax = 127 if values == "int8" else 7
+        bound = float(np.max(np.abs(delta))) / qmax + 1e-6
+        assert float(np.max(np.abs(got32 - want32))) <= bound
+
+
+def test_quant_min_values_floor_keeps_small_tensors_bf16():
+    """Tensors whose top-k keeps fewer than QUANT_MIN_VALUES values ship
+    bf16 — a scale header on a 3-value bias costs more than it saves."""
+    from p2pfl_tpu.ops.serialization import deserialize_arrays
+
+    codec = DeltaWireCodec("s")
+    big = np.zeros((4096,), np.float32)
+    small = np.zeros((4,), np.float32)
+
+    class _M:
+        contributors = ["s"]
+        num_samples = 1
+        additional_info: dict = {}
+
+        def get_parameters(self):
+            return [big + 0.5, small + 0.5]
+
+    codec.set_anchor([big, small], 0)
+    with Settings.overridden(
+        WIRE_COMPRESSION="topk", WIRE_TOPK_RATIO=0.1, WIRE_TOPK_VALUES="int8",
+        COALESCE_ENABLED=True, QUANT_MIN_VALUES=16,
+    ):
+        blob, label = codec.encode_tagged(_M(), 0)
+    assert label == "topk-int8"  # frame label follows the requested codec
+    _, meta = deserialize_arrays(bytes(blob))
+    kinds = [s.get("values") for s in meta[CODEC_META_KEY]]
+    assert kinds == ["int8", "bf16"]  # 409 values quantize; 1 value stays bf16
+
+
+def test_encode_against_anchor_history_is_stateless():
+    """A drain serving a retired round (or an async laggard window) encodes
+    against the anchor HISTORY without touching the live EF residuals."""
+    from p2pfl_tpu.models import mlp_model
+
+    sender = mlp_model(seed=0)
+    anchor0 = sender.get_parameters()
+    cs, cr = DeltaWireCodec("s"), DeltaWireCodec("r")
+    cs.anchor_history = 2
+    cs.set_anchor(anchor0, 0)
+    sender.set_parameters([np.asarray(p) + 0.01 for p in anchor0])
+    sender.set_contribution(["s"], 1)
+    with Settings.overridden(
+        WIRE_COMPRESSION="topk", WIRE_TOPK_RATIO=1.0, WIRE_TOPK_VALUES="float32",
+        COALESCE_ENABLED=False,
+    ):
+        # advance to round 1: round 0's anchor retires into the history
+        anchor1 = sender.get_parameters()
+        cs.set_anchor(anchor1, 1)
+        resid_before = cs.export_state()["residual"]
+        blob = cs.encode_model(sender, 0)  # retired round still encodes
+        assert blob is not None
+        assert cs.export_state()["residual"] == resid_before  # EF untouched
+        assert cs.encode_model(sender, 7) is None  # unknown round: dense
+    cr.set_anchor(anchor0, 0)
+    arrays, meta = cr.decode_frame(blob)
+    assert meta[DELTA_META_KEY]["round"] == 0
+    for got, want in zip(arrays, sender.get_parameters()):
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32), atol=1e-6
+        )
+
+
+# --- coalesced frames ---------------------------------------------------------
+
+
+def _coalesced_frame(values="int8"):
+    from p2pfl_tpu.models import mlp_model
+
+    rng = np.random.default_rng(10)
+    sender = mlp_model(seed=0)
+    anchor = sender.get_parameters()
+    cs = DeltaWireCodec("s")
+    cs.set_anchor(anchor, 1)
+    sender.set_parameters(
+        [np.asarray(p) + 0.01 * rng.standard_normal(np.asarray(p).shape).astype(np.float32) for p in anchor]
+    )
+    sender.set_contribution(["s"], 1)
+    with Settings.overridden(
+        WIRE_COMPRESSION="topk", WIRE_TOPK_RATIO=0.1, WIRE_TOPK_VALUES=values,
+        COALESCE_ENABLED=True,
+    ):
+        blob, _ = cs.encode_tagged(sender, 1)
+    receiver = DeltaWireCodec("r")
+    receiver.set_anchor(anchor, 1)
+    return bytes(blob), receiver
+
+
+def _tampered(blob, mutate):
+    """Re-serialize ``blob`` with ``mutate(arrays, meta)`` applied (the CRC
+    is recomputed — this simulates a HOSTILE sender, not line corruption)."""
+    from p2pfl_tpu.ops.serialization import deserialize_arrays, serialize_arrays
+
+    arrays, meta = deserialize_arrays(blob)
+    arrays = [np.asarray(a) for a in arrays]
+    out = mutate(arrays, meta)
+    if out is not None:
+        arrays = out
+    return bytes(serialize_arrays(arrays, meta))
+
+
+def test_coalesced_frame_shrinks_and_roundtrips():
+    blob, receiver = _coalesced_frame("int4")
+    arrays, meta = receiver.decode_frame(blob)
+    assert meta.get(COALESCE_META_KEY) is not None
+    assert all(np.isfinite(np.asarray(a, np.float32)).all() for a in arrays)
+    # the coalesced int4 body beats the PR 1 per-tensor bf16 layout by >2x
+    from p2pfl_tpu.models import mlp_model
+
+    sender = mlp_model(seed=0)
+    anchor = sender.get_parameters()
+    cs = DeltaWireCodec("s2")
+    cs.set_anchor(anchor, 1)
+    rng = np.random.default_rng(10)
+    sender.set_parameters(
+        [np.asarray(p) + 0.01 * rng.standard_normal(np.asarray(p).shape).astype(np.float32) for p in anchor]
+    )
+    sender.set_contribution(["s"], 1)
+    with Settings.overridden(
+        WIRE_COMPRESSION="topk", WIRE_TOPK_RATIO=0.1, WIRE_TOPK_VALUES="bf16",
+        COALESCE_ENABLED=False,
+    ):
+        baseline, _ = cs.encode_tagged(sender, 1)
+    assert len(baseline) > 2 * len(blob), (len(baseline), len(blob))
+
+
+@pytest.mark.parametrize(
+    "name,mutate",
+    [
+        (
+            "nan_scale",
+            lambda arrays, meta: [
+                s.__setitem__("scale", float("nan"))
+                for s in meta[CODEC_META_KEY]
+                if s.get("values") in ("int8", "int4")
+            ]
+            and None,
+        ),
+        (
+            "zero_scale",
+            lambda arrays, meta: [
+                s.__setitem__("scale", 0.0)
+                for s in meta[CODEC_META_KEY]
+                if s.get("values") in ("int8", "int4")
+            ]
+            and None,
+        ),
+        (
+            "hostile_zero_point",
+            lambda arrays, meta: [
+                s.__setitem__("zero_point", 1e9)
+                for s in meta[CODEC_META_KEY]
+                if s.get("values") in ("int8", "int4")
+            ]
+            and None,
+        ),
+        (
+            "extent_mismatch",
+            lambda arrays, meta: meta[CODEC_META_KEY][0].__setitem__(
+                "idx_bytes", 1 + int(meta[CODEC_META_KEY][0]["idx_bytes"])
+            )
+            and None,
+        ),
+        (
+            "truncated_plane",
+            lambda arrays, meta: arrays[:-1]
+            + [np.asarray(arrays[-1])[: max(1, np.asarray(arrays[-1]).size // 2)]],
+        ),
+        (
+            "inflate_bomb",
+            lambda arrays, meta: meta[COALESCE_META_KEY]["raw_len"].__setitem__(
+                1, 2
+            )
+            and None,
+        ),
+    ],
+)
+def test_hostile_coalesced_frames_rejected_before_anchor(name, mutate):
+    """Every hostile mutation of a quantized coalesced frame dies as a
+    DecodingParamsError BEFORE any value is dequantized into the anchor —
+    the pre-dequantize sanity screen of the wire-speed plane."""
+    blob, receiver = _coalesced_frame("int8")
+    hostile = _tampered(blob, mutate)
+    before = receiver.export_state()
+    with pytest.raises(DecodingParamsError):
+        receiver.decode_frame(hostile)
+    after = receiver.export_state()
+    assert after["anchor_round"] == before["anchor_round"]
+    for a, b in zip(before["anchor"], after["anchor"]):
+        np.testing.assert_array_equal(a, b)
+    # the pristine frame still decodes — the codec state survived intact
+    arrays, _ = receiver.decode_frame(blob)
+    assert all(np.isfinite(np.asarray(a, np.float32)).all() for a in arrays)
+
+
+def test_old_peer_uncoalesced_f32_frame_still_decodes():
+    """Mixed-version wire compat: a frame in the PRE-quantization layout —
+    per-tensor index+value arrays, no ``values`` key, no coalesce header —
+    decodes through the same entry point (an old peer on the wire)."""
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.ops.serialization import (
+        encode_sparse_indices,
+        serialize_arrays,
+    )
+
+    model = mlp_model(seed=0)
+    anchor = model.get_parameters()
+    receiver = DeltaWireCodec("r")
+    receiver.set_anchor(anchor, 3)
+    anchor_crc = receiver.export_state()["anchor_crc"]
+
+    parts, spec = [], []
+    rng = np.random.default_rng(11)
+    deltas = []
+    for leaf in anchor:
+        flat = np.zeros(np.asarray(leaf).size, np.float32)
+        k = max(1, flat.size // 10)
+        pos = np.sort(rng.choice(flat.size, size=k, replace=False))
+        vals = rng.normal(size=k).astype(np.float32) * 0.01
+        flat[pos] = vals
+        deltas.append(flat)
+        packed, icodec = encode_sparse_indices(pos.astype(np.int64))
+        parts.append(packed)
+        parts.append(vals)  # float32 values, exactly the old layout
+        spec.append(
+            {
+                "codec": "topk",
+                "dtype": np.asarray(leaf).dtype.str,
+                "shape": list(np.asarray(leaf).shape),
+                "index_codec": icodec,
+                "parts": 2,
+            }
+        )
+    old_frame = bytes(
+        serialize_arrays(
+            parts,
+            {
+                "contributors": ["old-peer"],
+                "num_samples": 3,
+                "additional_info": {},
+                CODEC_META_KEY: spec,
+                DELTA_META_KEY: {"round": 3, "anchor_crc": anchor_crc},
+            },
+        )
+    )
+    arrays, meta = receiver.decode_frame(old_frame)
+    assert meta["contributors"] == ["old-peer"]
+    for got, anc, d in zip(arrays, anchor, deltas):
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32).reshape(-1),
+            np.asarray(anc, np.float32).reshape(-1) + d,
+            atol=1e-6,
+        )
+
+
+def test_quantized_codec_is_parity_exempt_negative_control():
+    """The parity plane certifies the DENSE wire (parity.md): a quantized
+    sparse round-trip is lossy BY DESIGN, so its reconstruction must not
+    hash-match the exact model — the negative control documenting the
+    codec-scoped parity exemption."""
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.telemetry.ledger import canonical_params_hash
+
+    rng = np.random.default_rng(12)
+    sender = mlp_model(seed=0)
+    anchor = sender.get_parameters()
+    cs, cr = DeltaWireCodec("s"), DeltaWireCodec("r")
+    cs.set_anchor(anchor, 1)
+    cr.set_anchor(anchor, 1)
+    sender.set_parameters(
+        [np.asarray(p) + 0.01 * rng.standard_normal(np.asarray(p).shape).astype(np.float32) for p in anchor]
+    )
+    sender.set_contribution(["s"], 1)
+    with Settings.overridden(
+        WIRE_COMPRESSION="topk", WIRE_TOPK_RATIO=1.0, WIRE_TOPK_VALUES="int4",
+        COALESCE_ENABLED=True,
+    ):
+        blob, _ = cs.encode_tagged(sender, 1)
+    arrays, _ = cr.decode_frame(blob)
+    assert canonical_params_hash(arrays) != canonical_params_hash(
+        sender.get_parameters()
+    )
 
 
 # --- frame integrity ----------------------------------------------------------

@@ -331,9 +331,12 @@ def test_gossiper_tx_counters_mirrored_into_registry():
     from p2pfl_tpu.comm.gossiper import Gossiper
 
     g = Gossiper("mem://tx-test", send_fn=lambda n, e: None, get_direct_neighbors_fn=list)
-    env = Envelope.weights("mem://tx-test", "partial_model", 2, b"x" * 100, ["a"], 1)
+    env = Envelope.weights(
+        "mem://tx-test", "partial_model", 2, b"x" * 100, ["a"], 1, codec="topk-int8"
+    )
     g._record_tx(env)
     fam = REGISTRY.get("p2pfl_gossip_tx_bytes_total")
     assert fam is not None
-    assert fam.labels("mem://tx-test", "partial_model", "2").value == 100
+    assert fam.labels("mem://tx-test", "partial_model", "2", "topk-int8").value == 100
     assert g.bytes_for_round(2) == 100
+    assert g.bytes_by_codec() == {"topk-int8": 100}

@@ -1,6 +1,6 @@
 # Convenience targets; everything also runs as the plain commands shown.
 
-.PHONY: test test-fast bench dryrun proto-check api-docs telemetry-check chaos-check byzantine-check observatory-check perf-check async-check fleetobs-check recovery-check parity-check wire-check analyze race-check
+.PHONY: test test-fast bench dryrun proto-check api-docs telemetry-check chaos-check byzantine-check observatory-check perf-check async-check fleetobs-check recovery-check parity-check wire-check privacy-check analyze race-check
 
 test:            ## full suite on the virtual 8-device CPU mesh (~30 min, 1 core)
 	python -m pytest tests/ -q
@@ -46,6 +46,9 @@ parity-check:    ## sim↔real gate: one seeded 3-node scenario on the wire AND 
 
 wire-check:      ## 3-node gate: int4+coalesced codec matches f32 accuracy, sparse bytes shrink >=2x, measured train<->diffuse overlap > 0 (CPU-only)
 	JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/wire_check.py
+
+privacy-check:   ## 3-node gate: masked run matches plaintext accuracy, one masker killed mid-round does not corrupt the aggregate, epsilon reported nonzero (CPU-only)
+	JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/privacy_check.py
 
 analyze:         ## static correctness pass (C1-C5: lock order, blocking-under-lock, unguarded writes, jit purity, drift); exit 0 clean / 1 new finding / 2 stale suppression
 	PYTHONPATH=. python scripts/analyze.py --baseline analysis_baseline.json

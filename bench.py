@@ -1522,6 +1522,277 @@ def run_wire_bench() -> None:
     _emit(out, backend="cpu")
 
 
+def run_privacy_bench() -> None:
+    """Subprocess-style mode ``--privacy``: privacy-plane acceptance run.
+
+    Four arms over the real 8-node Node/gossip/aggregator stack (pinned
+    learner seeds so the lattice pipeline is replay-comparable):
+
+    * ``plaintext-int8`` — the PR 12 topk+quant codec (int8 + coalesce),
+      the wire-overhead comparator;
+    * ``masked`` — ``PRIVACY_SECAGG``: pairwise-masked lattice frames on
+      the shared rand-k support;
+    * ``masked-nomask`` — the IDENTICAL lattice pipeline with the pairwise
+      masks zeroed (bench-local patch): the bit-exactness comparator. The
+      masks cancel in modular integer arithmetic, so this arm must land at
+      EXACTLY the masked arm's accuracy — the asserted 0.0 pp delta;
+    * ``masked-crash`` — DP-SGD on, one committee member (seeded
+      ``plan_masker_dropout`` trace) crashed mid-round-1: survivors repair
+      the uncancelled mask shares and must finish sane, with a nonzero
+      epsilon through the budget ledger.
+
+    Writes ``artifacts/PRIVACY_BENCH.json`` with the shared meta block.
+    Shape overrides: P2PFL_TPU_PRIVACY_NODES (default 8),
+    P2PFL_TPU_PRIVACY_ROUNDS (default 4).
+    """
+    out: dict = {}
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"  # protocol-stack bench: CPU venue
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from p2pfl_tpu.chaos import CHAOS
+        from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+        from p2pfl_tpu.config import Settings
+        from p2pfl_tpu.learning.dataset import (
+            RandomIIDPartitionStrategy,
+            synthetic_mnist,
+        )
+        from p2pfl_tpu.learning.learner import JaxLearner
+        from p2pfl_tpu.models import mlp_model
+        from p2pfl_tpu.node import Node
+        from p2pfl_tpu.privacy import BUDGETS, wire_epsilon
+        from p2pfl_tpu.privacy.secagg import PrivacyPlane
+        from p2pfl_tpu.telemetry import REGISTRY, TRACER
+        from p2pfl_tpu.telemetry.ledger import canonical_params_hash
+        from p2pfl_tpu.utils.utils import set_test_settings, wait_convergence
+
+        n_nodes = int(os.environ.get("P2PFL_TPU_PRIVACY_NODES", "8"))
+        rounds = int(os.environ.get("P2PFL_TPU_PRIVACY_ROUNDS", "4"))
+        set_test_settings()
+        Settings.RESOURCE_MONITOR_PERIOD = 0
+        Settings.LOG_LEVEL = "WARNING"
+        Settings.TRAIN_SET_SIZE = n_nodes  # full committee: every node masks
+        Settings.PRIVACY_KEY_WAIT_S = 15.0
+        # Liveness bounds for a contended host (the wire bench's rationale).
+        Settings.HEARTBEAT_TIMEOUT = 10.0
+        Settings.VOTE_TIMEOUT = 30.0
+        Settings.AGGREGATION_TIMEOUT = 120.0
+        Settings.AGGREGATION_STALL_PATIENCE = 60.0
+
+        template = mlp_model(seed=0)
+        _phase("privacy bench: pre-warming the shared XLA programs")
+        warm_data = synthetic_mnist(n_train=256, n_test=64)
+        warm_parts = warm_data.generate_partitions(1, RandomIIDPartitionStrategy)
+        warm = JaxLearner(
+            template.build_copy(), warm_parts[0], self_addr="mem://warmup",
+            batch_size=32, seed=0,
+        )
+        warm.set_epochs(1)
+        warm.fit()
+        warm.evaluate()
+        del warm
+
+        data = synthetic_mnist(n_train=256 * n_nodes, n_test=256)
+        parts = data.generate_partitions(n_nodes, RandomIIDPartitionStrategy)
+
+        # Bench-local bit-exactness comparator: run the EXACT masked lattice
+        # pipeline with the pairwise masks zeroed. Patch scope = one arm.
+        _orig_mask_own = PrivacyPlane.mask_own
+
+        def _nomask_own(self, model, anchor_leaves, round, committee, *, mask=True):
+            return _orig_mask_own(
+                self, model, anchor_leaves, round, committee, mask=False
+            )
+
+        runs: dict = {}
+
+        def run_arm(label, *, secagg, nomask=False, dp=False, crash=False):
+            Settings.WIRE_COMPRESSION = "topk"
+            Settings.WIRE_TOPK_RATIO = 0.1
+            Settings.WIRE_TOPK_VALUES = "int8"
+            Settings.COALESCE_ENABLED = True
+            Settings.PRIVACY_SECAGG = secagg
+            Settings.PRIVACY_DP_CLIP = 8.0 if dp else 0.0
+            Settings.PRIVACY_DP_SIGMA = 0.005 if dp else 0.0
+            REGISTRY.reset()
+            TRACER.reset()
+            BUDGETS.reset()
+            CHAOS.reset()
+            if nomask:
+                PrivacyPlane.mask_own = _nomask_own
+            _phase(f"privacy bench: {n_nodes}-node federation, arm={label}")
+            nodes = [
+                Node(
+                    template.build_copy(params=mlp_model(seed=i).get_parameters()),
+                    parts[i], batch_size=32, seed=i,
+                )
+                for i in range(n_nodes)
+            ]
+            victim = None
+            killed = False
+            t0 = time.monotonic()
+            try:
+                for nd in nodes:
+                    nd.start()
+                for i in range(1, n_nodes):
+                    nodes[i].connect(nodes[0].addr)
+                wait_convergence(nodes, n_nodes - 1, wait=30)
+                if crash:
+                    trace = CHAOS.plan_masker_dropout(
+                        rounds, [nd.addr for nd in nodes], seed=11, drop_round=1
+                    )
+                    victim = next(nd for nd in nodes if nd.addr == trace[0].node)
+                nodes[0].set_start_learning(rounds=rounds, epochs=1)
+                deadline = time.time() + 900
+                while time.time() < deadline:
+                    if victim is not None and not killed:
+                        if (victim.state.round or 0) >= 1:
+                            time.sleep(0.5)
+                            victim.crash()
+                            CHAOS.recovery(victim.addr, "crash")
+                            killed = True
+                    live = [nd for nd in nodes if nd is not victim or not killed]
+                    if all(
+                        not nd.learning_in_progress()
+                        and nd.learning_workflow is not None
+                        for nd in live
+                    ):
+                        break
+                    time.sleep(0.25)
+                else:
+                    raise TimeoutError(f"{label} federation did not finish")
+                wall_s = time.monotonic() - t0
+                live = [nd for nd in nodes if nd is not victim or not killed]
+                by_codec: dict = {}
+                for nd in nodes:
+                    for codec, b in nd.protocol.gossiper.bytes_by_codec().items():
+                        by_codec[codec] = by_codec.get(codec, 0) + b
+                accs = [nd.learner.evaluate().get("test_acc", 0.0) for nd in live]
+                repairs = 0
+                fam = REGISTRY.get("p2pfl_privacy_repairs_total")
+                if fam is not None:
+                    repairs = sum(
+                        int(c.value) for lbl, c in fam.samples()
+                        if lbl.get("role") == "applied"
+                    )
+                masked_ok = 0
+                fam = REGISTRY.get("p2pfl_privacy_masked_rounds_total")
+                if fam is not None:
+                    masked_ok = sum(
+                        int(c.value) for lbl, c in fam.samples()
+                        if lbl.get("outcome") == "ok"
+                    )
+                runs[label] = {
+                    "bytes_by_codec": {k: int(v) for k, v in sorted(by_codec.items())},
+                    "final_test_acc_mean": round(sum(accs) / len(accs), 6),
+                    "final_test_acc_min": round(min(accs), 6),
+                    "params_hash_node0": canonical_params_hash(
+                        live[0].learner.get_model().get_parameters()
+                    ),
+                    "masked_rounds_ok": masked_ok,
+                    "mask_repairs_applied": repairs,
+                    "dp_epsilon": wire_epsilon(
+                        max(BUDGETS.epsilon(nd.addr) for nd in live)
+                    ) if dp else None,
+                    "killed": bool(killed),
+                    "wall_s": round(wall_s, 2),
+                }
+                _phase(f"privacy bench {label}: {json.dumps(runs[label])}")
+            finally:
+                PrivacyPlane.mask_own = _orig_mask_own
+                for nd in nodes:
+                    try:
+                        nd.stop()
+                    except Exception:  # noqa: BLE001 — crashed victim
+                        pass
+                InMemoryRegistry.reset()
+                CHAOS.reset()
+
+        run_arm("plaintext-int8", secagg=False)
+        run_arm("masked", secagg=True)
+        run_arm("masked-nomask", secagg=True, nomask=True)
+        run_arm("masked-crash", secagg=True, dp=True, crash=True)
+
+        # Acceptance 1: bit-exact masked FedAvg at zero dropout — 0.0 pp
+        # accuracy delta between the masked arm and its maskless twin.
+        bitexact_pp = 100.0 * abs(
+            runs["masked"]["final_test_acc_mean"]
+            - runs["masked-nomask"]["final_test_acc_mean"]
+        )
+        if bitexact_pp != 0.0:
+            raise AssertionError(
+                f"masked vs maskless accuracy delta {bitexact_pp} pp != 0.0"
+            )
+        # Acceptance 2: <=15% wire overhead on top of the topk+quant codec.
+        topk_sparse = sum(
+            b for c, b in runs["plaintext-int8"]["bytes_by_codec"].items()
+            if c.startswith("topk")
+        )
+        masked_sparse = runs["masked"]["bytes_by_codec"].get("masked", 0)
+        overhead = masked_sparse / max(topk_sparse, 1)
+        if overhead > 1.15:
+            raise AssertionError(
+                f"masked wire bytes {masked_sparse} are {overhead:.2f}x the "
+                f"topk+quant codec's {topk_sparse} (bound 1.15x)"
+            )
+        # Acceptance 3: the crash arm survived with a live DP budget. The
+        # crash is a wall-clock race (the victim may die before OR after its
+        # round-1 frame lands anywhere), so survivor accuracy is bounded
+        # against the plaintext arm rather than asserted equal.
+        crash = runs["masked-crash"]
+        if not crash["killed"]:
+            raise AssertionError("crash arm never killed its masker")
+        if crash["final_test_acc_mean"] < runs["plaintext-int8"][
+            "final_test_acc_mean"
+        ] - 0.25:
+            raise AssertionError(
+                f"crash-arm accuracy {crash['final_test_acc_mean']} collapsed"
+            )
+        if not (crash["dp_epsilon"] or 0) > 0:
+            raise AssertionError(f"crash arm epsilon {crash['dp_epsilon']}")
+        out = {
+            "metric": "privacy_masked_wire_overhead_vs_topk_quant",
+            "value": round(overhead, 4),
+            "unit": "x",
+            "vs_baseline": round(overhead, 4),
+            "meta": _bench_meta(seed=0, backend="cpu"),
+            "extra": {
+                "nodes": n_nodes,
+                "rounds": rounds,
+                "runs": runs,
+                "bitexact_acc_delta_pp": bitexact_pp,
+                "bitexact_params_hash_match": (
+                    runs["masked"]["params_hash_node0"]
+                    == runs["masked-nomask"]["params_hash_node0"]
+                ),
+                "masked_sparse_bytes": int(masked_sparse),
+                "topk_quant_sparse_bytes": int(topk_sparse),
+                "acc_delta_pp_vs_plaintext": round(
+                    100.0
+                    * (
+                        runs["plaintext-int8"]["final_test_acc_mean"]
+                        - runs["masked"]["final_test_acc_mean"]
+                    ),
+                    2,
+                ),
+                "note": "value = masked lattice frame bytes over the PR 12 "
+                "topk-int8+coalesce sparse bytes at the same ratio (<=1.15 "
+                "acceptance); bitexact_acc_delta_pp compares the masked arm "
+                "against the identical pipeline with masks zeroed (must be "
+                "exactly 0.0 — modular mask cancellation is exact, not "
+                "float-approximate)",
+            },
+        }
+        os.makedirs("artifacts", exist_ok=True)
+        with open(os.path.join("artifacts", "PRIVACY_BENCH.json"), "w") as f:
+            json.dump(out, f, indent=1)
+    except Exception as e:  # noqa: BLE001
+        traceback.print_exc(file=sys.stderr)
+        out["error"] = f"{type(e).__name__}: {e}"
+    _emit(out, backend="cpu")
+
+
 def run_parity_bench() -> None:
     """Subprocess-style mode ``--parity``: sim↔real parity acceptance run.
 
@@ -4734,6 +5005,8 @@ if __name__ == "__main__":
         run_cifar_bench()
     elif "--wire" in sys.argv:
         run_wire_bench()
+    elif "--privacy" in sys.argv:
+        run_privacy_bench()
     elif "--telemetry" in sys.argv:
         run_telemetry_bench()
     elif "--observatory" in sys.argv:

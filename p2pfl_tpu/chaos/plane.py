@@ -294,6 +294,32 @@ class ChaosPlane:
             events.append(RecoveryEvent(min(healed, rounds), "heal", groups=split))
         return tuple(sorted(events, key=lambda e: (e.when, e.kind, e.node)))
 
+    def plan_masker_dropout(
+        self,
+        rounds: int,
+        committee: Sequence[str],
+        *,
+        seed: Optional[int] = None,
+        drop_round: int = 1,
+    ) -> Tuple["RecoveryEvent", ...]:
+        """Seeded masker-dropout trace (privacy-plane acceptance): one
+        committee member, drawn with a dedicated
+        ``random.Random(f"{seed}|masker")`` stream, crashes at
+        ``drop_round`` MID-masked-round — after keys were exchanged, before
+        its masked frame lands everywhere. The survivors must repair the
+        uncancelled pairwise shares (``privacy_repair``) and the round's
+        aggregate must stay correct. The driver executes the crash
+        (:meth:`Node.crash`) and reports it via :meth:`recovery` so replays
+        assert identical event counts, like every other scenario trace."""
+        rng = random.Random(
+            f"{seed if seed is not None else Settings.CHAOS_SEED}|masker"
+        )
+        pool = list(committee)
+        if not pool or not 0 <= drop_round < rounds:
+            return ()
+        victim = pool[rng.randrange(len(pool))]
+        return (RecoveryEvent(drop_round, "crash", victim),)
+
     def recovery(self, label: str, kind: str) -> None:
         """Count one EXECUTED recovery-scenario event (``kind`` is "crash" |
         "restart" | "partition" | "heal" — recorded for the log line; the

@@ -15,6 +15,8 @@ from __future__ import annotations
 import logging
 from typing import TYPE_CHECKING, Any, List
 
+import numpy as np
+
 from p2pfl_tpu.comm.commands.command import Command
 from p2pfl_tpu.comm.delta import DELTA_META_KEY
 from p2pfl_tpu.config import Settings
@@ -226,7 +228,9 @@ class PartialModelCommand(Command):
         try:
             # Frames decode through the node's delta codec: dense frames pass
             # straight through; sparse top-k deltas reconstruct against this
-            # round's anchor (jitted scatter-add — no host loop).
+            # round's anchor (jitted scatter-add — no host loop). Masked
+            # lattice frames (privacy plane) carry neither delta nor codec
+            # spec and pass through untouched — they are handled below.
             arrays, meta = state.wire.decode_frame(weights)
         except DeltaAnchorError as exc:
             # Out of phase, not corrupt: drop it, the gossip loop re-ships.
@@ -238,6 +242,66 @@ class PartialModelCommand(Command):
             # not an exception storm.
             log.debug("partial model from %s undecodable: %s", source, exc)
             state.admission.record("corrupt", source, "partial_model")
+            return
+        from p2pfl_tpu.privacy.secagg import MASKED_META_KEY, PrivacyPlane
+
+        if PrivacyPlane.is_masked_frame(meta):
+            # Masked lattice frame: structural screening only (uniform ring
+            # values cannot be norm-screened — the committee-side range
+            # check at finalize owns the rest), then straight into the
+            # lattice-summing aggregator. Never touches the model or the
+            # delta anchor.
+            if not Settings.PRIVACY_SECAGG:
+                state.admission.record("masked_structure", source, "partial_model")
+                return
+            if not state.train_set:
+                # Out of phase, not hostile: the round's committee is not
+                # elected here yet (vote in progress), so the frame's
+                # declared geometry CANNOT be validated — drop silently and
+                # let the sender's gossip loop re-ship, exactly like a
+                # sparse frame ahead of our anchor. Rejecting would both
+                # poison the honest sender's suspect score and stall its
+                # gossip coverage into an abandonment.
+                log.debug(
+                    "masked partial from %s dropped: round %s committee not "
+                    "elected yet", source, round,
+                )
+                return
+            try:
+                lattices = PrivacyPlane.parse_frame(arrays, meta)
+            except Exception as exc:  # hostile plane geometry
+                log.debug("masked partial from %s unparseable: %s", source, exc)
+                state.admission.record("corrupt", source, "partial_model")
+                return
+            try:
+                model = node.learner.get_model()
+                shapes = [tuple(np.asarray(p).shape) for p in model.get_parameters()]
+                dtypes = [np.asarray(p).dtype for p in model.get_parameters()]
+                supports = PrivacyPlane.supports(round, shapes, dtypes)
+                expected_ks = [0 if s is None else int(s.size) for s in supports]
+            except Exception:  # noqa: BLE001 — geometry failure = reject
+                state.admission.record("masked_structure", source, "partial_model")
+                return
+            if state.admission.screen_masked(
+                lattices,
+                meta.get(MASKED_META_KEY),
+                committee=state.train_set,
+                contributors=contributors,
+                expected_ks=expected_ks,
+                source=source,
+                cmd="partial_model",
+            ):
+                return
+            handle = PrivacyPlane.handle_from_frame(
+                lattices, meta, contributors, num_samples
+            )
+            agg = node.aggregator.add_model(handle, round=round)
+            if agg:
+                node.protocol.broadcast(
+                    node.protocol.build_msg(
+                        ModelsAggregatedCommand.get_name(), args=agg, round=state.round
+                    )
+                )
             return
         # Admission control: screen the RECONSTRUCTED arrays (post sparse-
         # delta decode) against the local model spec + adaptive norm bound
@@ -501,6 +565,74 @@ class ReconcileModelCommand(Command):
             log.info(
                 "%s: reconcile catch-up for round %s staged (from %s)",
                 node.addr, round, source,
+            )
+
+
+class PrivacyKeyCommand(Command):
+    """Session public key for the privacy plane's pairwise mask agreement.
+
+    ``args = [pubkey_hex]``. TTL-gossiped at session bootstrap
+    (``establish_initial_model``); the handler answers a FIRST-seen key with
+    its own key sent directly back, so a joiner (or a peer whose broadcast
+    was dropped) converges on a symmetric pair secret without a dedicated
+    handshake round. Idempotent: repeated keys no-op."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "privacy_key"
+
+    def execute(self, source: str, round: int, *args: str, **kwargs: Any) -> None:
+        node = self._node
+        if source == node.addr or not args:
+            return
+        if node.state.privacy.learn_key(source, args[0]):
+            # New peer: answer with our key so the pair secret is derivable
+            # on both ends even if our bootstrap broadcast never reached it.
+            try:
+                node.protocol.send(
+                    source,
+                    node.protocol.build_msg(
+                        PrivacyKeyCommand.get_name(),
+                        args=[node.state.privacy.key_payload()],
+                    ),
+                    create_connection=True,
+                    raise_error=False,
+                    remove_on_error=False,
+                )
+            except Exception:  # noqa: BLE001 — a failed reply must not hurt us
+                log.debug("privacy_key reply to %s failed", source)
+
+
+class PrivacyRepairCommand(Command):
+    """Mask-repair share for a dead masker (privacy plane).
+
+    ``args = [dead_addr, pair_secret_hex]``, ``round`` = the masked round
+    being repaired. Broadcast by every survivor whose pairwise mask with
+    the dead committee member would otherwise stay uncancelled in the
+    round's lattice sum; every aggregating node stores the share and
+    :meth:`PrivacyPlane.finalize` subtracts the reconstructed mask. The
+    reveal is safe exactly because the dead peer's own frame is absent from
+    the sum being repaired (when it DID arrive, the peer is a contributor
+    and no repair is applied — first wins, like full-model adoption)."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "privacy_repair"
+
+    def execute(self, source: str, round: int, *args: str, **kwargs: Any) -> None:
+        node = self._node
+        if len(args) < 2 or source == node.addr:
+            return
+        dead, secret_hex = args[0], args[1]
+        if node.state.privacy.note_repair(int(round), source, dead, secret_hex):
+            node.protocol.flight_recorder.record(
+                "privacy_repair", survivor=source, dead=dead, round=int(round)
             )
 
 

@@ -81,8 +81,10 @@ DELTA_META_KEY = "__delta__"
 COALESCE_META_KEY = "__coalesce__"
 
 #: Codec labels (telemetry + gossiper TX attribution). ``dense`` is every
-#: non-sparse frame (init, fallback, catch-up, reconcile).
-CODEC_LABELS = ("topk", "topk-int8", "topk-int4", "dense")
+#: non-sparse frame (init, fallback, catch-up, reconcile); ``masked`` is a
+#: privacy-plane lattice frame (p2pfl_tpu/privacy/secagg.py — value planes
+#: only, the shared rand-k support costs zero wire bytes).
+CODEC_LABELS = ("topk", "topk-int8", "topk-int4", "dense", "masked")
 
 _COMPRESSION_RATIO = REGISTRY.gauge(
     "p2pfl_wire_compression_ratio",

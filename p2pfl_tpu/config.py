@@ -224,6 +224,56 @@ class Settings:
     ASYNC_SUSPECT_GATE: float = _env_float("ASYNC_SUSPECT_GATE", 1.0, 0.0, 1e9)
     ASYNC_STRAGGLER_GATE: float = _env_float("ASYNC_STRAGGLER_GATE", 2.0, 0.0, 1e9)
 
+    # --- privacy plane (p2pfl_tpu/privacy/) ---------------------------------
+    # Committee-based distributed secure aggregation + DP-SGD on the gossip
+    # wire (DisAgg, arxiv 2605.13708; Papaya, arxiv 2111.04877). All values
+    # validated at load with the WIRE_COMPRESSION fail-fast pattern.
+    #
+    # Masked rounds: committee members exchange pairwise masks (finite-field
+    # DH key agreement over the gossip wire -> per-(round, pair) PRG seeds)
+    # that cancel EXACTLY in the integer-lattice sum, so no single frame
+    # reveals an individual update but the committee sum decodes to the
+    # plain aggregate (bit-exact with the same pipeline run maskless).
+    PRIVACY_SECAGG: bool = _env_override("PRIVACY_SECAGG", False)
+    # Fraction of each delta tensor shipped on masked rounds. Masked frames
+    # use a SHARED pseudorandom support (rand-k from public round state, so
+    # indices cost zero wire bytes and pairwise masks cancel position-wise);
+    # per-sender top-k supports cannot cancel and are unusable here.
+    PRIVACY_MASK_RATIO: float = _env_float("PRIVACY_MASK_RATIO", 0.1, 1e-6, 1.0)
+    # Ring width of the masked integer lattice (frame bytes/value = bits/8;
+    # 12-bit values pack two-per-three-bytes on the wire — 1.5 B/value, which
+    # is what keeps masked frames under the topk+quant codec's byte budget
+    # while qmax stays int8-class resolution). The committee sum must
+    # decode: n * qmax * headroom < 2^(bits-1).
+    PRIVACY_RING_BITS: int = _env_int("PRIVACY_RING_BITS", 12, 12, 32)
+    if PRIVACY_RING_BITS not in (12, 16, 32):
+        raise ValueError(
+            f"P2PFL_TPU_PRIVACY_RING_BITS={PRIVACY_RING_BITS} is not one of "
+            "(12, 16, 32)"
+        )
+    # Per-coordinate clamp applied at the SENDER before lattice quantization
+    # (clipping-at-sender: the committee cannot norm-screen masked frames, so
+    # the bound is enforced where the plaintext still exists). Sets the
+    # lattice scale (RANGE / qmax): smaller range = finer quantization of
+    # the typical tiny per-coordinate delta; clamp overflow lands in the EF
+    # residual and ships next round, like every other codec error.
+    PRIVACY_VALUE_RANGE: float = _env_float("PRIVACY_VALUE_RANGE", 0.25, 1e-9, 1e3)
+    # Committee-side range check on the UNMASKED aggregate: reject the masked
+    # round when the decoded lattice sum exceeds committee_size * qmax (only
+    # a ring wrap — a hostile or unrepaired mask share — can get there).
+    PRIVACY_RANGE_MULT: float = _env_float("PRIVACY_RANGE_MULT", 1.0, 1.0, 1e6)
+    # Hard cap on masked-committee size (decode-bound fail-fast: beyond it
+    # qmax degrades below 1 and the lattice cannot carry a value at all).
+    PRIVACY_MAX_COMMITTEE: int = _env_int("PRIVACY_MAX_COMMITTEE", 256, 2, 16384)
+    # Bounded wait for committee pubkeys during session bootstrap (seconds).
+    PRIVACY_KEY_WAIT_S: float = _env_float("PRIVACY_KEY_WAIT_S", 10.0, 0.0, 600.0)
+    # DP-SGD defaults picked up by JaxLearner when not set per-learner:
+    # per-example L2 clip (0 disables DP) and Gaussian noise multiplier.
+    PRIVACY_DP_CLIP: float = _env_float("PRIVACY_DP_CLIP", 0.0, 0.0, 1e6)
+    PRIVACY_DP_SIGMA: float = _env_float("PRIVACY_DP_SIGMA", 0.0, 0.0, 1e3)
+    # Target delta of the reported (epsilon, delta) privacy budget.
+    PRIVACY_DELTA: float = _env_float("PRIVACY_DELTA", 1e-5, 1e-12, 0.5)
+
     # --- durable recovery plane (management/checkpoint.py NodeJournal,
     # stages/recovery.py, comm heal detection) ------------------------------
     # Crash-restart resume, partition-heal reconciliation and quorum-aware

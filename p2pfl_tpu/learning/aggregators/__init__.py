@@ -10,6 +10,7 @@ from p2pfl_tpu.learning.aggregators.fedavg import (  # noqa: F401
     FedAvg,
 )
 from p2pfl_tpu.learning.aggregators.fedmedian import FedMedian  # noqa: F401
+from p2pfl_tpu.learning.aggregators.masked import MaskedFedAvg  # noqa: F401
 from p2pfl_tpu.learning.aggregators.robust import (  # noqa: F401
     GeometricMedian,
     Krum,
@@ -20,6 +21,6 @@ from p2pfl_tpu.learning.aggregators.scaffold import Scaffold  # noqa: F401
 
 __all__ = [
     "Aggregator", "AsyncBufferedAggregator", "CanonicalFedAvg", "FedAvg",
-    "FedMedian", "GeometricMedian", "Krum", "MultiKrum", "TrimmedMean",
-    "Scaffold", "staleness_weight",
+    "FedMedian", "GeometricMedian", "Krum", "MaskedFedAvg", "MultiKrum",
+    "TrimmedMean", "Scaffold", "staleness_weight",
 ]

@@ -296,8 +296,8 @@ class JaxLearner(Learner):
         lr: float = 1e-3,
         batch_size: int = 64,
         fedprox_mu: float = 0.0,
-        dp_clip_norm: float = 0.0,
-        dp_noise_multiplier: float = 0.0,
+        dp_clip_norm: Optional[float] = None,
+        dp_noise_multiplier: Optional[float] = None,
         seed: Optional[int] = None,
         callbacks: Optional[List[str]] = None,
         interrupt_every: Optional[int] = None,
@@ -310,8 +310,20 @@ class JaxLearner(Learner):
         self.optimizer = optimizer if optimizer is not None else optax.adam(self.lr)
         self.batch_size = int(batch_size)
         self.fedprox_mu = float(fedprox_mu)
-        self.dp_clip_norm = float(dp_clip_norm)
-        self.dp_noise_multiplier = float(dp_noise_multiplier)
+        # None defers to the privacy plane's process-wide DP defaults
+        # (P2PFL_TPU_PRIVACY_DP_* — validated in config.py), so a federation
+        # can be made private by environment without touching every Node
+        # constructor; an explicit argument still wins.
+        from p2pfl_tpu.config import Settings
+
+        self.dp_clip_norm = float(
+            Settings.PRIVACY_DP_CLIP if dp_clip_norm is None else dp_clip_norm
+        )
+        self.dp_noise_multiplier = float(
+            Settings.PRIVACY_DP_SIGMA
+            if dp_noise_multiplier is None
+            else dp_noise_multiplier
+        )
         if self.dp_noise_multiplier > 0.0 and self.dp_clip_norm <= 0.0:
             raise ValueError(
                 "dp_noise_multiplier > 0 requires dp_clip_norm > 0 — without "
@@ -582,10 +594,27 @@ class JaxLearner(Learner):
         self.report("update_norm", upd_norm)
         SKETCHES.observe("update_norm", self._self_addr, upd_norm)
 
+        # Per-node privacy-budget ledger (p2pfl_tpu/privacy/budget.py): the
+        # cumulative epsilon rides the health digest and fed_top, so the
+        # fleet sees each node's spend — not just the node itself.
+        from p2pfl_tpu.privacy.budget import BUDGETS
+
         if self.dp_clip_norm <= 0.0:
             self._nonprivate_steps += total_steps
+            BUDGETS.record(
+                self._self_addr,
+                clip_norm=0.0,
+                noise_multiplier=0.0,
+                nonprivate_steps=total_steps,
+            )
         else:
             self._dp_total_steps += total_steps
+            BUDGETS.record(
+                self._self_addr,
+                clip_norm=self.dp_clip_norm,
+                noise_multiplier=self.dp_noise_multiplier,
+                dp_steps=total_steps,
+            )
             # Reported as a metric, NOT stamped into model.additional_info:
             # aggregation merges peers' additional_info into the local model,
             # so a stamped entry could be overwritten by another node's

@@ -374,6 +374,14 @@ class NodeJournal:
             "nei_status": {k: int(v) for k, v in state.nei_status.items()},
             "contributors": list(model.contributors),
             "num_samples": int(model.get_num_samples()),
+            # Privacy plane: the session DH keypair + learned peer keys.
+            # A crash-restarted masker MUST come back with the same pair
+            # secrets — its re-sent masked frame then cancels exactly like
+            # the lost one would have, instead of poisoning the lattice sum
+            # with a fresh unmatched mask. Plaintext on disk, the same trust
+            # the journal already extends to model params (threat model:
+            # docs/components/privacy.md).
+            "privacy": state.privacy.export_state(),
         }
         saved = self._ck.save(int(r), tree, meta)
         if saved:
@@ -435,6 +443,9 @@ class NodeJournal:
                 node.state.nei_status.update(
                     {k: int(v) for k, v in (meta.get("nei_status") or {}).items()}
                 )
+                # Masked-round continuity: restore the journaled privacy key
+                # material (pair secrets re-derive bit-identically).
+                node.state.privacy.import_state(meta.get("privacy") or {})
                 node._resume_meta = dict(meta)
                 return dict(meta)
             except Exception as exc:  # noqa: BLE001 — torn step: fall back

@@ -32,6 +32,8 @@ from p2pfl_tpu.comm.commands.impl import (
     ModelsAggregatedCommand,
     ModelsReadyCommand,
     PartialModelCommand,
+    PrivacyKeyCommand,
+    PrivacyRepairCommand,
     ReconcileCommand,
     ReconcileModelCommand,
     StartLearningCommand,
@@ -84,7 +86,26 @@ class Node:
     ) -> None:
         self.protocol = protocol(addr)
         self.state = NodeState(self.protocol.get_address())
-        self.aggregator = aggregator if aggregator is not None else FedAvg()
+        if aggregator is None:
+            if Settings.PRIVACY_SECAGG:
+                from p2pfl_tpu.learning.aggregators import MaskedFedAvg
+
+                aggregator = MaskedFedAvg()
+            else:
+                aggregator = FedAvg()
+        elif Settings.PRIVACY_SECAGG and not aggregator.partial_aggregation:
+            # The admission-vs-secrecy tension, resolved the DisAgg/Papaya
+            # way: robust rules (Krum, TrimmedMean, ...) need INDIVIDUAL
+            # updates, and secure aggregation exists to hide exactly those.
+            # Clipping-at-sender + the committee-side range check replace
+            # them on masked rounds — a non-linear rule here would silently
+            # score uniform ring noise.
+            raise ValueError(
+                "PRIVACY_SECAGG requires a linear (partial-aggregation) "
+                f"rule; {type(aggregator).__name__} inspects individual "
+                "updates, which masked frames hide by design"
+            )
+        self.aggregator = aggregator
         self.aggregator.set_addr(self.addr)
         required = self.aggregator.get_required_callbacks()
         if required:
@@ -164,6 +185,10 @@ class Node:
                 # progress exchange + dense catch-up adoption.
                 ReconcileCommand(self),
                 ReconcileModelCommand(self),
+                # Privacy plane (p2pfl_tpu/privacy/): pairwise-mask key
+                # agreement + masker-dropout repair shares.
+                PrivacyKeyCommand(self),
+                PrivacyRepairCommand(self),
             ]
         )
 
@@ -582,6 +607,28 @@ class Node:
             # Rebind (don't mutate): stages iterate the current binding.
             state.train_set = [n for n in state.train_set if n != addr]
         shrunk = self.aggregator.remove_node(addr)
+        if shrunk and Settings.PRIVACY_SECAGG and state.round is not None:
+            # Masker dropout: the dead committee member's pairwise mask
+            # shares are now uncancelled in every aggregator's lattice sum.
+            # Reveal OUR pair secret with it (privacy_repair broadcast) so
+            # finalize can subtract our share; every other survivor does the
+            # same for theirs. Safe precisely because the dead peer's own
+            # frame never entered the sums being repaired (shrunk=True means
+            # its contribution had not arrived).
+            secret = state.privacy.repair_secrets_for(addr, state.round)
+            if secret is not None:
+                self.protocol.broadcast(
+                    self.protocol.build_msg(
+                        PrivacyRepairCommand.get_name(),
+                        args=[addr, secret],
+                        round=state.round,
+                    )
+                )
+                logger.warning(
+                    self.addr,
+                    f"masker {addr} died mid-round {state.round}: revealed "
+                    "our pair secret for mask repair",
+                )
         state.models_aggregated.pop(addr, None)
         # The retired coverage table too: an overlap drain must stop trying
         # to serve a dead laggard (its candidate filter reads this).

@@ -47,6 +47,7 @@ class NodeState:
     def __init__(self, addr: str) -> None:
         from p2pfl_tpu.comm.admission import AdmissionController
         from p2pfl_tpu.comm.delta import DeltaWireCodec
+        from p2pfl_tpu.privacy.secagg import PrivacyPlane
 
         self.addr = addr
         self.status = "Idle"
@@ -57,6 +58,12 @@ class NodeState:
         # (structure/dtype/NaN/norm-bound, comm/admission.py) between
         # decode_frame and aggregator.add_model / apply_frame.
         self.admission = AdmissionController(addr)
+        # Privacy plane (p2pfl_tpu/privacy/): session DH keypair, pairwise
+        # mask state, EF residual of the masked lattice codec, repair
+        # shares. Active only under Settings.PRIVACY_SECAGG, but the key
+        # material exists unconditionally so handshakes from masked peers
+        # always have something to answer with.
+        self.privacy = PrivacyPlane(addr)
         # Federation-wide trace id of the running experiment: minted by the
         # initiator, adopted by peers from the start_learning frame's span
         # context (telemetry/tracing.py). None -> the workflow opens a

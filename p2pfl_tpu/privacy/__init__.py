@@ -1,0 +1,45 @@
+"""Privacy plane: committee-based secure aggregation + DP-SGD budget.
+
+* :mod:`p2pfl_tpu.privacy.masking` — pairwise mask algebra (DH key
+  agreement, per-round PRG streams, the exactly-cancelling integer
+  lattice).
+* :mod:`p2pfl_tpu.privacy.secagg` — the per-node :class:`PrivacyPlane`
+  (masked encode/finalize, repairs, journal round-trip).
+* :mod:`p2pfl_tpu.privacy.budget` — the per-node RDP privacy-budget ledger
+  surfaced through digest / observatory / ``fed_top``.
+
+See ``docs/components/privacy.md`` for the threat model, the mask
+protocol, and the budget semantics.
+"""
+
+from p2pfl_tpu.privacy.budget import BUDGETS, PrivacyBudgetLedger, wire_epsilon
+from p2pfl_tpu.privacy.masking import (
+    PairwiseMasker,
+    center_ring,
+    lattice_qmax,
+    ring_dtype,
+    shared_support,
+    signed_share,
+)
+from p2pfl_tpu.privacy.secagg import (
+    MASKED_INFO_KEY,
+    MASKED_META_KEY,
+    PrivacyPlane,
+    masked_info,
+)
+
+__all__ = [
+    "BUDGETS",
+    "MASKED_INFO_KEY",
+    "MASKED_META_KEY",
+    "PairwiseMasker",
+    "PrivacyBudgetLedger",
+    "PrivacyPlane",
+    "center_ring",
+    "lattice_qmax",
+    "masked_info",
+    "ring_dtype",
+    "shared_support",
+    "signed_share",
+    "wire_epsilon",
+]

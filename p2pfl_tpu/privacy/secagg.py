@@ -1,0 +1,504 @@
+"""Committee-based secure aggregation on the gossip wire.
+
+One masked round, end to end (sync scheduler; the async scheduler runs the
+DP half of the plane only — see ``docs/components/privacy.md``):
+
+1. **Bootstrap** — every node broadcasts its session public key
+   (``privacy_key``); :class:`~p2pfl_tpu.privacy.masking.PairwiseMasker`
+   derives pair secrets on demand.
+2. **Encode** (:meth:`PrivacyPlane.mask_own`) — the trainer computes its
+   round delta against the shared round anchor, adds the error-feedback
+   residual, samples it on the round's SHARED rand-k support (public seed →
+   zero index bytes on the wire), clamps each value to
+   ``±PRIVACY_VALUE_RANGE`` (clipping-at-sender), quantizes onto the
+   integer lattice, and adds its pairwise mask total. The EF residual
+   absorbs clamp + lattice error element-exactly, like the PR 12 quant
+   codec's residual does.
+3. **Gossip** — masked frames ride the normal partial-model gossip
+   (codec label ``masked``); lattice vectors ADD mod the ring, so partial
+   aggregation, contributor dedup, coverage tracking and overlap drains all
+   work unchanged (:class:`~p2pfl_tpu.learning.aggregators.masked.
+   MaskedFedAvg`).
+4. **Screen** — the committee cannot norm-screen a masked frame (its values
+   are uniform ring elements by design — the admission-vs-secrecy tension);
+   :meth:`p2pfl_tpu.comm.admission.AdmissionController.screen_masked`
+   validates everything that IS checkable (ring dtype, per-tensor support
+   sizes, declared round/committee) and the committee-side range check at
+   finalize catches what is not.
+5. **Finalize** (:meth:`PrivacyPlane.finalize`) — with every committee
+   member present the pairwise masks have already cancelled in the merged
+   sum; for each missing masker the survivors' revealed pair secrets
+   (``privacy_repair``, journaled through the PR 10 NodeJournal on the
+   masker itself) reconstruct the uncancelled shares to subtract. The
+   centered lattice sum is range-checked (``n * qmax`` — only a ring wrap,
+   i.e. a hostile or unrepaired mask share, can exceed it), dequantized,
+   averaged with UNIT weights (the DisAgg committee mean; the
+   unauthenticated ``num_samples`` claim cannot weight what it cannot
+   inspect), and scattered onto the anchor.
+
+Masked FedAvg is bit-exact with the identical pipeline run maskless: the
+masks cancel in modular integer arithmetic, not to float epsilon — the
+property ``tests/test_privacy.py`` and ``bench.py --privacy`` assert.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.models.model_handle import ModelHandle
+from p2pfl_tpu.ops.serialization import deserialize_arrays, serialize_arrays
+from p2pfl_tpu.privacy.masking import (
+    PairwiseMasker,
+    center_ring,
+    lattice_qmax,
+    pack_ring,
+    ring_dtype,
+    shared_support,
+    signed_share,
+    unpack_ring,
+)
+from p2pfl_tpu.telemetry import REGISTRY
+
+log = logging.getLogger("p2pfl_tpu")
+
+#: Frame-metadata key marking a masked lattice frame. The payload's arrays
+#: are per-float-tensor lattice vectors over the round's shared support;
+#: non-float leaves ship nothing (finalize carries the anchor's value).
+MASKED_META_KEY = "__masked__"
+
+#: additional_info key carried on in-process masked handles.
+MASKED_INFO_KEY = "__masked__"
+
+_MASKED_FRAMES = REGISTRY.counter(
+    "p2pfl_privacy_masked_frames_total",
+    "Masked lattice frames encoded for the wire",
+    labels=("node",),
+)
+_MASKED_ROUNDS = REGISTRY.counter(
+    "p2pfl_privacy_masked_rounds_total",
+    "Masked-round finalizations by outcome (ok / unrepaired / range / "
+    "structure)",
+    labels=("node", "outcome"),
+)
+_REPAIRS = REGISTRY.counter(
+    "p2pfl_privacy_repairs_total",
+    "Mask-repair shares by role (tx = revealed own pair secret for a dead "
+    "masker, rx = stored a survivor's reveal, applied = subtracted at "
+    "finalize)",
+    labels=("node", "role"),
+)
+
+
+def masked_info(handle: ModelHandle) -> Optional[Dict[str, Any]]:
+    """The masked-lattice descriptor of an in-process handle, or ``None``
+    for a plaintext model handle."""
+    info = handle.additional_info.get(MASKED_INFO_KEY)
+    return info if isinstance(info, dict) else None
+
+
+class PrivacyPlane:
+    """Per-node secure-aggregation state (held on
+    :class:`~p2pfl_tpu.node_state.NodeState` like the delta codec and the
+    admission controller). Thread-safe: encode runs on the stage thread,
+    repairs and key learning on transport threads."""
+
+    def __init__(self, addr: str) -> None:
+        self.addr = addr
+        self._lock = threading.RLock()
+        self.masker = PairwiseMasker(addr)
+        # Error-feedback residual, float32 flat per tensor (None until the
+        # first masked encode; dropped when the model structure changes).
+        self._residual: Optional[List[np.ndarray]] = None
+        # (round, survivor, dead) -> pair secret revealed for repair.
+        self._repairs: Dict[Tuple[int, str, str], bytes] = {}
+        # rounds whose repairs we already broadcast per dead peer (dedup).
+        self._repairs_sent: set = set()
+
+    # --- key agreement (privacy_key command) ---------------------------------
+
+    def key_payload(self) -> str:
+        return self.masker.public_key_hex()
+
+    def learn_key(self, peer: str, pubkey_hex: str) -> bool:
+        with self._lock:
+            return self.masker.learn_key(peer, pubkey_hex)
+
+    def knows_keys(self, peers: Sequence[str]) -> bool:
+        with self._lock:
+            return all(self.masker.knows(p) for p in peers)
+
+    def missing_keys(self, peers: Sequence[str]) -> List[str]:
+        with self._lock:
+            return [p for p in peers if not self.masker.knows(p)]
+
+    # --- geometry ------------------------------------------------------------
+
+    @staticmethod
+    def lattice_params(committee_size: int) -> Tuple[int, int, float]:
+        """(ring bits, qmax, scale) of a masked round for ``committee_size``
+        members — a pure function of public configuration, so every member
+        derives the same lattice."""
+        bits = Settings.PRIVACY_RING_BITS
+        if committee_size > Settings.PRIVACY_MAX_COMMITTEE:
+            raise ValueError(
+                f"masked committee of {committee_size} exceeds "
+                f"PRIVACY_MAX_COMMITTEE={Settings.PRIVACY_MAX_COMMITTEE}"
+            )
+        qmax = lattice_qmax(bits, committee_size)
+        scale = Settings.PRIVACY_VALUE_RANGE / qmax
+        return bits, qmax, scale
+
+    @staticmethod
+    def supports(round: int, shapes: Sequence[tuple], dtypes: Sequence) -> List[Optional[np.ndarray]]:
+        """Shared rand-k support per tensor (``None`` for non-float leaves,
+        which masked frames do not carry)."""
+        out: List[Optional[np.ndarray]] = []
+        for i, (shape, dt) in enumerate(zip(shapes, dtypes)):
+            size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            if not np.issubdtype(np.dtype(dt), np.floating) or size == 0:
+                out.append(None)
+                continue
+            out.append(
+                shared_support(round, i, size, Settings.PRIVACY_MASK_RATIO)
+            )
+        return out
+
+    # --- encode --------------------------------------------------------------
+
+    def mask_own(
+        self,
+        model: ModelHandle,
+        anchor_leaves: Sequence[np.ndarray],
+        round: int,
+        committee: Sequence[str],
+        *,
+        mask: bool = True,
+    ) -> ModelHandle:
+        """Masked lattice handle of this node's round contribution.
+
+        ``mask=False`` runs the IDENTICAL lattice pipeline with a zero mask
+        — the bit-exactness comparator (and the fallback when a committee
+        member's key is missing would poison the sum anyway; callers decide).
+        Raises ``ValueError`` when a committee pubkey is missing with
+        ``mask=True``.
+        """
+        committee = sorted(set(committee))
+        bits, qmax, scale = self.lattice_params(len(committee))
+        dt = ring_dtype(bits)
+        leaves = model.get_parameters()
+        anchors = [
+            np.ascontiguousarray(a, np.float32).reshape(-1) for a in anchor_leaves
+        ]
+        if len(leaves) != len(anchors):
+            raise ValueError("model/anchor structure mismatch")
+        with self._lock:
+            if mask:
+                missing = self.missing_keys([p for p in committee if p != self.addr])
+                if missing:
+                    raise ValueError(f"missing committee pubkeys: {missing}")
+            if self._residual is not None and len(self._residual) != len(leaves):
+                self._residual = None
+            if self._residual is None:
+                self._residual = [
+                    np.zeros((a.size,), np.float32) for a in anchors
+                ]
+            shapes = [tuple(np.asarray(l).shape) for l in leaves]
+            dtypes = [np.asarray(l).dtype for l in leaves]
+            supports = self.supports(round, shapes, dtypes)
+            lattices: List[np.ndarray] = []
+            ks: List[int] = []
+            for i, (leaf, anchor) in enumerate(zip(leaves, anchors)):
+                idx = supports[i]
+                if idx is None:
+                    ks.append(0)
+                    continue
+                flat = np.ascontiguousarray(leaf, np.float32).reshape(-1)
+                if self._residual[i].size != flat.size:
+                    self._residual[i] = np.zeros((flat.size,), np.float32)
+                acc = (flat - anchor) + self._residual[i]
+                if not np.isfinite(acc).all():
+                    # A diverged tensor must not launder NaNs through the
+                    # lattice: transmit zero, keep the finite residual parts.
+                    acc = np.where(np.isfinite(acc), acc, 0.0).astype(np.float32)
+                v = acc[idx]
+                q = np.clip(
+                    np.rint(np.clip(v, -Settings.PRIVACY_VALUE_RANGE,
+                                    Settings.PRIVACY_VALUE_RANGE) / scale),
+                    -qmax, qmax,
+                ).astype(np.int64)
+                # Element-exact error feedback: residual[idx] becomes
+                # acc[idx] - q*scale, everything else keeps the full delta.
+                resid = acc.copy()
+                resid[idx] = (v - q.astype(np.float32) * np.float32(scale)).astype(
+                    np.float32
+                )
+                self._residual[i] = resid
+                lat = (q % (1 << bits)).astype(dt)
+                if mask:
+                    lat = (
+                        lat
+                        + self.masker.total_mask(committee, round, i, idx.size, bits)
+                    ).astype(dt)
+                lattices.append(lat)
+                ks.append(int(idx.size))
+            _MASKED_FRAMES.labels(self.addr).inc()
+            return ModelHandle(
+                params=lattices,
+                contributors=[self.addr],
+                num_samples=model.get_num_samples(),
+                additional_info={
+                    MASKED_INFO_KEY: {
+                        "round": int(round),
+                        "bits": int(bits),
+                        "n": len(committee),
+                        "ks": ks,
+                    }
+                },
+            )
+
+    # --- wire codec ----------------------------------------------------------
+
+    @staticmethod
+    def encode_frame(handle: ModelHandle, wire_ctx: str = "") -> bytes:
+        """Serialize a masked lattice handle for the gossip wire: one
+        bit-packed value plane per masked tensor (12-bit rings pack
+        two-per-three-bytes — 1.5 B/value; the shared support ships no
+        index bytes at all), lattice descriptor + federation metadata in
+        the frame header."""
+        info = masked_info(handle)
+        if info is None:
+            raise ValueError("not a masked handle")
+        bits = int(info["bits"])
+        planes = [pack_ring(a, bits) for a in handle.get_parameters()]
+        meta: Dict[str, Any] = {
+            "contributors": list(handle.contributors),
+            "num_samples": int(handle.get_num_samples()),
+            MASKED_META_KEY: dict(info),
+        }
+        if wire_ctx:
+            from p2pfl_tpu.telemetry import tracing
+
+            meta[tracing.TRACE_META_KEY] = wire_ctx
+        return serialize_arrays(planes, meta)
+
+    @staticmethod
+    def parse_frame(
+        arrays: Sequence[np.ndarray], meta: Dict[str, Any]
+    ) -> List[np.ndarray]:
+        """Unpack a masked frame's value planes into in-memory lattice
+        vectors. Raises ``ValueError`` on any geometry a hostile frame
+        controls (unknown ring, plane/k disagreement, tensor count) —
+        callers surface that as a counted ``corrupt`` rejection BEFORE any
+        value can enter a lattice sum."""
+        info = meta.get(MASKED_META_KEY)
+        if not isinstance(info, dict):
+            raise ValueError("not a masked frame")
+        bits = int(info.get("bits", 0))
+        if bits not in (12, 16, 32):
+            raise ValueError(f"unknown masked ring width {bits}")
+        ks = [int(k) for k in (info.get("ks") or []) if int(k) > 0]
+        if len(arrays) != len(ks):
+            raise ValueError("masked frame tensor count disagrees with ks")
+        return [unpack_ring(np.asarray(a), k, bits) for a, k in zip(arrays, ks)]
+
+    @staticmethod
+    def is_masked_frame(meta: Dict[str, Any]) -> bool:
+        return isinstance(meta.get(MASKED_META_KEY), dict)
+
+    @staticmethod
+    def handle_from_frame(
+        arrays: Sequence[np.ndarray],
+        meta: Dict[str, Any],
+        contributors: List[str],
+        num_samples: int,
+    ) -> ModelHandle:
+        """In-process masked handle from an admission-screened wire frame."""
+        return ModelHandle(
+            params=[np.asarray(a) for a in arrays],
+            contributors=contributors,
+            num_samples=num_samples,
+            additional_info={MASKED_INFO_KEY: dict(meta[MASKED_META_KEY])},
+        )
+
+    # --- repairs (masker dropout) --------------------------------------------
+
+    def repair_secrets_for(self, dead: str, round: int) -> Optional[str]:
+        """Hex pair secret to reveal for ``dead`` (None when unknown or
+        already revealed for this round)."""
+        with self._lock:
+            if not self.masker.knows(dead) or dead == self.addr:
+                return None
+            key = (int(round), dead)
+            if key in self._repairs_sent:
+                return None
+            self._repairs_sent.add(key)
+            sec = self.masker.pair_secret(dead)
+        _REPAIRS.labels(self.addr, "tx").inc()
+        return sec.hex()
+
+    def note_repair(
+        self, round: int, survivor: str, dead: str, secret_hex: str
+    ) -> bool:
+        """Store a survivor's revealed pair secret (transport thread)."""
+        try:
+            sec = bytes.fromhex(secret_hex)
+        except (TypeError, ValueError):
+            return False
+        if len(sec) != 32 or survivor == dead:
+            return False
+        with self._lock:
+            self._repairs[(int(round), survivor, dead)] = sec
+        _REPAIRS.labels(self.addr, "rx").inc()
+        return True
+
+    # --- finalize ------------------------------------------------------------
+
+    def finalize(
+        self,
+        handle: ModelHandle,
+        committee: Sequence[str],
+        anchor_leaves: Sequence[np.ndarray],
+    ) -> Tuple[Optional[List[np.ndarray]], str]:
+        """Unmask the merged committee sum into model-shaped parameters.
+
+        Returns ``(params, "ok")`` or ``(None, reason)`` with ``reason`` in
+        ``{"unrepaired", "range", "structure"}`` — the caller falls back to
+        its own plaintext model and the outcome is counted either way.
+        """
+        info = masked_info(handle)
+        if info is None:
+            return None, self._outcome("structure")
+        committee = sorted(set(committee))
+        round = int(info.get("round", -1))
+        bits = int(info.get("bits", 0))
+        declared_n = int(info.get("n", 0))
+        if bits != Settings.PRIVACY_RING_BITS or declared_n != len(committee):
+            return None, self._outcome("structure")
+        try:
+            _, qmax, scale = self.lattice_params(declared_n)
+        except ValueError:
+            return None, self._outcome("structure")
+        dt = ring_dtype(bits)
+        present = sorted(set(handle.contributors) & set(committee))
+        missing = sorted(set(committee) - set(present))
+        if not present:
+            return None, self._outcome("structure")
+        anchors = [
+            np.ascontiguousarray(a, np.float32) for a in anchor_leaves
+        ]
+        shapes = [tuple(a.shape) for a in anchors]
+        dtypes = [a.dtype for a in anchors]
+        supports = self.supports(round, shapes, dtypes)
+        lattices = [np.asarray(a) for a in handle.get_parameters()]
+        masked_supports = [s for s in supports if s is not None]
+        if len(lattices) != len(masked_supports) or any(
+            l.dtype != dt or l.shape != (s.size,)
+            for l, s in zip(lattices, masked_supports)
+        ):
+            return None, self._outcome("structure")
+        # Subtract the uncancelled shares of every (present, missing) pair:
+        # our own pair secrets cover pairs involving us, survivors' repair
+        # reveals cover the rest. Any still-unknown secret aborts — an
+        # uncancelled mask share is uniform ring noise, not an aggregate.
+        corrections: List[Tuple[bytes, str, str]] = []
+        with self._lock:
+            for i_addr in present:
+                for d_addr in missing:
+                    if i_addr == self.addr:
+                        sec = self.masker.pair_secret(d_addr) if self.masker.knows(d_addr) else None
+                    else:
+                        sec = self._repairs.get((round, i_addr, d_addr))
+                    if sec is None:
+                        log.warning(
+                            "(%s) masked round %s: no repair share for pair "
+                            "(%s, %s) — falling back to plaintext",
+                            self.addr, round, i_addr, d_addr,
+                        )
+                        return None, self._outcome("unrepaired")
+                    corrections.append((sec, i_addr, d_addr))
+        out: List[np.ndarray] = []
+        li = 0
+        n = len(present)
+        for i, anchor in enumerate(anchors):
+            idx = supports[i]
+            if idx is None:
+                out.append(anchor.astype(dtypes[i], copy=True))
+                continue
+            lat = lattices[li].copy()
+            for sec, i_addr, d_addr in corrections:
+                lat = (
+                    lat - signed_share(sec, i_addr, d_addr, round, i, idx.size, bits)
+                ).astype(dt)
+            li += 1
+            t = center_ring(lat, bits)
+            # Committee-side range check: an honest sum of |q| <= qmax over
+            # n members is bounded; beyond it a mask share failed to cancel
+            # (hostile frame, wrong pair secret) — reject before the values
+            # can touch the model or the next round's anchor.
+            bound = int(n * qmax * Settings.PRIVACY_RANGE_MULT)
+            if t.size and int(np.abs(t).max()) > bound:
+                log.warning(
+                    "(%s) masked round %s: lattice sum out of range "
+                    "(|t|max=%d > %d) — rejecting the masked aggregate",
+                    self.addr, round, int(np.abs(t).max()), bound,
+                )
+                return None, self._outcome("range")
+            vbar = (t.astype(np.float64) * float(scale) / n).astype(np.float32)
+            flat = anchor.reshape(-1).astype(np.float32, copy=True)
+            flat[idx] = flat[idx] + vbar
+            out.append(flat.reshape(shapes[i]).astype(dtypes[i]))
+        if corrections:
+            _REPAIRS.labels(self.addr, "applied").inc(len(corrections))
+        from p2pfl_tpu.telemetry.ledger import LEDGERS
+
+        if LEDGERS.enabled():
+            LEDGERS.get(self.addr).emit(
+                "privacy_masked",
+                round=round,
+                dedup_key=("privacy_masked", round),
+                members=present,
+                repaired=missing,
+            )
+        return out, self._outcome("ok")
+
+    def _outcome(self, outcome: str) -> str:
+        _MASKED_ROUNDS.labels(self.addr, outcome).inc()
+        return outcome
+
+    # --- recovery journal (PR 10 NodeJournal) --------------------------------
+
+    def export_state(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"masker": self.masker.export_state()}
+
+    def import_state(self, st: Dict[str, Any]) -> None:
+        masker = (st or {}).get("masker")
+        if not masker:
+            return
+        with self._lock:
+            try:
+                self.masker = PairwiseMasker.import_state(self.addr, masker)
+            except (KeyError, TypeError, ValueError):
+                log.warning(
+                    "(%s) journaled privacy key material unreadable — "
+                    "minting a fresh session keypair", self.addr,
+                )
+
+    def reset(self) -> None:
+        with self._lock:
+            self._residual = None
+            self._repairs.clear()
+            self._repairs_sent.clear()
+
+
+__all__ = [
+    "MASKED_INFO_KEY",
+    "MASKED_META_KEY",
+    "PrivacyPlane",
+    "masked_info",
+]

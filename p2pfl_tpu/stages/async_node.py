@@ -112,6 +112,21 @@ class AsyncStartStage(Stage):
         # Lagging peers' sparse frames must stay decodable: windows advance
         # per node, so keep a few anchors instead of sync's single one.
         state.wire.anchor_history = Settings.ASYNC_ANCHOR_HISTORY
+        if Settings.PRIVACY_SECAGG:
+            # Pairwise masks need a round-scoped committee whose members all
+            # fold into ONE sum; async windows fold dynamic, per-node
+            # subsets, so mask cancellation has no place to happen. This is
+            # Papaya's production split exactly: buffered async aggregation
+            # pairs with CLIENT-side DP (clipping + noise at the sender,
+            # which this scheduler keeps — the budget ledger and epsilon
+            # digest ride every async fit), while committee masking runs on
+            # the sync scheduler. Warn once, proceed unmasked.
+            log.warning(
+                "%s: PRIVACY_SECAGG is sync-only — async windows run the DP "
+                "half of the privacy plane (clipping-at-sender + noise + "
+                "budget ledger), contributions ride the wire unmasked",
+                node.addr,
+            )
         if (state.round or 0) > 0:
             # Mid-experiment joiner: wait for the catch-up model.
             deadline = time.time() + Settings.VOTE_TIMEOUT

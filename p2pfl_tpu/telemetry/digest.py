@@ -101,6 +101,11 @@ class HealthDigest:
     rejections: Dict[str, float] = field(default_factory=dict)  # reason -> n
     rejected_by_source: Dict[str, float] = field(default_factory=dict)
     faults_seen: float = 0.0  # chaos faults injected at this node's sends
+    # Privacy plane: cumulative (epsilon, PRIVACY_DELTA)-DP spend of this
+    # node's training. -1 = no valid DP claim (noise off / non-private
+    # steps — JSON cannot carry inf); 0 = nothing released yet. Absent on
+    # pre-privacy (older) peers — always tolerated.
+    dp_epsilon: float = 0.0
     # Device.
     mem_bytes: float = 0.0
     # Distribution sketches (v2+): name -> QuantileSketch wire dict, plus
@@ -163,7 +168,7 @@ def decode(payload: str) -> Optional["HealthDigest"]:
         ("steps_per_s", float), ("jit_compile_s", float),
         ("tx_bytes", float), ("rx_bytes", float), ("queue_depth", float),
         ("agg_waits", int), ("agg_wait_s", float), ("contributors", float),
-        ("faults_seen", float), ("mem_bytes", float),
+        ("faults_seen", float), ("mem_bytes", float), ("dp_epsilon", float),
     ):
         v = raw.get(name)
         if v is None:
@@ -288,6 +293,7 @@ def collect(addr: str, state: Any = None) -> HealthDigest:
         dig.rejected_by_source = by_source
         dig.staleness = _gauge_value("p2pfl_async_staleness", addr)
         dig.faults_seen = float(_series_sum("p2pfl_chaos_faults_total", addr))
+        dig.dp_epsilon = _gauge_value("p2pfl_privacy_epsilon", addr)
         dig.mem_bytes = device_mem_bytes()
         # v2: the node's distribution sketches (step-time, staleness,
         # update-norm, agg-wait) + distinct-contributor estimator, wire

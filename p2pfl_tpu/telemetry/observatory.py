@@ -593,6 +593,7 @@ class Observatory:
                 "rejections": dict(d.rejections),
                 "rejected_by_source": dict(d.rejected_by_source),
                 "faults_seen": d.faults_seen,
+                "dp_epsilon": d.dp_epsilon,
                 "mem_bytes": d.mem_bytes,
                 "scores": scores.get(d.node, {}),
             }

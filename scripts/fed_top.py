@@ -106,8 +106,8 @@ def render(
         title += f" ({len(peers)} tracked + {fleet['overflow_peers']} sketch-folded)"
     header = (
         f"{'PEER':<23} {'ROUND':>7} {'STAGE':<22} {'STEP/S':>8} "
-        f"{'TX MiB':>8} {'RX MiB':>8} {'STALE':>6} {'STRAG':>7} {'SUSP':>7} "
-        f"{'LINK':>6} {'AGE s':>6}"
+        f"{'TX MiB':>8} {'RX MiB':>8} {'STALE':>6} {'EPS':>6} {'STRAG':>7} "
+        f"{'SUSP':>7} {'LINK':>6} {'AGE s':>6}"
     )
     lines = [
         paint(_BOLD, title),
@@ -129,11 +129,16 @@ def render(
         stale = p.get("staleness_p90")
         if stale is None:
             stale = p.get("staleness", 0.0)
+        # Privacy budget: cumulative DP epsilon. "-" = nothing reported,
+        # "inf" = -1 sentinel (non-private steps void the claim).
+        eps = p.get("dp_epsilon", 0.0)
+        eps_s = "-" if not eps else ("inf" if eps < 0 else f"{eps:.2f}")
         row = (
             f"{_short(addr):<23} {round_s:>7} {p.get('stage') or '-':<22.22} "
             f"{p.get('steps_per_s', 0.0):>8.1f} {_mib(p.get('tx_bytes', 0.0)):>8} "
             f"{_mib(p.get('rx_bytes', 0.0)):>8} "
             f"{(f'{stale:.1f}' if stale else '-'):>6} "
+            f"{eps_s:>6} "
             f"{s.get('straggler', 0.0):>7.2f} "
             f"{s.get('suspect', 0.0):>7.1f} {s.get('link', 0.0):>6.1f} "
             f"{s.get('age_s', 0.0):>6.1f}"

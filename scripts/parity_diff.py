@@ -50,6 +50,7 @@ KIND_RANK = {
     "chaos_fault": 1,
     "membership": 2,
     "admission_rejected": 3,
+    "privacy_masked": 3,
     "contribution_folded": 4,
     "aggregate_committed": 5,
     "window_close": 6,

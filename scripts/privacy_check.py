@@ -1,0 +1,232 @@
+"""CI gate: privacy plane (committee secure aggregation + DP budget).
+
+One tiny 3-node MNIST federation runs three legs:
+
+* **plaintext** — the PR 12 sparse wire (top-k int8), no masking;
+* **masked** — ``PRIVACY_SECAGG``: pairwise-masked lattice frames on the
+  shared rand-k support, DP-SGD clipping+noise in the learner;
+* **dropout** — same masked shape, but one committee member (chosen by the
+  seeded ``CHAOS.plan_masker_dropout`` trace) is crashed MID-round-1;
+  survivors must repair the uncancelled mask shares and finish.
+
+Asserts (exit 0 when all pass; nonzero with a reason on stderr):
+
+1. the masked run's accuracy lands within ``ACC_TOL`` of plaintext (the EF
+   residual absorbs lattice + rand-k error within a few rounds),
+2. one masker killed mid-round does not corrupt the aggregate — survivors
+   finish with sane accuracy, repairs counted (``privacy_repair``),
+3. the DP budget is live: every node reports a NONZERO epsilon through the
+   budget ledger (and hence the digest/fed_top surface).
+
+Fast, CPU-only, tier-1-safe — invoked by ``make privacy-check``.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import time  # noqa: E402
+
+ROUNDS = 6  # EF needs a few rounds to repay rand-k + lattice error
+# Dropout legs run longer: losing a masker mid-round forks the survivors'
+# contributor sets for that round (both costs are honest — plaintext pays
+# the same under timeout partials), and the refederation needs a few more
+# rounds to contract the fork on this tiny problem.
+DROPOUT_ROUNDS = 8
+ACC_TOL = 0.1
+LEG_BUDGET_S = 150.0
+KILL_ROUND = 1
+
+
+def main() -> int:
+    from p2pfl_tpu.chaos import CHAOS
+    from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+    from p2pfl_tpu.config import Settings
+    from p2pfl_tpu.learning.dataset import (
+        RandomIIDPartitionStrategy,
+        synthetic_mnist,
+    )
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.node import Node
+    from p2pfl_tpu.privacy import BUDGETS, wire_epsilon
+    from p2pfl_tpu.telemetry import REGISTRY, TRACER
+    from p2pfl_tpu.utils.utils import set_test_settings, wait_convergence
+
+    set_test_settings()
+    Settings.RESOURCE_MONITOR_PERIOD = 0
+    Settings.LOG_LEVEL = "WARNING"
+    Settings.TRAIN_SET_SIZE = 3  # full committee: every node masks
+    Settings.EXECUTOR_MAX_WORKERS = 0
+    Settings.PRIVACY_KEY_WAIT_S = 8.0
+
+    n = 3
+    data = synthetic_mnist(n_train=128 * n, n_test=256)
+    parts = data.generate_partitions(n, RandomIIDPartitionStrategy)
+
+    def run_leg(name, secagg, dp, kill_victim=None, rounds=ROUNDS):
+        REGISTRY.reset()
+        TRACER.reset()
+        BUDGETS.reset()
+        CHAOS.reset()
+        Settings.WIRE_COMPRESSION = "topk"
+        Settings.WIRE_TOPK_RATIO = 0.1
+        Settings.WIRE_TOPK_VALUES = "int8"
+        Settings.PRIVACY_SECAGG = secagg
+        # DP parameters sized for the gate, not for a privacy claim: the
+        # assertion here is that the MECHANISM runs end to end (clipped
+        # per-example grads, Gaussian noise, nonzero finite epsilon through
+        # the budget ledger) without sinking this tiny model — the epsilon
+        # such a sigma buys is large and honestly reported as such.
+        Settings.PRIVACY_DP_CLIP = 8.0 if dp else 0.0
+        Settings.PRIVACY_DP_SIGMA = 0.005 if dp else 0.0
+        nodes = [Node(mlp_model(seed=i), parts[i], batch_size=32) for i in range(n)]
+        victim = None
+        try:
+            for nd in nodes:
+                nd.start()
+            for i in range(1, n):
+                nodes[i].connect(nodes[0].addr)
+            wait_convergence(nodes, n - 1, wait=15)
+            if kill_victim is not None:
+                trace = CHAOS.plan_masker_dropout(
+                    rounds, [nd.addr for nd in nodes], seed=7, drop_round=KILL_ROUND
+                )
+                victim = next(nd for nd in nodes if nd.addr == trace[0].node)
+            nodes[0].set_start_learning(rounds=rounds, epochs=1)
+            killed = False
+            deadline = time.monotonic() + LEG_BUDGET_S
+            while time.monotonic() < deadline:
+                if victim is not None and not killed:
+                    if (victim.state.round or 0) >= KILL_ROUND:
+                        time.sleep(0.3)  # mid-round: keys exchanged, gossip live
+                        victim.crash()
+                        CHAOS.recovery(victim.addr, "crash")
+                        killed = True
+                survivors = [nd for nd in nodes if nd is not victim or not killed]
+                if all(
+                    not nd.learning_in_progress()
+                    and nd.learning_workflow is not None
+                    for nd in survivors
+                ):
+                    break
+                time.sleep(0.1)
+            else:
+                print(f"FAIL: {name} leg did not finish in budget", file=sys.stderr)
+                return None
+            survivors = [nd for nd in nodes if nd is not victim or not killed]
+            accs = [nd.learner.evaluate().get("test_acc", 0.0) for nd in survivors]
+            eps = [wire_epsilon(BUDGETS.epsilon(nd.addr)) for nd in survivors]
+            repairs = 0
+            fam = REGISTRY.get("p2pfl_privacy_repairs_total")
+            if fam is not None:
+                repairs = sum(
+                    int(c.value)
+                    for lbl, c in fam.samples()
+                    if lbl.get("role") == "applied"
+                )
+            return {
+                "acc": sum(accs) / len(accs),
+                "accs": accs,
+                "eps": eps,
+                "repairs": repairs,
+                "killed": killed,
+            }
+        finally:
+            for nd in nodes:
+                try:
+                    nd.stop()
+                except Exception:  # noqa: BLE001 — crashed victim
+                    pass
+            InMemoryRegistry.reset()
+            CHAOS.reset()
+
+    print("privacy-check: plaintext leg...", file=sys.stderr)
+    plain = run_leg("plaintext", secagg=False, dp=False)
+    if plain is None:
+        return 1
+    print(
+        f"privacy-check: plaintext acc {plain['acc']:.3f} — masked leg...",
+        file=sys.stderr,
+    )
+    masked = run_leg("masked", secagg=True, dp=True)
+    if masked is None:
+        return 1
+    print(
+        f"privacy-check: masked acc {masked['acc']:.3f} eps {masked['eps']} — "
+        "dropout leg...",
+        file=sys.stderr,
+    )
+    dropout = run_leg(
+        "dropout", secagg=True, dp=True, kill_victim=True, rounds=DROPOUT_ROUNDS
+    )
+    if dropout is None:
+        return 1
+    print(
+        f"privacy-check: masked dropout acc {dropout['acc']:.3f} — plaintext "
+        "dropout reference leg...",
+        file=sys.stderr,
+    )
+    # The fair comparator for "did the dead masker poison the sum": the SAME
+    # kill on the plaintext wire — losing a third of the data degrades any
+    # run; corruption would crater far below that reference.
+    dropout_ref = run_leg(
+        "dropout-ref", secagg=False, dp=False, kill_victim=True,
+        rounds=DROPOUT_ROUNDS,
+    )
+    if dropout_ref is None:
+        return 1
+
+    # 1. masked accuracy parity with plaintext.
+    if masked["acc"] < plain["acc"] - ACC_TOL:
+        print(
+            f"FAIL: masked accuracy {masked['acc']:.3f} fell more than "
+            f"{ACC_TOL} below plaintext {plain['acc']:.3f}",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"PASS: masked acc {masked['acc']:.3f} vs plaintext {plain['acc']:.3f}",
+        file=sys.stderr,
+    )
+
+    # 2. masker dropout: survivors finish, aggregate not corrupted, repairs
+    # actually flowed.
+    if not dropout["killed"] or not dropout_ref["killed"]:
+        print("FAIL: a dropout leg never killed its masker", file=sys.stderr)
+        return 1
+    if dropout["acc"] < dropout_ref["acc"] - 2 * ACC_TOL:
+        print(
+            f"FAIL: masked dropout accuracy {dropout['acc']:.3f} collapsed "
+            f"below the plaintext same-kill reference {dropout_ref['acc']:.3f} "
+            "— the dead masker poisoned the sum",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"PASS: one masker killed mid-round-{KILL_ROUND}; survivors at "
+        f"{dropout['acc']:.3f} vs plaintext same-kill {dropout_ref['acc']:.3f} "
+        f"(mask repairs applied: {dropout['repairs']})",
+        file=sys.stderr,
+    )
+
+    # 3. epsilon nonzero on every node of the DP legs.
+    for leg, name in ((masked, "masked"), (dropout, "dropout")):
+        bad = [e for e in leg["eps"] if not e > 0]
+        if bad:
+            print(
+                f"FAIL: {name} leg reported non-positive epsilon(s): {leg['eps']}",
+                file=sys.stderr,
+            )
+            return 1
+    print(f"PASS: epsilon nonzero on every node ({masked['eps']})", file=sys.stderr)
+    print("privacy-check PASSED", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

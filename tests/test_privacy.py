@@ -1,0 +1,590 @@
+"""Privacy plane tests: mask algebra, bit-exactness, dropout repair,
+hostile frames, the RDP accountant, and the wire-overhead bound.
+
+The load-bearing claims:
+
+* pairwise masks cancel EXACTLY (modular integer arithmetic) in any merge
+  order — masked FedAvg is bit-exact with the identical pipeline run
+  maskless at zero dropout;
+* a dead masker's uncancelled shares are reconstructible from journaled /
+  revealed pair secrets, so a crash mid-round cannot poison the sum;
+* hostile masked frames die as counted structural rejections BEFORE any
+  lattice value reaches the aggregator or the anchor;
+* the (previously dead) accountant in ``learning/privacy.py`` is wired,
+  monotone, and honest about voided guarantees;
+* a masked frame costs at most 1.15x the PR 12 topk+quant frame bytes for
+  the same tensors (the shared support ships zero index bytes).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.comm.admission import AdmissionController
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.learning.aggregators.masked import MaskedFedAvg
+from p2pfl_tpu.learning.privacy import (
+    dp_sgd_privacy_spent,
+    gaussian_rdp_epsilon,
+    resolve_seed,
+)
+from p2pfl_tpu.models.model_handle import ModelHandle
+from p2pfl_tpu.privacy import (
+    BUDGETS,
+    PairwiseMasker,
+    PrivacyPlane,
+    lattice_qmax,
+    ring_dtype,
+    shared_support,
+    signed_share,
+    wire_epsilon,
+)
+from p2pfl_tpu.telemetry import REGISTRY
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    REGISTRY.reset()
+    BUDGETS.reset()
+    yield
+    REGISTRY.reset()
+    BUDGETS.reset()
+
+
+def _federation(n=3, round=2, seed=0):
+    """n planes with exchanged keys + n toy models around a shared anchor."""
+    addrs = [f"n{i}" for i in range(n)]
+    planes = {a: PrivacyPlane(a) for a in addrs}
+    for a in addrs:
+        for b in addrs:
+            if a != b:
+                assert planes[a].learn_key(b, planes[b].masker.public_key_hex())
+    rng = np.random.default_rng(seed)
+    anchor = [
+        rng.normal(size=(24, 6)).astype(np.float32),
+        rng.normal(size=(11,)).astype(np.float32),
+    ]
+    models = {
+        a: ModelHandle(
+            params=[
+                x + rng.normal(scale=1e-3, size=x.shape).astype(np.float32)
+                for x in anchor
+            ],
+            contributors=[a],
+            num_samples=10 + i,
+        )
+        for i, a in enumerate(addrs)
+    }
+    return addrs, planes, anchor, models, round
+
+
+# --- mask algebra -------------------------------------------------------------
+
+
+def test_pair_secrets_symmetric_and_distinct():
+    a, b, c = PairwiseMasker("a"), PairwiseMasker("b"), PairwiseMasker("c")
+    for x, y in ((a, b), (a, c), (b, c)):
+        assert x.learn_key(y.addr, y.public_key_hex())
+        assert y.learn_key(x.addr, x.public_key_hex())
+    assert a.pair_secret("b") == b.pair_secret("a")
+    assert a.pair_secret("c") == c.pair_secret("a")
+    assert a.pair_secret("b") != a.pair_secret("c")
+
+
+def test_hostile_pubkeys_rejected():
+    m = PairwiseMasker("a")
+    assert not m.learn_key("b", "zz-not-hex")
+    assert not m.learn_key("b", "0")  # out of group range
+    assert not m.learn_key("b", "1")
+    assert not m.learn_key("a", PairwiseMasker("x").public_key_hex())  # self
+
+
+def test_total_masks_cancel_over_committee():
+    addrs, planes, _, _, r = _federation(4)
+    bits = Settings.PRIVACY_RING_BITS
+    for tensor_idx, k in ((0, 31), (1, 7)):
+        acc = np.zeros(k, ring_dtype(bits))
+        for a in addrs:
+            acc = acc + planes[a].masker.total_mask(addrs, r, tensor_idx, k, bits)
+        assert not acc.any()
+
+
+def test_signed_share_pair_sums_to_zero():
+    a, b = PairwiseMasker("a"), PairwiseMasker("b")
+    a.learn_key("b", b.public_key_hex())
+    b.learn_key("a", a.public_key_hex())
+    sec = a.pair_secret("b")
+    bits = Settings.PRIVACY_RING_BITS
+    s_ab = signed_share(sec, "a", "b", 5, 0, 16, bits)
+    s_ba = signed_share(sec, "b", "a", 5, 0, 16, bits)
+    assert not (s_ab + s_ba).any()
+    # distinct streams per round and tensor
+    assert not np.array_equal(s_ab, signed_share(sec, "a", "b", 6, 0, 16, bits))
+    assert not np.array_equal(s_ab, signed_share(sec, "a", "b", 5, 1, 16, bits))
+
+
+def test_shared_support_deterministic_sorted_bounded():
+    idx = shared_support(3, 0, 1000, 0.1)
+    assert np.array_equal(idx, shared_support(3, 0, 1000, 0.1))
+    assert idx.size == 100 and (np.diff(idx) > 0).all()
+    assert 0 <= idx[0] and idx[-1] < 1000
+    assert not np.array_equal(idx, shared_support(4, 0, 1000, 0.1))
+    assert shared_support(3, 0, 3, 0.1).size == 1  # floor of one value
+
+
+def test_lattice_qmax_bounds():
+    from p2pfl_tpu.privacy.masking import LATTICE_HEADROOM
+
+    assert lattice_qmax(16, 3) == 32767 // (3 * LATTICE_HEADROOM)
+    # honest worst-case sum stays range-checkable inside the signed half
+    assert 3 * lattice_qmax(16, 3) * LATTICE_HEADROOM <= (1 << 15) - 1
+    with pytest.raises(ValueError):
+        lattice_qmax(16, 40000)  # qmax < 1
+
+
+def test_pack_ring_roundtrip_all_widths():
+    from p2pfl_tpu.privacy.masking import pack_ring, unpack_ring
+
+    rng = np.random.default_rng(3)
+    for bits in (12, 16, 32):
+        for k in (1, 2, 7, 64):
+            v = rng.integers(0, 1 << bits, size=k, dtype=np.uint64).astype(
+                ring_dtype(bits)
+            )
+            packed = pack_ring(v, bits)
+            assert packed.dtype == np.uint8
+            if bits == 12:
+                assert packed.size == 3 * ((k + 1) // 2)  # 1.5 B/value
+            assert np.array_equal(unpack_ring(packed, k, bits), v)
+    # unreduced mod-2**16 carrier reduces on pack (ring consistency)
+    v = np.array([4096 + 5, 65535], np.uint16)
+    assert np.array_equal(
+        unpack_ring(pack_ring(v, 12), 2, 12), np.array([5, 4095], np.uint16)
+    )
+    with pytest.raises(ValueError):
+        unpack_ring(np.zeros(4, np.uint8), 2, 12)  # wrong plane length
+    with pytest.raises(ValueError):
+        unpack_ring(np.zeros(3, np.uint8), 4, 12)
+
+
+def test_hostile_packed_frame_dies_as_value_error():
+    """A frame whose packed planes disagree with the declared ks must raise
+    in parse_frame — the command handler surfaces that as a counted
+    ``corrupt`` rejection before any value enters a lattice sum."""
+    addrs, planes, anchor, models, r = _federation(2)
+    handle = planes[addrs[0]].mask_own(models[addrs[0]], anchor, r, addrs)
+    blob = PrivacyPlane.encode_frame(handle)
+    from p2pfl_tpu.ops.serialization import deserialize_arrays
+
+    arrays, meta = deserialize_arrays(bytes(blob))
+    assert PrivacyPlane.is_masked_frame(meta)
+    lat = PrivacyPlane.parse_frame(arrays, meta)
+    for x, y in zip(lat, handle.get_parameters()):
+        ring = 1 << Settings.PRIVACY_RING_BITS
+        assert np.array_equal(x, (np.asarray(y).astype(np.uint32) % ring).astype(x.dtype))
+    with pytest.raises(ValueError):
+        PrivacyPlane.parse_frame(arrays[:-1], meta)  # tensor count
+    with pytest.raises(ValueError):
+        PrivacyPlane.parse_frame(
+            [np.zeros(2, np.uint8)] * len(arrays), meta
+        )  # plane length
+    bad_meta = {**meta, "__masked__": {**meta["__masked__"], "bits": 13}}
+    with pytest.raises(ValueError):
+        PrivacyPlane.parse_frame(arrays, bad_meta)  # unknown ring
+
+
+# --- bit-exactness & merge-order independence ---------------------------------
+
+
+def _encode_all(planes, models, anchor, addrs, r, mask):
+    handles = []
+    for a in addrs:
+        planes[a].reset()
+        handles.append(planes[a].mask_own(models[a], anchor, r, addrs, mask=mask))
+    return handles
+
+
+def test_masked_bitexact_with_maskless_and_merge_order_independent():
+    addrs, planes, anchor, models, r = _federation(3)
+    agg = MaskedFedAvg()
+    agg.set_addr(addrs[0])
+
+    def finalized(mask, order):
+        handles = _encode_all(planes, models, anchor, addrs, r, mask)
+        merged = agg.aggregate([handles[i] for i in order])
+        out, outcome = planes[addrs[0]].finalize(merged, addrs, anchor)
+        assert outcome == "ok"
+        return out
+
+    base = finalized(True, [0, 1, 2])
+    for order in ([2, 1, 0], [1, 0, 2]):
+        again = finalized(True, order)
+        for x, y in zip(base, again):
+            assert np.array_equal(x, y)
+    plain = finalized(False, [0, 1, 2])
+    for x, y in zip(base, plain):
+        assert np.array_equal(x, y)  # bit-exact, not allclose
+
+
+def test_masked_aggregate_tracks_true_mean():
+    addrs, planes, anchor, models, r = _federation(3)
+    agg = MaskedFedAvg()
+    agg.set_addr(addrs[0])
+    handles = _encode_all(planes, models, anchor, addrs, r, True)
+    out, outcome = planes[addrs[0]].finalize(agg.aggregate(handles), addrs, anchor)
+    assert outcome == "ok"
+    true_mean = [
+        anchor[i]
+        + np.mean(
+            [np.asarray(models[a].params[i]) - anchor[i] for a in addrs], axis=0
+        )
+        for i in range(len(anchor))
+    ]
+    # rand-k support covers ~10% per round; ON the support the lattice is
+    # within a quantization step of the true mean, OFF it the anchor holds.
+    for i, (got, want) in enumerate(zip(out, true_mean)):
+        idx = shared_support(r, i, got.size, Settings.PRIVACY_MASK_RATIO)
+        _, qmax, scale = PrivacyPlane.lattice_params(len(addrs))
+        got_f, want_f, anc_f = (
+            got.reshape(-1), want.reshape(-1), anchor[i].reshape(-1)
+        )
+        assert np.abs(got_f[idx] - want_f[idx]).max() <= scale
+        off = np.setdiff1d(np.arange(got_f.size), idx)
+        assert np.array_equal(got_f[off], anc_f[off])
+
+
+def test_error_feedback_carries_untransmitted_mass():
+    addrs, planes, anchor, models, r = _federation(2)
+    p = planes[addrs[0]]
+    p.mask_own(models[addrs[0]], anchor, r, addrs)
+    delta0 = np.asarray(models[addrs[0]].params[0]).reshape(-1) - anchor[
+        0
+    ].reshape(-1)
+    resid = p._residual[0]
+    idx = shared_support(r, 0, delta0.size, Settings.PRIVACY_MASK_RATIO)
+    off = np.setdiff1d(np.arange(delta0.size), idx)
+    # off-support: the full delta is retained for a later round
+    assert np.allclose(resid[off], delta0[off])
+    # on-support: only the (bounded) lattice error remains
+    _, qmax, scale = PrivacyPlane.lattice_params(len(addrs))
+    assert np.abs(resid[idx]).max() <= 0.5 * scale + 1e-7
+
+
+# --- dropout recovery ---------------------------------------------------------
+
+
+def test_dropout_repair_via_revealed_secrets():
+    addrs, planes, anchor, models, r = _federation(3)
+    agg = MaskedFedAvg()
+    agg.set_addr(addrs[0])
+    handles = _encode_all(planes, models, anchor, addrs, r, True)
+    dead = addrs[2]
+    merged = agg.aggregate(handles[:2])  # dead masker's frame never arrived
+    # Unrepaired: the observer knows its OWN pair with the dead peer but not
+    # the other survivor's — finalize must refuse, not emit ring noise.
+    out, outcome = planes[addrs[0]].finalize(merged, addrs, anchor)
+    assert out is None and outcome == "unrepaired"
+    # The other survivor reveals; finalize succeeds and equals the maskless
+    # 2-contributor sum under the SAME declared committee of 3.
+    sec = planes[addrs[1]].repair_secrets_for(dead, r)
+    assert sec is not None
+    assert planes[addrs[0]].note_repair(r, addrs[1], dead, sec)
+    out, outcome = planes[addrs[0]].finalize(merged, addrs, anchor)
+    assert outcome == "ok"
+    # Reference: the maskless 2-contributor lattice sum decoded with the
+    # SAME float ops finalize uses (the maskless frames' lattices ARE the
+    # raw q grids, so this is the ground truth the repair must recover).
+    from p2pfl_tpu.privacy.masking import center_ring
+
+    plain = _encode_all(planes, models, anchor, addrs, r, False)
+    plain_merged = agg.aggregate(plain[:2])
+    bits = Settings.PRIVACY_RING_BITS
+    _, _, scale = PrivacyPlane.lattice_params(len(addrs))
+    for i, (got, anc) in enumerate(zip(out, anchor)):
+        idx = shared_support(r, i, anc.size, Settings.PRIVACY_MASK_RATIO)
+        t = center_ring(np.asarray(plain_merged.get_parameters()[i]), bits)
+        vbar = (t.astype(np.float64) * float(scale) / 2).astype(np.float32)
+        ref = anc.reshape(-1).astype(np.float32, copy=True)
+        ref[idx] = ref[idx] + vbar
+        assert np.array_equal(got.reshape(-1), ref)
+
+
+def test_dropout_repair_via_journaled_seeds():
+    """A crash-RESTARTED masker re-derives identical masks from journaled
+    key material (export/import round-trip) — its re-sent frame cancels
+    exactly like the lost one."""
+    addrs, planes, anchor, models, r = _federation(3)
+    p = planes[addrs[0]]
+    resurrected = PrivacyPlane(addrs[0])
+    resurrected.import_state(p.export_state())
+    bits = Settings.PRIVACY_RING_BITS
+    before = p.masker.total_mask(addrs, r, 0, 17, bits)
+    after = resurrected.masker.total_mask(addrs, r, 0, 17, bits)
+    assert np.array_equal(before, after)
+    assert resurrected.masker.pair_secret(addrs[1]) == p.masker.pair_secret(addrs[1])
+
+
+def test_repair_reveal_once_and_hostile_repairs_dropped():
+    addrs, planes, _, _, r = _federation(2)
+    p = planes[addrs[0]]
+    assert p.repair_secrets_for("ghost", r) is None  # unknown peer: nothing
+    sec = p.repair_secrets_for(addrs[1], r)
+    assert sec is not None
+    assert p.repair_secrets_for(addrs[1], r) is None  # dedup per (round, dead)
+    q = planes[addrs[1]]
+    assert not q.note_repair(r, "s", "s", "ab" * 32)  # survivor == dead
+    assert not q.note_repair(r, "s", "d", "zz")  # not hex
+    assert not q.note_repair(r, "s", "d", "ab" * 8)  # wrong length
+
+
+# --- hostile masked frames ----------------------------------------------------
+
+
+def _masked_meta(r=2, n=3, bits=None, ks=(10,)):
+    return {
+        "round": r,
+        "bits": Settings.PRIVACY_RING_BITS if bits is None else bits,
+        "n": n,
+        "ks": list(ks),
+    }
+
+
+def test_hostile_masked_frames_rejected_and_counted():
+    adm = AdmissionController("t0")
+    committee = ["a", "b", "c"]
+    dt = ring_dtype(Settings.PRIVACY_RING_BITS)
+    good = [np.zeros(10, dt)]
+
+    def rejected(reason, **kw):
+        before = adm.rejected_count(reason)
+        args = {
+            "arrays": good,
+            "info": _masked_meta(),
+            "committee": committee,
+            "contributors": ["a"],
+            "expected_ks": [10],
+            "source": "evil",
+        }
+        args.update(kw)
+        got = adm.screen_masked(**args)
+        assert got == reason
+        assert adm.rejected_count(reason) == before + 1
+
+    rejected("masked_structure", info=None)
+    rejected("masked_structure", info={"round": "x"})
+    rejected("masked_structure", info=_masked_meta(bits=8))  # wrong ring
+    rejected("masked_structure", info=_masked_meta(n=2))  # committee mismatch
+    rejected("masked_member", contributors=["outsider"])
+    rejected("masked_member", contributors=[])
+    rejected("masked_structure", arrays=[np.zeros(9, dt)])  # short plane
+    rejected("masked_structure", arrays=[np.zeros(10, np.float32)])  # not ring
+    rejected("masked_structure", arrays=[])  # tensor count
+    # the clean frame passes
+    assert (
+        adm.screen_masked(
+            good,
+            _masked_meta(),
+            committee=committee,
+            contributors=["a"],
+            expected_ks=[10],
+            source="honest",
+        )
+        is None
+    )
+
+
+def test_range_check_rejects_wrapped_sum_before_model():
+    """An unrepaired/hostile mask share is uniform ring noise — the
+    committee-side range check must reject it before any value reaches
+    model-shaped output."""
+    addrs, planes, anchor, models, r = _federation(2)
+    agg = MaskedFedAvg()
+    agg.set_addr(addrs[0])
+    handles = _encode_all(planes, models, anchor, addrs, r, True)
+    # corrupt one lattice plane with a huge constant (survives merge)
+    bad = handles[1]
+    params = [np.asarray(a).copy() for a in bad.get_parameters()]
+    params[0] = params[0] + ring_dtype(Settings.PRIVACY_RING_BITS).type(
+        3 << (Settings.PRIVACY_RING_BITS - 3)
+    )
+    hostile = ModelHandle(
+        params=params,
+        contributors=bad.contributors,
+        num_samples=bad.num_samples,
+        additional_info=dict(bad.additional_info),
+    )
+    out, outcome = planes[addrs[0]].finalize(
+        agg.aggregate([handles[0], hostile]), addrs, anchor
+    )
+    assert out is None and outcome == "range"
+
+
+def test_masked_merge_drops_plaintext_and_foreign_lattices():
+    addrs, planes, anchor, models, r = _federation(3)
+    agg = MaskedFedAvg()
+    agg.set_addr(addrs[0])
+    handles = _encode_all(planes, models, anchor, addrs, r, True)
+    merged = agg.aggregate([handles[0], models[addrs[1]], handles[2]])
+    assert sorted(merged.contributors) == [addrs[0], addrs[2]]
+    # a frame from another lattice generation (different round) is dropped
+    other = _encode_all(planes, models, anchor, addrs, r + 1, True)
+    merged2 = agg.aggregate([handles[0], other[1]])
+    assert merged2.contributors == [addrs[0]]
+
+
+# --- accountant (learning/privacy.py, now live) -------------------------------
+
+
+def test_accountant_monotonicity():
+    eps = [gaussian_rdp_epsilon(1.0, t, 1e-5) for t in (1, 10, 100, 1000)]
+    assert all(b > a for a, b in zip(eps, eps[1:]))  # more steps, more spend
+    sig = [gaussian_rdp_epsilon(s, 100, 1e-5) for s in (0.5, 1.0, 2.0, 4.0)]
+    assert all(b < a for a, b in zip(sig, sig[1:]))  # more noise, less spend
+    assert gaussian_rdp_epsilon(1.0, 100, 1e-5) < gaussian_rdp_epsilon(
+        1.0, 100, 1e-7
+    )  # tighter delta costs epsilon
+    assert gaussian_rdp_epsilon(0.0, 10, 1e-5) == math.inf
+    assert gaussian_rdp_epsilon(1.0, 0, 1e-5) == 0.0
+    with pytest.raises(ValueError):
+        gaussian_rdp_epsilon(1.0, 10, 1.5)
+
+
+def test_privacy_spent_honest_about_voided_guarantee():
+    ok = dp_sgd_privacy_spent(1.0, 1.0, 100)
+    assert 0 < ok["epsilon"] < math.inf
+    voided = dp_sgd_privacy_spent(1.0, 1.0, 100, nonprivate_steps=1)
+    assert voided["epsilon"] == math.inf
+    nothing = dp_sgd_privacy_spent(1.0, 1.0, 0)
+    assert nothing["epsilon"] == 0.0
+
+
+def test_resolve_seed_entropy_and_pinned_warning():
+    a, b = resolve_seed(None), resolve_seed(None)
+    assert a != b  # OS entropy (collision odds 2^-31)
+    assert resolve_seed(42) == 42
+    with pytest.warns(UserWarning):
+        resolve_seed(42, dp_noise_multiplier=1.0)
+
+
+def test_budget_ledger_rides_gauge_and_wire_sentinel():
+    BUDGETS.record("nA", clip_norm=1.0, noise_multiplier=1.0, dp_steps=50)
+    eps1 = BUDGETS.epsilon("nA")
+    assert 0 < eps1 < math.inf
+    BUDGETS.record("nA", clip_norm=1.0, noise_multiplier=1.0, dp_steps=50)
+    assert BUDGETS.epsilon("nA") > eps1  # composition is monotone
+    fam = REGISTRY.get("p2pfl_privacy_epsilon")
+    vals = {lbl["node"]: c.value for lbl, c in fam.samples()}
+    assert vals["nA"] == pytest.approx(BUDGETS.epsilon("nA"))
+    # non-private steps void the claim -> wire sentinel -1
+    BUDGETS.record("nA", clip_norm=0.0, noise_multiplier=0.0, nonprivate_steps=1)
+    assert BUDGETS.epsilon("nA") == math.inf
+    assert wire_epsilon(BUDGETS.epsilon("nA")) == -1.0
+    assert wire_epsilon(0.0) == 0.0 and wire_epsilon(2.5) == 2.5
+
+
+def test_digest_carries_epsilon():
+    from p2pfl_tpu.telemetry import digest as dig
+
+    BUDGETS.record("nB", clip_norm=1.0, noise_multiplier=2.0, dp_steps=10)
+    d = dig.collect("nB")
+    assert d.dp_epsilon == pytest.approx(wire_epsilon(BUDGETS.epsilon("nB")))
+    rt = dig.decode(d.encode())
+    assert rt.dp_epsilon == pytest.approx(d.dp_epsilon)
+    # absent field (older peer) tolerated
+    legacy = dig.decode('{"node":"old","v":1}')
+    assert legacy is not None and legacy.dp_epsilon == 0.0
+
+
+# --- wire overhead ------------------------------------------------------------
+
+
+def test_masked_wire_overhead_within_bound():
+    """A masked frame must cost <= 1.15x the PR 12 topk+quant frame for the
+    same model at the same ratio (acceptance: <=15% overhead). The shared
+    support ships no index bytes, which is what pays for the wider values."""
+    from p2pfl_tpu.comm.delta import DeltaWireCodec
+
+    rng = np.random.default_rng(1)
+    anchor = [
+        rng.normal(size=(128, 64)).astype(np.float32),
+        rng.normal(size=(64, 10)).astype(np.float32),
+        rng.normal(size=(10,)).astype(np.float32),
+    ]
+    model = ModelHandle(
+        params=[
+            x + rng.normal(scale=1e-3, size=x.shape).astype(np.float32)
+            for x in anchor
+        ],
+        contributors=["n0"],
+        num_samples=8,
+    )
+    addrs, planes, _, _, _ = _federation(3)
+    with Settings.overridden(
+        WIRE_COMPRESSION="topk",
+        WIRE_TOPK_RATIO=Settings.PRIVACY_MASK_RATIO,
+        WIRE_TOPK_VALUES="int8",
+        COALESCE_ENABLED=True,
+    ):
+        codec = DeltaWireCodec("n0")
+        codec.set_anchor(anchor, 2)
+        tagged = codec.encode_tagged(model, 2)
+        assert tagged is not None
+        topk_bytes = len(tagged[0])
+        masked = planes[addrs[0]].mask_own(model, anchor, 2, addrs)
+        masked_bytes = len(PrivacyPlane.encode_frame(masked))
+    assert masked_bytes <= 1.15 * topk_bytes, (masked_bytes, topk_bytes)
+
+
+# --- chaos scenario -----------------------------------------------------------
+
+
+def test_plan_masker_dropout_deterministic():
+    from p2pfl_tpu.chaos import CHAOS
+
+    nodes = [f"mem://n{i}" for i in range(5)]
+    a = CHAOS.plan_masker_dropout(4, nodes, seed=9, drop_round=1)
+    b = CHAOS.plan_masker_dropout(4, nodes, seed=9, drop_round=1)
+    assert a == b and len(a) == 1
+    assert a[0].kind == "crash" and a[0].node in nodes and a[0].when == 1
+    c = CHAOS.plan_masker_dropout(4, nodes, seed=10, drop_round=1)
+    assert c[0].node in nodes  # other seeds still pick from the committee
+    assert CHAOS.plan_masker_dropout(4, [], seed=9) == ()
+    assert CHAOS.plan_masker_dropout(2, nodes, seed=9, drop_round=5) == ()
+
+
+# --- ledger / parity exemption ------------------------------------------------
+
+
+def test_privacy_masked_kind_ranked_and_not_in_trajectory():
+    from p2pfl_tpu.telemetry.ledger import KIND_RANK, TRAJECTORY_KINDS
+
+    assert "privacy_masked" in KIND_RANK
+    # masked rounds are a wire-only fact: the fused mesh has no masks, so
+    # the kind must stay OUT of the cross-backend trajectory comparison
+    # (the codec-scoped parity exemption, docs/components/parity.md).
+    assert "privacy_masked" not in TRAJECTORY_KINDS
+
+
+def test_parity_negative_control_masked_vs_plain_hashes_differ():
+    """Negative control for the masked-aggregate parity exemption: the
+    masked pipeline's aggregate is NOT bit-identical to plaintext FedAvg
+    (unit weights + lattice), so comparing their ledgers MUST diverge —
+    which is exactly why masked runs are exempt from the parity gate."""
+    from p2pfl_tpu.learning.aggregators.fedavg import FedAvg
+    from p2pfl_tpu.telemetry.ledger import canonical_params_hash
+
+    addrs, planes, anchor, models, r = _federation(3)
+    agg = MaskedFedAvg()
+    agg.set_addr(addrs[0])
+    handles = _encode_all(planes, models, anchor, addrs, r, True)
+    out, outcome = planes[addrs[0]].finalize(agg.aggregate(handles), addrs, anchor)
+    assert outcome == "ok"
+    plain = FedAvg()
+    plain.set_addr(addrs[0])
+    ref = plain.aggregate([models[a] for a in addrs])
+    assert canonical_params_hash(out) != canonical_params_hash(
+        [np.asarray(p) for p in ref.get_parameters()]
+    )

@@ -609,14 +609,18 @@ class PrivacyKeyCommand(Command):
 class PrivacyRepairCommand(Command):
     """Mask-repair share for a dead masker (privacy plane).
 
-    ``args = [dead_addr, pair_secret_hex]``, ``round`` = the masked round
-    being repaired. Broadcast by every survivor whose pairwise mask with
-    the dead committee member would otherwise stay uncancelled in the
-    round's lattice sum; every aggregating node stores the share and
-    :meth:`PrivacyPlane.finalize` subtracts the reconstructed mask. The
-    reveal is safe exactly because the dead peer's own frame is absent from
-    the sum being repaired (when it DID arrive, the peer is a contributor
-    and no repair is applied — first wins, like full-model adoption)."""
+    ``args = [dead_addr, round_secret_hex]``, ``round`` = the masked round
+    being repaired. The payload is the survivor's ROUND-SCOPED pair secret
+    (``H(pair_secret, round)``) — never the pair secret itself, so a wire
+    capture opens exactly one round's mask streams. Broadcast by every
+    survivor whose pairwise mask with the dead committee member would
+    otherwise stay uncancelled in the round's lattice sum (withheld when
+    coverage shows the "dead" peer's frame already circulated — the
+    false-dropout gate in ``Node._on_peer_death``); every aggregating node
+    stores the share first-write-wins with both parties validated against
+    the round's committee (:meth:`PrivacyPlane.note_repair` — the claimed
+    survivor is bound to the transport source here), and
+    :meth:`PrivacyPlane.finalize` subtracts the reconstructed mask."""
 
     def __init__(self, node: "Node") -> None:
         self._node = node

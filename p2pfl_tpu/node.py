@@ -610,25 +610,45 @@ class Node:
         if shrunk and Settings.PRIVACY_SECAGG and state.round is not None:
             # Masker dropout: the dead committee member's pairwise mask
             # shares are now uncancelled in every aggregator's lattice sum.
-            # Reveal OUR pair secret with it (privacy_repair broadcast) so
-            # finalize can subtract our share; every other survivor does the
-            # same for theirs. Safe precisely because the dead peer's own
-            # frame never entered the sums being repaired (shrunk=True means
-            # its contribution had not arrived).
-            secret = state.privacy.repair_secrets_for(addr, state.round)
-            if secret is not None:
-                self.protocol.broadcast(
-                    self.protocol.build_msg(
-                        PrivacyRepairCommand.get_name(),
-                        args=[addr, secret],
-                        round=state.round,
-                    )
-                )
+            # Reveal OUR round-scoped pair secret with it (privacy_repair
+            # broadcast) so finalize can subtract our share; every other
+            # survivor does the same for theirs. shrunk=True means its
+            # contribution never entered OUR sum — but death detection is
+            # local, not fleet-consistent: under a partition or heartbeat
+            # flap another peer may already hold the "dead" node's masked
+            # frame, and whoever holds both that frame and every survivor's
+            # reveal can unmask the individual update (the false-dropout
+            # attack). So reveal only when no other peer's coverage report
+            # for this round lists the peer as merged; the residual wire-
+            # observer exposure is stated in docs/components/privacy.md.
+            held = any(
+                addr in (merged or ())
+                for peer, merged in list(state.models_aggregated.items())
+                if peer != addr
+            )
+            if held:
                 logger.warning(
                     self.addr,
-                    f"masker {addr} died mid-round {state.round}: revealed "
-                    "our pair secret for mask repair",
+                    f"masker {addr} died mid-round {state.round} but a peer "
+                    "already merged its frame — withholding the mask-repair "
+                    "reveal (round may fall back to plaintext)",
                 )
+            else:
+                secret = state.privacy.repair_secrets_for(addr, state.round)
+                if secret is not None:
+                    self.protocol.broadcast(
+                        self.protocol.build_msg(
+                            PrivacyRepairCommand.get_name(),
+                            args=[addr, secret],
+                            round=state.round,
+                        )
+                    )
+                    logger.warning(
+                        self.addr,
+                        f"masker {addr} died mid-round {state.round}: "
+                        "revealed our round-scoped pair secret for mask "
+                        "repair",
+                    )
         state.models_aggregated.pop(addr, None)
         # The retired coverage table too: an overlap drain must stop trying
         # to serve a dead laggard (its candidate filter reads this).

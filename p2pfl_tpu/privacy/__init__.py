@@ -18,6 +18,7 @@ from p2pfl_tpu.privacy.masking import (
     center_ring,
     lattice_qmax,
     ring_dtype,
+    round_secret,
     shared_support,
     signed_share,
 )
@@ -39,6 +40,7 @@ __all__ = [
     "lattice_qmax",
     "masked_info",
     "ring_dtype",
+    "round_secret",
     "shared_support",
     "signed_share",
     "wire_epsilon",

@@ -16,8 +16,15 @@ Three layers, each exactly-cancelling by construction:
   (``privacy_key``). A pair's shared secret is the SHA-256 of the DH shared
   value bound to the sorted pair, so both ends derive the same secret and
   no third party can.
-* **Per-round mask streams** — a pair's mask for ``(round, tensor)`` is a
-  PRG stream seeded from ``SHA256(pair_secret, round, tensor)``. The
+* **Per-round mask streams** — the stream KDF is two-stage:
+  ``round_secret = SHA256(pair_secret, round)`` scopes the pair secret to
+  one round, and the per-tensor stream is a PRG seeded from
+  ``SHA256(round_secret, tensor)``. The two-stage split is load-bearing
+  for dropout repair: a survivor reveals ONLY the round-scoped secret
+  (``privacy_repair``), which reconstructs the dead pair's masks for that
+  round and nothing else — a wire observer who captures every reveal of
+  round ``r`` learns nothing about any other round's streams, even when a
+  crash-restarted masker resumes with the same journaled keypair. The
   lexicographically smaller address ADDS the stream, the larger SUBTRACTS
   it, so the pair's net contribution to any sum that contains both is the
   zero vector of the ring — exactly, in integer arithmetic, not to float
@@ -189,6 +196,18 @@ def round_half_up(x: float) -> int:
     return int(np.floor(x + 0.5))
 
 
+def round_secret(pair_secret: bytes, round: int) -> bytes:
+    """Round-scoped derivation of a pair secret — the ONLY value the repair
+    path ever puts on the wire. One-way: holding ``round_secret(s, r)``
+    yields round ``r``'s mask streams and no other round's (the pair secret
+    itself never leaves the two endpoints' memory/journal)."""
+    return _sha(
+        b"p2pfl-privacy-round",
+        pair_secret,
+        int(round).to_bytes(8, "big", signed=True),
+    )
+
+
 class PairwiseMasker:
     """One node's key material + mask generator.
 
@@ -260,20 +279,24 @@ class PairwiseMasker:
 
     @staticmethod
     def stream(
-        pair_secret: bytes, round: int, tensor_idx: int, k: int, bits: int
+        round_sec: bytes, tensor_idx: int, k: int, bits: int
     ) -> np.ndarray:
-        """The pair's uniform ring-element stream for one (round, tensor):
-        both ends render the identical array from the shared secret."""
+        """The pair's uniform ring-element stream for one tensor of the
+        round baked into ``round_sec`` (:func:`round_secret`): both ends
+        render the identical array from the shared secret."""
         seed = _seed64(
             b"p2pfl-privacy-mask",
-            pair_secret,
-            int(round).to_bytes(8, "big", signed=True),
+            round_sec,
             int(tensor_idx).to_bytes(4, "big"),
         )
         rng = np.random.Generator(np.random.PCG64(seed))
         return rng.integers(0, 1 << bits, size=int(k), dtype=np.uint64).astype(
             ring_dtype(bits)
         )
+
+    def pair_round_secret(self, peer: str, round: int) -> bytes:
+        """Round-scoped pair secret with ``peer`` — the revealable form."""
+        return round_secret(self.pair_secret(peer), round)
 
     def pair_share(
         self,
@@ -291,7 +314,7 @@ class PairwiseMasker:
         to zero in the ring."""
         owner = owner or self.addr
         return signed_share(
-            self.pair_secret(peer), owner, peer, round, tensor_idx, k, bits
+            self.pair_round_secret(peer, round), owner, peer, tensor_idx, k, bits
         )
 
     def total_mask(
@@ -335,19 +358,20 @@ class PairwiseMasker:
 
 
 def signed_share(
-    pair_secret: bytes,
+    round_sec: bytes,
     owner: str,
     peer: str,
-    round: int,
     tensor_idx: int,
     k: int,
     bits: int,
 ) -> np.ndarray:
     """Render the signed mask share ``owner`` contributes for the pair
-    (owner, peer) from the bare pair secret — the repair path: a survivor
-    reveals its pair secret with a dead masker (``privacy_repair``) and any
-    aggregator reconstructs the share to subtract, without the dead peer."""
-    stream = PairwiseMasker.stream(pair_secret, round, tensor_idx, k, bits)
+    (owner, peer) from the ROUND-SCOPED secret (:func:`round_secret`) — the
+    repair path: a survivor reveals its round-scoped secret with a dead
+    masker (``privacy_repair``) and any aggregator reconstructs the share
+    to subtract, without the dead peer and without learning any other
+    round's streams."""
+    stream = PairwiseMasker.stream(round_sec, tensor_idx, k, bits)
     if owner < peer:
         return stream
     dt = ring_dtype(bits)
@@ -361,6 +385,7 @@ __all__ = [
     "lattice_qmax",
     "pack_ring",
     "ring_dtype",
+    "round_secret",
     "shared_support",
     "signed_share",
     "unpack_ring",

@@ -27,9 +27,11 @@ DP half of the plane only — see ``docs/components/privacy.md``):
    finalize catches what is not.
 5. **Finalize** (:meth:`PrivacyPlane.finalize`) — with every committee
    member present the pairwise masks have already cancelled in the merged
-   sum; for each missing masker the survivors' revealed pair secrets
-   (``privacy_repair``, journaled through the PR 10 NodeJournal on the
-   masker itself) reconstruct the uncancelled shares to subtract. The
+   sum; for each missing masker the survivors' revealed ROUND-SCOPED pair
+   secrets (``privacy_repair`` — ``H(pair_secret, round)``, never the pair
+   secret itself, so a captured reveal opens one round's streams and no
+   other's even across a journaled crash-restart) reconstruct the
+   uncancelled shares to subtract. The
    centered lattice sum is range-checked (``n * qmax`` — only a ring wrap,
    i.e. a hostile or unrepaired mask share, can exceed it), dequantized,
    averaged with UNIT weights (the DisAgg committee mean; the
@@ -58,6 +60,7 @@ from p2pfl_tpu.privacy.masking import (
     lattice_qmax,
     pack_ring,
     ring_dtype,
+    round_secret,
     shared_support,
     signed_share,
     unpack_ring,
@@ -87,9 +90,9 @@ _MASKED_ROUNDS = REGISTRY.counter(
 )
 _REPAIRS = REGISTRY.counter(
     "p2pfl_privacy_repairs_total",
-    "Mask-repair shares by role (tx = revealed own pair secret for a dead "
-    "masker, rx = stored a survivor's reveal, applied = subtracted at "
-    "finalize)",
+    "Mask-repair shares by role (tx = revealed own round-scoped pair "
+    "secret for a dead masker, rx = stored a survivor's reveal, applied = "
+    "subtracted at finalize)",
     labels=("node", "role"),
 )
 
@@ -114,10 +117,16 @@ class PrivacyPlane:
         # Error-feedback residual, float32 flat per tensor (None until the
         # first masked encode; dropped when the model structure changes).
         self._residual: Optional[List[np.ndarray]] = None
-        # (round, survivor, dead) -> pair secret revealed for repair.
+        # (round, survivor, dead) -> ROUND-SCOPED secret revealed for
+        # repair. First write wins: a later frame claiming the same pair
+        # must not displace a stored reveal (a hostile overwrite would make
+        # finalize subtract garbage and trip the range check).
         self._repairs: Dict[Tuple[int, str, str], bytes] = {}
         # rounds whose repairs we already broadcast per dead peer (dedup).
         self._repairs_sent: set = set()
+        # round -> committee the masks were generated against (registered
+        # by mask_own/finalize; validates repair claims). Bounded.
+        self._committees: Dict[int, frozenset] = {}
 
     # --- key agreement (privacy_key command) ---------------------------------
 
@@ -188,6 +197,7 @@ class PrivacyPlane:
         ``mask=True``.
         """
         committee = sorted(set(committee))
+        self.note_committee(round, committee)
         bits, qmax, scale = self.lattice_params(len(committee))
         dt = ring_dtype(bits)
         leaves = model.get_parameters()
@@ -327,9 +337,21 @@ class PrivacyPlane:
 
     # --- repairs (masker dropout) --------------------------------------------
 
+    def note_committee(self, round: int, committee: Sequence[str]) -> None:
+        """Register the committee a masked round's masks were generated
+        against (called by :meth:`mask_own` and :meth:`finalize`). Repair
+        claims for the round are validated against it; bounded to the last
+        few rounds so a long session cannot grow it."""
+        with self._lock:
+            self._committees[int(round)] = frozenset(committee)
+            while len(self._committees) > 8:
+                del self._committees[min(self._committees)]
+
     def repair_secrets_for(self, dead: str, round: int) -> Optional[str]:
-        """Hex pair secret to reveal for ``dead`` (None when unknown or
-        already revealed for this round)."""
+        """Hex ROUND-SCOPED secret (``H(pair_secret, round)``) to reveal
+        for ``dead`` — never the raw pair secret, which derives every
+        round's mask streams and must not hit the wire (None when unknown
+        or already revealed for this round)."""
         with self._lock:
             if not self.masker.knows(dead) or dead == self.addr:
                 return None
@@ -337,22 +359,37 @@ class PrivacyPlane:
             if key in self._repairs_sent:
                 return None
             self._repairs_sent.add(key)
-            sec = self.masker.pair_secret(dead)
+            sec = round_secret(self.masker.pair_secret(dead), round)
         _REPAIRS.labels(self.addr, "tx").inc()
         return sec.hex()
 
     def note_repair(
         self, round: int, survivor: str, dead: str, secret_hex: str
     ) -> bool:
-        """Store a survivor's revealed pair secret (transport thread)."""
+        """Store a survivor's revealed round-scoped secret (transport
+        thread; ``survivor`` is the frame's transport source, so the claim
+        is bound to the sender). First write wins per (round, survivor,
+        dead), and both parties must be members of the round's registered
+        committee — a peer outside it has no pair share in the sum and its
+        'reveal' could only corrupt finalize. A round with no registered
+        committee rejects every claim: any aggregator that will finalize
+        round ``r`` ran :meth:`mask_own` (which registers) at round start,
+        before a mid-round death can be detected, so the only frames this
+        drops are ones nobody here could validate or use."""
         try:
             sec = bytes.fromhex(secret_hex)
         except (TypeError, ValueError):
             return False
         if len(sec) != 32 or survivor == dead:
             return False
+        key = (int(round), survivor, dead)
         with self._lock:
-            self._repairs[(int(round), survivor, dead)] = sec
+            members = self._committees.get(key[0])
+            if members is None or survivor not in members or dead not in members:
+                return False
+            if key in self._repairs:
+                return False
+            self._repairs[key] = sec
         _REPAIRS.labels(self.addr, "rx").inc()
         return True
 
@@ -363,8 +400,14 @@ class PrivacyPlane:
         handle: ModelHandle,
         committee: Sequence[str],
         anchor_leaves: Sequence[np.ndarray],
+        anchor_round: Optional[int] = None,
     ) -> Tuple[Optional[List[np.ndarray]], str]:
         """Unmask the merged committee sum into model-shaped parameters.
+
+        ``anchor_round``, when given, must match the aggregate's declared
+        round: the lattice deltas were computed against that round's anchor,
+        and scattering them onto any other base would silently corrupt the
+        mean (counted as ``structure``).
 
         Returns ``(params, "ok")`` or ``(None, reason)`` with ``reason`` in
         ``{"unrepaired", "range", "structure"}`` — the caller falls back to
@@ -379,6 +422,13 @@ class PrivacyPlane:
         declared_n = int(info.get("n", 0))
         if bits != Settings.PRIVACY_RING_BITS or declared_n != len(committee):
             return None, self._outcome("structure")
+        if anchor_round is not None and int(anchor_round) != round:
+            log.warning(
+                "(%s) masked round %s: anchor is for round %s — refusing to "
+                "scatter onto the wrong base", self.addr, round, anchor_round,
+            )
+            return None, self._outcome("structure")
+        self.note_committee(round, committee)
         try:
             _, qmax, scale = self.lattice_params(declared_n)
         except ValueError:
@@ -402,15 +452,20 @@ class PrivacyPlane:
         ):
             return None, self._outcome("structure")
         # Subtract the uncancelled shares of every (present, missing) pair:
-        # our own pair secrets cover pairs involving us, survivors' repair
-        # reveals cover the rest. Any still-unknown secret aborts — an
-        # uncancelled mask share is uniform ring noise, not an aggregate.
+        # our own round-scoped pair secrets cover pairs involving us,
+        # survivors' repair reveals (already round-scoped) cover the rest.
+        # Any still-unknown secret aborts — an uncancelled mask share is
+        # uniform ring noise, not an aggregate.
         corrections: List[Tuple[bytes, str, str]] = []
         with self._lock:
             for i_addr in present:
                 for d_addr in missing:
                     if i_addr == self.addr:
-                        sec = self.masker.pair_secret(d_addr) if self.masker.knows(d_addr) else None
+                        sec = (
+                            self.masker.pair_round_secret(d_addr, round)
+                            if self.masker.knows(d_addr)
+                            else None
+                        )
                     else:
                         sec = self._repairs.get((round, i_addr, d_addr))
                     if sec is None:
@@ -432,7 +487,7 @@ class PrivacyPlane:
             lat = lattices[li].copy()
             for sec, i_addr, d_addr in corrections:
                 lat = (
-                    lat - signed_share(sec, i_addr, d_addr, round, i, idx.size, bits)
+                    lat - signed_share(sec, i_addr, d_addr, i, idx.size, bits)
                 ).astype(dt)
             li += 1
             t = center_ring(lat, bits)
@@ -494,6 +549,7 @@ class PrivacyPlane:
             self._residual = None
             self._repairs.clear()
             self._repairs_sent.clear()
+            self._committees.clear()
 
 
 __all__ = [

@@ -505,7 +505,14 @@ class TrainStage(Stage):
                 "local model", node.addr,
             )
             return own
-        params, outcome = state.privacy.finalize(aggregated, committee, anchor[0])
+        # anchor[1] is the anchor's round: finalize refuses (counted as a
+        # structure outcome) when it disagrees with the aggregate's declared
+        # round — mask_own checks this at encode time, and a stale or
+        # advanced anchor at finalize would scatter the committee mean onto
+        # the wrong base silently.
+        params, outcome = state.privacy.finalize(
+            aggregated, committee, anchor[0], anchor_round=anchor[1]
+        )
         if params is None:
             log.warning(
                 "%s: masked round %s not finalizable (%s) — falling back to "

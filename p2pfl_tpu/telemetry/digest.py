@@ -102,10 +102,13 @@ class HealthDigest:
     rejected_by_source: Dict[str, float] = field(default_factory=dict)
     faults_seen: float = 0.0  # chaos faults injected at this node's sends
     # Privacy plane: cumulative (epsilon, PRIVACY_DELTA)-DP spend of this
-    # node's training. -1 = no valid DP claim (noise off / non-private
-    # steps — JSON cannot carry inf); 0 = nothing released yet. Absent on
-    # pre-privacy (older) peers — always tolerated.
-    dp_epsilon: float = 0.0
+    # node's training. None = the node never reported a budget (DP off /
+    # pre-privacy peer — always tolerated, omitted on the wire); 0 = DP
+    # active, nothing released yet (a genuine zero-spend claim); -1 = no
+    # valid DP claim (noise off / non-private steps — JSON cannot carry
+    # inf). None and 0 are distinct on purpose: absent telemetry must not
+    # render as an active zero-spend guarantee.
+    dp_epsilon: Optional[float] = None
     # Device.
     mem_bytes: float = 0.0
     # Distribution sketches (v2+): name -> QuantileSketch wire dict, plus
@@ -142,6 +145,8 @@ class HealthDigest:
             d["sk"] = sk
         if not d.get("tx_by_codec"):
             d.pop("tx_by_codec", None)  # keep pre-codec-label beats byte-identical
+        if d.get("dp_epsilon") is None:
+            d.pop("dp_epsilon", None)  # no budget reported: omit, don't claim 0
         return json.dumps(d, separators=(",", ":"), sort_keys=True)
 
 
@@ -229,6 +234,18 @@ def _gauge_value(name: str, node: str) -> float:
     return 0.0
 
 
+def _gauge_value_opt(name: str, node: str) -> Optional[float]:
+    """Like :func:`_gauge_value` but ``None`` when the node has no series —
+    'never reported' must stay distinguishable from a genuine 0.0."""
+    fam = REGISTRY.get(name)
+    if fam is None:
+        return None
+    for labels, child in fam.samples():
+        if labels.get("node") == node:
+            return float(child.value)
+    return None
+
+
 def device_mem_bytes() -> float:
     """Accelerator memory in use, best effort: backend memory stats when the
     platform exposes them, else the sum of live jax array buffers (process-
@@ -293,7 +310,7 @@ def collect(addr: str, state: Any = None) -> HealthDigest:
         dig.rejected_by_source = by_source
         dig.staleness = _gauge_value("p2pfl_async_staleness", addr)
         dig.faults_seen = float(_series_sum("p2pfl_chaos_faults_total", addr))
-        dig.dp_epsilon = _gauge_value("p2pfl_privacy_epsilon", addr)
+        dig.dp_epsilon = _gauge_value_opt("p2pfl_privacy_epsilon", addr)
         dig.mem_bytes = device_mem_bytes()
         # v2: the node's distribution sketches (step-time, staleness,
         # update-norm, agg-wait) + distinct-contributor estimator, wire

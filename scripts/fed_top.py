@@ -129,10 +129,12 @@ def render(
         stale = p.get("staleness_p90")
         if stale is None:
             stale = p.get("staleness", 0.0)
-        # Privacy budget: cumulative DP epsilon. "-" = nothing reported,
+        # Privacy budget: cumulative DP epsilon. "-" = the peer never
+        # reported one (absent telemetry), "0.00" = DP active with nothing
+        # released yet — a genuine zero-spend claim, not the same thing —
         # "inf" = -1 sentinel (non-private steps void the claim).
-        eps = p.get("dp_epsilon", 0.0)
-        eps_s = "-" if not eps else ("inf" if eps < 0 else f"{eps:.2f}")
+        eps = p.get("dp_epsilon")
+        eps_s = "-" if eps is None else ("inf" if eps < 0 else f"{eps:.2f}")
         row = (
             f"{_short(addr):<23} {round_s:>7} {p.get('stage') or '-':<22.22} "
             f"{p.get('steps_per_s', 0.0):>8.1f} {_mib(p.get('tx_bytes', 0.0)):>8} "

@@ -38,6 +38,7 @@ from p2pfl_tpu.privacy import (
     PrivacyPlane,
     lattice_qmax,
     ring_dtype,
+    round_secret,
     shared_support,
     signed_share,
     wire_epsilon,
@@ -116,14 +117,45 @@ def test_signed_share_pair_sums_to_zero():
     a, b = PairwiseMasker("a"), PairwiseMasker("b")
     a.learn_key("b", b.public_key_hex())
     b.learn_key("a", a.public_key_hex())
-    sec = a.pair_secret("b")
+    rs = a.pair_round_secret("b", 5)
+    assert rs == b.pair_round_secret("a", 5)  # both ends derive it
     bits = Settings.PRIVACY_RING_BITS
-    s_ab = signed_share(sec, "a", "b", 5, 0, 16, bits)
-    s_ba = signed_share(sec, "b", "a", 5, 0, 16, bits)
+    s_ab = signed_share(rs, "a", "b", 0, 16, bits)
+    s_ba = signed_share(rs, "b", "a", 0, 16, bits)
     assert not (s_ab + s_ba).any()
     # distinct streams per round and tensor
-    assert not np.array_equal(s_ab, signed_share(sec, "a", "b", 6, 0, 16, bits))
-    assert not np.array_equal(s_ab, signed_share(sec, "a", "b", 5, 1, 16, bits))
+    rs6 = a.pair_round_secret("b", 6)
+    assert not np.array_equal(s_ab, signed_share(rs6, "a", "b", 0, 16, bits))
+    assert not np.array_equal(s_ab, signed_share(rs, "a", "b", 1, 16, bits))
+
+
+def test_repair_reveal_is_round_scoped():
+    """The wire form of a repair is H(pair_secret, round) — NOT the pair
+    secret. A captured round-r reveal must not regenerate any other round's
+    mask streams, even across a journaled crash-restart with the same
+    keypair (the exact leak of revealing the raw pair secret)."""
+    a, b = PairwiseMasker("a"), PairwiseMasker("b")
+    a.learn_key("b", b.public_key_hex())
+    b.learn_key("a", a.public_key_hex())
+    r, bits = 5, Settings.PRIVACY_RING_BITS
+    reveal = round_secret(a.pair_secret("b"), r)
+    assert reveal != a.pair_secret("b")
+    # the reveal reconstructs round r's stream exactly...
+    assert np.array_equal(
+        PairwiseMasker.stream(reveal, 0, 16, bits),
+        PairwiseMasker.stream(a.pair_round_secret("b", r), 0, 16, bits),
+    )
+    # ...but feeding it back through the KDF in the observer's only possible
+    # roles (as a pair secret, or as a later round's secret) yields streams
+    # unrelated to what the pair actually masks with in round r+1 — the
+    # per-round scoping holds even though the keypair is unchanged.
+    true_next = PairwiseMasker.stream(a.pair_round_secret("b", r + 1), 0, 16, bits)
+    assert not np.array_equal(
+        PairwiseMasker.stream(round_secret(reveal, r + 1), 0, 16, bits), true_next
+    )
+    assert not np.array_equal(
+        PairwiseMasker.stream(reveal, 0, 16, bits), true_next
+    )
 
 
 def test_shared_support_deterministic_sorted_bounded():
@@ -292,6 +324,9 @@ def test_dropout_repair_via_revealed_secrets():
     sec = planes[addrs[1]].repair_secrets_for(dead, r)
     assert sec is not None
     assert planes[addrs[0]].note_repair(r, addrs[1], dead, sec)
+    # A hostile overwrite of the stored genuine reveal is refused (first
+    # write wins) — finalize keeps subtracting the real share below.
+    assert not planes[addrs[0]].note_repair(r, addrs[1], dead, "ab" * 32)
     out, outcome = planes[addrs[0]].finalize(merged, addrs, anchor)
     assert outcome == "ok"
     # Reference: the maskless 2-contributor lattice sum decoded with the
@@ -328,16 +363,27 @@ def test_dropout_repair_via_journaled_seeds():
 
 
 def test_repair_reveal_once_and_hostile_repairs_dropped():
-    addrs, planes, _, _, r = _federation(2)
+    addrs, planes, _, _, r = _federation(3)
     p = planes[addrs[0]]
     assert p.repair_secrets_for("ghost", r) is None  # unknown peer: nothing
     sec = p.repair_secrets_for(addrs[1], r)
     assert sec is not None
     assert p.repair_secrets_for(addrs[1], r) is None  # dedup per (round, dead)
     q = planes[addrs[1]]
-    assert not q.note_repair(r, "s", "s", "ab" * 32)  # survivor == dead
-    assert not q.note_repair(r, "s", "d", "zz")  # not hex
-    assert not q.note_repair(r, "s", "d", "ab" * 8)  # wrong length
+    q.note_committee(r, addrs)
+    assert not q.note_repair(r, addrs[0], addrs[0], "ab" * 32)  # survivor == dead
+    assert not q.note_repair(r, addrs[0], addrs[2], "zz")  # not hex
+    assert not q.note_repair(r, addrs[0], addrs[2], "ab" * 8)  # wrong length
+    # committee validation: a claimed survivor or dead peer outside the
+    # round's registered committee is rejected, as is any claim for a round
+    # whose committee was never registered here.
+    assert not q.note_repair(r, "outsider", addrs[2], "ab" * 32)
+    assert not q.note_repair(r, addrs[0], "outsider", "ab" * 32)
+    assert not q.note_repair(r + 1, addrs[0], addrs[2], "ab" * 32)
+    # first write wins: the genuine claim sticks, an overwrite is refused
+    assert q.note_repair(r, addrs[0], addrs[2], sec)
+    assert not q.note_repair(r, addrs[0], addrs[2], "ab" * 32)
+    assert q._repairs[(r, addrs[0], addrs[2])] == bytes.fromhex(sec)
 
 
 # --- hostile masked frames ----------------------------------------------------
@@ -422,6 +468,24 @@ def test_range_check_rejects_wrapped_sum_before_model():
     assert out is None and outcome == "range"
 
 
+def test_finalize_refuses_mismatched_anchor_round():
+    """A stale (or advanced) anchor at finalize would scatter the committee
+    mean onto the wrong base — finalize must refuse it as a counted
+    structure outcome, mirroring mask_own's encode-time anchor check."""
+    addrs, planes, anchor, models, r = _federation(3)
+    agg = MaskedFedAvg()
+    agg.set_addr(addrs[0])
+    merged = agg.aggregate(_encode_all(planes, models, anchor, addrs, r, True))
+    out, outcome = planes[addrs[0]].finalize(
+        merged, addrs, anchor, anchor_round=r + 1
+    )
+    assert out is None and outcome == "structure"
+    out, outcome = planes[addrs[0]].finalize(
+        merged, addrs, anchor, anchor_round=r
+    )
+    assert outcome == "ok" and out is not None
+
+
 def test_masked_merge_drops_plaintext_and_foreign_lattices():
     addrs, planes, anchor, models, r = _federation(3)
     agg = MaskedFedAvg()
@@ -493,9 +557,52 @@ def test_digest_carries_epsilon():
     assert d.dp_epsilon == pytest.approx(wire_epsilon(BUDGETS.epsilon("nB")))
     rt = dig.decode(d.encode())
     assert rt.dp_epsilon == pytest.approx(d.dp_epsilon)
-    # absent field (older peer) tolerated
+    # absent field (older peer / DP never reported) decodes to None — NOT
+    # 0.0, which would read as an active zero-spend DP claim in fed_top
     legacy = dig.decode('{"node":"old","v":1}')
-    assert legacy is not None and legacy.dp_epsilon == 0.0
+    assert legacy is not None and legacy.dp_epsilon is None
+    # a node with no budget entry omits the field on the wire entirely
+    silent = dig.collect("never-reported-dp")
+    assert silent.dp_epsilon is None
+    assert '"dp_epsilon"' not in silent.encode()
+    assert dig.decode(silent.encode()).dp_epsilon is None
+
+
+def test_fed_top_eps_column_distinguishes_absent_from_zero():
+    """fed_top's EPS column: '-' means the peer never reported a budget;
+    '0.00' is a genuine zero-spend DP claim; 'inf' is the -1 voided-claim
+    sentinel. Conflating absent with 0.0 would render missing telemetry as
+    an active privacy guarantee."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "fed_top", os.path.join(os.path.dirname(__file__), "..", "scripts", "fed_top.py")
+    )
+    ft = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ft)
+
+    def peer(**kw):
+        return {"round": 1, "total_rounds": 2, "stage": "s", "scores": {}, **kw}
+
+    snap = {
+        "observer": "obs",
+        "peers": {
+            "mem://silent": peer(),  # no dp_epsilon key at all
+            "mem://null": peer(dp_epsilon=None),  # digest never reported
+            "mem://zero": peer(dp_epsilon=0.0),  # DP on, nothing released
+            "mem://void": peer(dp_epsilon=-1.0),  # guarantee voided
+            "mem://live": peer(dp_epsilon=2.5),
+        },
+    }
+    out = ft.render(snap, color=False)
+    rows = {
+        line.split()[0]: line for line in out.splitlines() if "mem://" in line
+    }
+    assert " - " in rows["mem://silent"] and " - " in rows["mem://null"]
+    assert "0.00" in rows["mem://zero"]
+    assert "inf" in rows["mem://void"]
+    assert "2.50" in rows["mem://live"]
 
 
 # --- wire overhead ------------------------------------------------------------

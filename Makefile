@@ -1,6 +1,6 @@
 # Convenience targets; everything also runs as the plain commands shown.
 
-.PHONY: test test-fast bench dryrun proto-check api-docs telemetry-check chaos-check byzantine-check observatory-check perf-check async-check fleetobs-check recovery-check parity-check wire-check privacy-check analyze race-check
+.PHONY: test test-fast bench dryrun proto-check api-docs telemetry-check chaos-check byzantine-check observatory-check perf-check async-check fleetobs-check recovery-check parity-check wire-check privacy-check analyze race-check population-check
 
 test:            ## full suite on the virtual 8-device CPU mesh (~30 min, 1 core)
 	python -m pytest tests/ -q
@@ -49,6 +49,9 @@ wire-check:      ## 3-node gate: int4+coalesced codec matches f32 accuracy, spar
 
 privacy-check:   ## 3-node gate: masked run matches plaintext accuracy, one masker killed mid-round does not corrupt the aggregate, epsilon reported nonzero (CPU-only)
 	JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/privacy_check.py
+
+population-check: ## 64-node fused gate: 10% cohort + seeded churn finishes, cohort stream replay-identical across chunked runs and fresh plans (CPU-only)
+	JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/population_check.py
 
 analyze:         ## static correctness pass (C1-C5: lock order, blocking-under-lock, unguarded writes, jit purity, drift); exit 0 clean / 1 new finding / 2 stale suppression
 	PYTHONPATH=. python scripts/analyze.py --baseline analysis_baseline.json

@@ -399,6 +399,37 @@ class Settings:
     PARITY_ROUNDS: int = _env_int("PARITY_ROUNDS", 2, 1, 100)
     PARITY_SEED: int = _env_int("PARITY_SEED", 1234, 0, 2**31 - 1)
 
+    # --- population-scale engine (population/) ------------------------------
+    # Cohort sampling (Papaya, arxiv 2111.04877): each round/window solicits
+    # only a seeded hash-sampled cohort instead of every live peer, so
+    # fan-in stays sublinear in fleet size. The sampler is order-independent
+    # (score = blake2b(seed:round:name)) so the fused mesh and the wire
+    # schedulers derive the SAME cohort from the same (seed, round, names)
+    # — which is what lets parity_diff gate a cohort-sampled scenario.
+    # ENABLED gates the wire schedulers (sync vote + async solicitation);
+    # the fused backend takes explicit committee schedules instead.
+    POP_COHORT_ENABLED: bool = _env_override("POP_COHORT_ENABLED", False)
+    POP_COHORT_FRACTION: float = _env_float("POP_COHORT_FRACTION", 1.0, 0.0, 1.0)
+    POP_COHORT_MIN: int = _env_int("POP_COHORT_MIN", 1, 1, 1 << 20)
+    POP_COHORT_SEED: int = _env_int("POP_COHORT_SEED", 0, 0, 2**31 - 1)
+    # Seeded availability churn (population/scenarios.py): per-(round, node)
+    # hash-derived down probability, applied identically by both backends as
+    # a COHORT-ELIGIBILITY filter (a down node is never solicited; real node
+    # death remains the wire-only chaos plane).
+    POP_CHURN_RATE: float = _env_float("POP_CHURN_RATE", 0.0, 0.0, 1.0)
+    # bench.py --population shape (overridable for CI-scale smoke runs).
+    POP_BENCH_NODES: int = _env_int("POP_BENCH_NODES", 100_000, 8, 1 << 24)
+    POP_BENCH_ROUNDS: int = _env_int("POP_BENCH_ROUNDS", 10, 1, 10_000)
+    POP_BENCH_COHORT: float = _env_float("POP_BENCH_COHORT", 0.01, 0.0, 1.0)
+
+    # --- bench TPU probe ----------------------------------------------------
+    # Per-attempt timeout for the throwaway TPU probe subprocess bench.py
+    # spawns before committing to the chip (BENCH_r03-r05 regression: hung
+    # tunnel probes silently fell back to CPU). Validated here so a typo'd
+    # value fails at import; bench.py retries one extra probe on timeout and
+    # stamps fallback_reason either way so perf_diff's backend refusal fires.
+    BENCH_PROBE_TIMEOUT: float = _env_float("BENCH_PROBE_TIMEOUT", 90.0, 1.0, 3600.0)
+
     # Continuous performance profiling (management/profiler.py): when set,
     # the stage machine captures ONE windowed jax.profiler device trace of
     # a fit per process under this directory (capture-once, never-raising),

@@ -243,6 +243,17 @@ class MeshSimulation:
             node-index-canonical). The sim↔real parity harness
             (:mod:`p2pfl_tpu.parity`) requires it — the wire backend can
             only reproduce a deterministic ordering.
+        pad_to_multiple: pad the population with zero-weight filler nodes to
+            the next multiple of this (default: the mesh's ``nodes`` axis),
+            so every stacked buffer shards instead of replicating. Fillers
+            carry zero samples (FedAvg weight 0), are NEVER electable (the
+            vote and any committee schedule range over the LOGICAL
+            population only), and are invisible to ``fleet_health`` /
+            ``fleet_snapshot`` / ``attach_ledger`` — padded and unpadded
+            runs produce identical aggregates (asserted by
+            tests/test_population.py). Only the default-``per_node_init``
+            shared-template initialization is padding-invariant; per-node
+            init keys are split over the PADDED count.
     """
 
     def __init__(
@@ -271,6 +282,7 @@ class MeshSimulation:
         clip_update_norm: float = 0.0,
         node_speed: Optional[np.ndarray] = None,
         canonical_committee: bool = False,
+        pad_to_multiple: Optional[int] = None,
     ) -> None:
         if task not in ("classification", "lm"):
             raise ValueError(f"unknown task {task!r}")
@@ -450,21 +462,34 @@ class MeshSimulation:
         # explicit out_shardings), never on host — with a tunneled or remote
         # accelerator the naive host-side np.broadcast_to + upload dominates
         # startup by minutes.
-        if self.num_nodes % self.mesh.shape["nodes"] != 0:
-            # Loud, not silent: every stacked buffer (params, opt state,
-            # data) falls back to replication, multiplying HBM use by the
-            # node-axis size and serializing the population loop.
-            import warnings
+        # Auto-pad to the mesh's nodes axis with zero-weight filler nodes
+        # (replaces the old warn-and-replicate fallback: a non-divisible
+        # population used to silently replicate every stacked buffer on
+        # every device). Fillers carry zero samples — sample-count weighting
+        # zeroes them out of any aggregate — and the vote / committee
+        # schedules range over logical_num_nodes only, so they are never
+        # elected: padded and unpadded runs produce identical trajectories.
+        self.logical_num_nodes = self.num_nodes
+        mult = (
+            int(pad_to_multiple)
+            if pad_to_multiple is not None
+            else int(self.mesh.shape["nodes"])
+        )
+        if mult < 1:
+            raise ValueError(f"pad_to_multiple must be >= 1, got {mult}")
+        n_pad = (-self.num_nodes) % mult
+        if n_pad:
 
-            warnings.warn(
-                f"population size {self.num_nodes} is not divisible by the "
-                f"mesh 'nodes' axis ({self.mesh.shape['nodes']}): stacked "
-                "population buffers will be REPLICATED on every device "
-                "instead of sharded. Pad the population to a multiple of "
-                "the node axis (empty partitions are fine under fedavg — "
-                "sample-count weighting zeroes them) or resize the mesh.",
-                stacklevel=3,
-            )
+            def _zero_rows(a: np.ndarray) -> np.ndarray:
+                a = np.asarray(a)
+                return np.concatenate(
+                    [a, np.zeros((n_pad,) + a.shape[1:], a.dtype)], axis=0
+                )
+
+            self.x = _zero_rows(self.x)
+            self.y = _zero_rows(self.y)
+            self.sample_mask = _zero_rows(self.sample_mask)
+            self.num_nodes += n_pad
 
         def stacked_spec(x) -> P:
             spec = [None] * (x.ndim + 1)
@@ -629,19 +654,36 @@ class MeshSimulation:
             scaffold=(self.algorithm == "scaffold"),
         )
 
-    def _round_body(self, carry, key: jax.Array, do_eval: jax.Array, data, epochs: int):
+    def _round_body(
+        self, carry, key: jax.Array, do_eval: jax.Array, data, epochs: int,
+        committee: Optional[jax.Array] = None,
+    ):
         params_stack, opt_stack, c_stack, c_global = carry
         x, y, sample_mask, num_samples, xt, yt = data
         kv, kt = jax.random.split(key)
 
-        committee = vote_committee(kv, self.num_nodes, self.train_set_size)  # [K]
-        if self.canonical_committee:
-            # Parity mode: node-index-canonical committee ORDER (the set is
-            # unchanged). Gather order, per-member key assignment and the
-            # FedAvg reduction order all become deterministic functions of
-            # the node index — the wire backend can reproduce them exactly,
-            # which is what makes cross-backend aggregates bit-comparable.
-            committee = jnp.sort(committee)
+        if committee is None:
+            # Election over the LOGICAL population only: zero-weight filler
+            # nodes added by mesh-axis padding are never electable, so a
+            # padded run's committees (and therefore its whole trajectory)
+            # match the unpadded run's bit-for-bit.
+            committee = vote_committee(
+                kv, self.logical_num_nodes, self.train_set_size
+            )  # [K]
+            if self.canonical_committee:
+                # Parity mode: node-index-canonical committee ORDER (the set
+                # is unchanged). Gather order, per-member key assignment and
+                # the FedAvg reduction order all become deterministic
+                # functions of the node index — the wire backend can
+                # reproduce them exactly, which is what makes cross-backend
+                # aggregates bit-comparable.
+                committee = jnp.sort(committee)
+        # else: a population-engine committee SCHEDULE row (cohort sampling
+        # — population/cohort.py): the members are precomputed host-side,
+        # already index-sorted; kv is split-and-dropped above so the key
+        # stream (kt and everything derived from it) matches what a voted
+        # round at the same absolute index would have used.
+        k_members = int(committee.shape[0])
 
         # Gather committee state/data (XLA all_gather over the nodes axis).
         p_k = jax.tree.map(lambda a: a[committee], params_stack)
@@ -650,7 +692,7 @@ class MeshSimulation:
         x_k = x[committee]
         y_k = y[committee]
         w_k = sample_mask[committee]
-        keys = jax.random.split(kt, self.train_set_size)
+        keys = jax.random.split(kt, k_members)
 
         p_k_new, o_k, losses = jax.vmap(
             partial(self._local_train, c_global=c_global, epochs=epochs)
@@ -719,7 +761,9 @@ class MeshSimulation:
                 dy,
                 dc,
                 jnp.float32(self.scaffold_global_lr),
-                jnp.float32(self.num_nodes),
+                # N in SCAFFOLD's K/N variate scale is the TRUE population —
+                # mesh-axis filler nodes are not federation members.
+                jnp.float32(self.logical_num_nodes),
             )
             agg = jax.tree.map(lambda g, t: g.astype(t.dtype), new_global, anchor)
             c_stack = jax.tree.map(
@@ -799,7 +843,8 @@ class MeshSimulation:
     )
     def _run_jit(
         self, params_stack, opt_stack, c_stack, c_global, data, start_round,
-        final_round, *, rounds: int, epochs: int, eval_every: int = 1,
+        final_round, committee_schedule=None, *, rounds: int, epochs: int,
+        eval_every: int = 1,
     ):
         # Per-round keys are position-independent (fold_in on the absolute
         # round index): chunking and checkpoint-resume replay identically.
@@ -810,10 +855,20 @@ class MeshSimulation:
         # final round unconditionally so final_test_acc always exists.
         do_eval = ((idx + 1) % eval_every == 0) | (idx == final_round)
         carry = (params_stack, opt_stack, c_stack, c_global)
+        if committee_schedule is None:
+            body = lambda c, ke: self._round_body(c, ke[0], ke[1], data, epochs)  # noqa: E731
+            xs: Any = (keys, do_eval)
+        else:
+            # Cohort sampling: one precomputed [rounds, K] committee row per
+            # scanned round (population/cohort.py). None-vs-array is a
+            # trace-time (pytree-structure) distinction, so the voted and
+            # scheduled programs are separate compiled executables.
+            body = lambda c, ke: self._round_body(  # noqa: E731
+                c, ke[0], ke[1], data, epochs, committee=ke[2]
+            )
+            xs = (keys, do_eval, committee_schedule)
         carry, (committees, train_loss, test_loss, test_acc) = jax.lax.scan(
-            lambda c, ke: self._round_body(c, ke[0], ke[1], data, epochs),
-            carry,
-            (keys, do_eval),
+            body, carry, xs
         )
         params_stack, opt_stack, c_stack, c_global = carry
         return params_stack, opt_stack, c_stack, c_global, committees, train_loss, test_loss, test_acc
@@ -830,6 +885,7 @@ class MeshSimulation:
         checkpoint_every: int = 1,
         eval_every: int = 1,
         profile_dir: Optional[str] = None,
+        committee_schedule: Optional[np.ndarray] = None,
     ) -> SimulationResult:
         """Execute ``rounds`` federated rounds on the mesh.
 
@@ -863,6 +919,15 @@ class MeshSimulation:
         disables) captures the FIRST timed chunk as a windowed
         ``jax.profiler`` device trace under that directory — post-warmup,
         so the window shows steady-state per-op execution, not compile.
+
+        ``committee_schedule`` (``[rounds, K]`` int32 node indices,
+        index-sorted rows — e.g. from
+        :func:`p2pfl_tpu.population.cohort.committee_schedule`) replaces
+        the per-round vote with a precomputed cohort per round: the
+        population engine's sampled-cohort rounds at 100k scale. Indices
+        must lie in the LOGICAL population (fillers excluded); row ``i``
+        drives absolute round ``completed_rounds + i``, and chunking slices
+        the schedule to match.
         """
         if self._closed:
             raise RuntimeError(
@@ -884,6 +949,23 @@ class MeshSimulation:
         if rounds % rounds_per_call:
             chunks.append(rounds % rounds_per_call)
         start = self.completed_rounds
+        sched: Optional[np.ndarray] = None
+        if committee_schedule is not None:
+            sched = np.asarray(committee_schedule, np.int32)
+            if sched.ndim != 2 or sched.shape[0] != rounds or sched.shape[1] < 1:
+                raise ValueError(
+                    f"committee_schedule has shape {sched.shape}, expected "
+                    f"({rounds}, K>=1) — one index-sorted cohort row per round"
+                )
+            if sched.min() < 0 or sched.max() >= self.logical_num_nodes:
+                # An out-of-range index would be silently clamped by XLA's
+                # gather and train the wrong node — the same failure class
+                # the byzantine_mask length check guards against.
+                raise ValueError(
+                    f"committee_schedule indices must be in "
+                    f"[0, {self.logical_num_nodes}) — the logical population "
+                    "(mesh-axis fillers are not electable)"
+                )
 
         if warmup:
             # Population/opt buffers are donated to the round program (the
@@ -910,6 +992,7 @@ class MeshSimulation:
                 out = self._run_jit(
                     wp, wo, wc, wcg, data, jnp.int32(start + rounds + 1),
                     jnp.int32(start + rounds + chunks[0]),
+                    None if sched is None else jnp.asarray(sched[: chunks[0]]),
                     rounds=chunks[0], epochs=epochs, eval_every=eval_every,
                 )
                 jax.block_until_ready(out[0])
@@ -945,6 +1028,9 @@ class MeshSimulation:
                     params_stack, opt_stack, c_stack, c_global, comm, _tr, tl, ta = self._run_jit(
                         params_stack, opt_stack, c_stack, c_global,
                         data, jnp.int32(start + done), jnp.int32(start + rounds - 1),
+                        None
+                        if sched is None
+                        else jnp.asarray(sched[done: done + chunk]),
                         rounds=chunk, epochs=epochs, eval_every=eval_every,
                     )
                 committees.append(comm)
@@ -1093,13 +1179,13 @@ class MeshSimulation:
 
         if node_names is not None:
             names = [str(s) for s in node_names]
-            if len(names) != self.num_nodes:
+            if len(names) != self.logical_num_nodes:
                 raise ValueError(
                     f"node_names has {len(names)} entries for "
-                    f"{self.num_nodes} virtual nodes"
+                    f"{self.logical_num_nodes} virtual nodes"
                 )
         else:
-            names = [f"vnode/{i:05d}" for i in range(self.num_nodes)]
+            names = [f"vnode/{i:05d}" for i in range(self.logical_num_nodes)]
         if run_id is not None:
             LEDGERS.configure(run_id)
         self._ledger = LEDGERS.get(node)
@@ -1183,7 +1269,7 @@ class MeshSimulation:
         """
         if result.committees is None:
             raise ValueError("result carries no committee history")
-        n = self.num_nodes
+        n = self.logical_num_nodes  # mesh-axis fillers are not fleet members
         rounds = int(result.committees.shape[0])
         steps_per_round = max(1, (int(self.x.shape[1]) // self.batch_size) * epochs)
         base_step_s = result.seconds_per_round / steps_per_round
@@ -1203,6 +1289,12 @@ class MeshSimulation:
             "round_lag": np.asarray(round_lag),
             "round": np.asarray(round_idx),
             "rejections": np.asarray(rejections),
+            # Cohort-fill: the fraction of this run's rounds the node was
+            # solicited in. Under full-population rounds this is just
+            # committee luck; under a cohort schedule it is the sampler's
+            # realized coverage — the population engine's fairness metric
+            # (fed_top renders it as the COHORT column).
+            "cohort_fill": np.asarray(participation) / float(max(1, rounds)),
         }
 
     def fleet_snapshot(
@@ -1225,7 +1317,7 @@ class MeshSimulation:
         )
 
         health = self.fleet_health(result, epochs=epochs)
-        names = [f"vnode/{i:05d}" for i in range(self.num_nodes)]
+        names = [f"vnode/{i:05d}" for i in range(self.logical_num_nodes)]
         snap = population_snapshot(
             observer="mesh-sim", node_names=names, metrics=health, top_n=top_n
         )

@@ -1,0 +1,214 @@
+"""Seeded, order-independent cohort sampling — the population engine's
+shared primitive.
+
+Papaya-style cross-device FL (arxiv 2111.04877) solicits only a sampled
+cohort per round/window so fan-in stays sublinear in fleet size. The
+sampler here is a pure function of ``(seed, round, name)``:
+
+    score(name) = blake2b(f"{seed}:{round}:{name}")
+    cohort(round) = the k lowest-scoring eligible names, returned sorted
+
+Properties the parity gate leans on:
+
+* **order-independent** — the fused mesh scores index-derived names and the
+  wire scheduler scores peer addresses; as long as the NAME SETS match, the
+  cohorts match, regardless of discovery order or which backend computes it;
+* **per-round reshuffle** — scores are keyed on the round, so over many
+  rounds every node's expected participation converges to the cohort
+  fraction (coverage fairness, asserted by tests/test_population.py);
+* **deterministic under churn** — availability is a filter applied BEFORE
+  ranking, so both backends that agree on who is down agree on the cohort.
+
+Wire integration: the sync vote stage and the async solicitation call
+:func:`wire_cohort_filter` with the round's candidate names. It is a no-op
+unless cohort sampling is switched on — either ambiently via
+``Settings.POP_COHORT_ENABLED`` (knob-driven production shape) or by an
+installed :class:`CohortPlan` (scenario runs, which also carry a churn
+trace). Keeping the OFF path one predicate keeps the hot vote path cheap.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from p2pfl_tpu.config import Settings
+
+
+def cohort_score(seed: int, round_idx: int, name: str) -> int:
+    """Deterministic per-(round, node) ranking score: the first 8 bytes of
+    ``blake2b(seed:round:name)`` as an unsigned integer. Python-version- and
+    platform-stable (unlike ``hash()``), cheap (one short digest), and
+    uniform enough that the k-lowest rule is an unbiased sample."""
+    h = hashlib.blake2b(
+        f"{int(seed)}:{int(round_idx)}:{name}".encode(), digest_size=8
+    )
+    return int.from_bytes(h.digest(), "big")
+
+
+def cohort_size(n: int, fraction: float, min_size: int = 1) -> int:
+    """Cohort size for an ``n``-name pool: ``max(min_size, round(f*n))``
+    clamped to ``n``. Fixed for a fixed pool size — the fused backend needs
+    a static K for the scanned round program's shapes."""
+    k = max(int(min_size), int(round(float(fraction) * n)))
+    return max(1, min(k, n))
+
+
+def availability_down(seed: int, round_idx: int, name: str, churn_rate: float) -> bool:
+    """Hash-derived churn trace: is ``name`` down in ``round_idx``? Uses an
+    independent hash domain (``churn:`` prefix) so availability and cohort
+    ranking never correlate. Both backends call this with the same
+    arguments, so they agree on the eligible pool by construction."""
+    if churn_rate <= 0.0:
+        return False
+    h = hashlib.blake2b(
+        f"churn:{int(seed)}:{int(round_idx)}:{name}".encode(), digest_size=8
+    )
+    v = int.from_bytes(h.digest(), "big") / float(1 << 64)
+    return v < float(churn_rate)
+
+
+def cohort_for_round(
+    seed: int,
+    round_idx: int,
+    names: Sequence[str],
+    fraction: float,
+    min_size: int = 1,
+    available: Optional[Callable[[str], bool]] = None,
+) -> List[str]:
+    """The round's cohort: k lowest-scoring available names, sorted.
+
+    ``k`` is derived from the FULL name-set size (not the post-churn pool)
+    so the fused backend's committee shape stays static across rounds; when
+    churn leaves fewer than ``k`` names available the cohort shrinks to the
+    pool — callers that need a fixed K (committee schedules) raise instead.
+    """
+    pool = [n for n in names if available is None or available(n)]
+    k = min(cohort_size(len(names), fraction, min_size), len(pool))
+    # (score, name) sort: the name tie-break makes a (vanishingly unlikely)
+    # score collision deterministic too.
+    ranked = sorted(pool, key=lambda n: (cohort_score(seed, round_idx, n), n))
+    return sorted(ranked[:k])
+
+
+@dataclass(frozen=True)
+class CohortPlan:
+    """A fully-seeded cohort policy: sampler config + churn trace.
+
+    One plan describes both backends' solicitation for a whole run;
+    :func:`install_plan` makes it ambient for the wire schedulers, while the
+    fused backend compiles it into a committee schedule up front
+    (:func:`committee_schedule`).
+    """
+
+    seed: int
+    fraction: float
+    min_size: int = 1
+    churn_rate: float = 0.0
+    #: optional explicit full-population name set; when present the cohort
+    #: is computed over it (not the live candidate set), so a wire node
+    #: whose neighbor view is briefly stale still derives the same cohort.
+    names: Optional[tuple] = field(default=None)
+
+    def available(self, round_idx: int, name: str) -> bool:
+        return not availability_down(self.seed, round_idx, name, self.churn_rate)
+
+    def cohort(self, round_idx: int, candidates: Sequence[str]) -> List[str]:
+        names = list(self.names) if self.names is not None else list(candidates)
+        return cohort_for_round(
+            self.seed,
+            round_idx,
+            names,
+            self.fraction,
+            self.min_size,
+            available=lambda n: self.available(round_idx, n),
+        )
+
+
+def committee_schedule(
+    plan: CohortPlan,
+    node_names: Sequence[str],
+    rounds: int,
+    start_round: int = 0,
+) -> np.ndarray:
+    """Compile a plan into the fused backend's ``[rounds, K]`` int32
+    committee schedule (node INDICES, sorted per round — the order
+    ``canonical_committee`` would produce, so per-member RNG keys line up
+    with the wire's :func:`~p2pfl_tpu.parity.round_member_keys` ranks).
+
+    K must be constant across rounds (the scanned round program's shapes
+    are static): a churn draw that leaves fewer than K nodes available
+    raises instead of silently shrinking the round.
+    """
+    names = [str(n) for n in node_names]
+    index = {n: i for i, n in enumerate(names)}
+    k = cohort_size(len(names), plan.fraction, plan.min_size)
+    sched = np.empty((rounds, k), np.int32)
+    for ri in range(rounds):
+        r = start_round + ri
+        cohort = plan.cohort(r, names)
+        if len(cohort) != k:
+            raise ValueError(
+                f"round {r}: churn left {len(cohort)} available nodes for a "
+                f"K={k} cohort — lower POP_CHURN_RATE or the cohort fraction "
+                "(the fused scan needs a static committee shape)"
+            )
+        sched[ri] = [index[n] for n in cohort]
+    return sched
+
+
+# --- ambient plan for the wire schedulers -------------------------------------
+
+_PLAN_LOCK = threading.Lock()
+_ACTIVE_PLAN: Optional[CohortPlan] = None
+
+
+def install_plan(plan: CohortPlan) -> None:
+    """Make ``plan`` ambient for every wire node in this process (scenario
+    runs install one plan for the whole federation — per-node plans would
+    let two nodes disagree about the cohort, which is the bug class this
+    module exists to remove)."""
+    global _ACTIVE_PLAN
+    with _PLAN_LOCK:
+        _ACTIVE_PLAN = plan
+
+
+def clear_plan() -> None:
+    global _ACTIVE_PLAN
+    with _PLAN_LOCK:
+        _ACTIVE_PLAN = None
+
+
+def active_plan() -> Optional[CohortPlan]:
+    """The effective plan: an installed one wins; otherwise the
+    ``POP_COHORT_*`` knobs when enabled; otherwise None (sampling off)."""
+    with _PLAN_LOCK:
+        if _ACTIVE_PLAN is not None:
+            return _ACTIVE_PLAN
+    if Settings.POP_COHORT_ENABLED:
+        return CohortPlan(
+            seed=Settings.POP_COHORT_SEED,
+            fraction=Settings.POP_COHORT_FRACTION,
+            min_size=Settings.POP_COHORT_MIN,
+            churn_rate=Settings.POP_CHURN_RATE,
+        )
+    return None
+
+
+def wire_cohort_filter(round_idx: int, candidates: Sequence[str]) -> List[str]:
+    """Filter a wire scheduler's candidate list down to the round's cohort.
+
+    No-op (the input, as a list) when cohort sampling is off. With a plan,
+    returns the cohort members present in ``candidates`` — computed over
+    the plan's pinned name set when it has one, else over the candidates —
+    so every node that sees the same round derives the same cohort.
+    """
+    plan = active_plan()
+    if plan is None:
+        return list(candidates)
+    cohort = set(plan.cohort(round_idx, sorted(candidates)))
+    return [c for c in candidates if c in cohort]

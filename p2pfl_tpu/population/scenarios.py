@@ -1,0 +1,449 @@
+"""Declarative, seeded population scenarios — one spec, two backends.
+
+A :class:`PopulationScenario` extends the parity harness's
+:class:`~p2pfl_tpu.parity.ParityScenario` with the population-scale
+environment axes Papaya (arxiv 2111.04877) treats as production reality:
+
+* **Dirichlet non-IID partitioning** — per-node label proportions drawn
+  from ``Dirichlet(alpha)``, materialized with fixed per-node sample counts
+  (label SKEW, equal sizes) so both backends batch the same shapes and the
+  shared train kernel stays bit-identical;
+* **cohort sampling** — a :class:`~p2pfl_tpu.population.cohort.CohortPlan`
+  over the scenario's node names; the fused backend compiles it into a
+  committee schedule, the wire backend filters its vote candidates through
+  the SAME hash sampler;
+* **availability/churn traces** — the plan's hash-derived eligibility
+  filter (a churned-out node is not solicited that round; it still gossips,
+  matching the fused backend where non-members simply don't train);
+* **device-class speed tiers** — fused-side ``node_speed`` multipliers
+  (trajectory-invariant virtual timing; the wire's sync rounds would absorb
+  real sleeps the same way, so tiers are not emulated with wall-clock);
+* **seeded Byzantine fractions** — a seeded draw of adversaries applying
+  the shared ``poison_delta`` transform on both backends.
+
+Because cohorts shrink the per-round committee, a single wire node no
+longer witnesses every fold: :func:`stitch_observer_stream` assembles the
+wire's certified trajectory from a rotating per-round observer (the round's
+first cohort member — its ``CanonicalFedAvg`` folds every contribution and
+its commit carries the content hash), which ``scripts/parity_diff.py`` then
+aligns against the fused ledger end-to-end.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.parity import (
+    ParityLearner,
+    ParityScenario,
+    build_train_fn,
+    round_member_keys,
+)
+from p2pfl_tpu.population.cohort import (
+    CohortPlan,
+    clear_plan,
+    cohort_size,
+    committee_schedule,
+    install_plan,
+)
+
+
+def dirichlet_label_counts(
+    rng: np.random.Generator, n: int, s: int, num_classes: int, alpha: float
+) -> np.ndarray:
+    """Per-node class counts ``[n, num_classes]`` summing to ``s`` per row:
+    proportions drawn from ``Dirichlet(alpha)``, quantized by largest
+    remainder so every node holds EXACTLY ``s`` samples (fixed counts keep
+    both backends' batch shapes — and therefore the shared kernel's
+    compiled program — identical under any skew)."""
+    props = rng.dirichlet(np.full(num_classes, float(alpha)), size=n)
+    raw = props * s
+    counts = np.floor(raw).astype(np.int64)
+    short = s - counts.sum(axis=1)
+    order = np.argsort(-(raw - counts), axis=1, kind="stable")
+    for i in range(n):
+        counts[i, order[i, : int(short[i])]] += 1
+    return counts
+
+
+@dataclass
+class PopulationScenario(ParityScenario):
+    """A seeded population scenario both backends can execute.
+
+    Inherits the parity scenario's learner/data knobs; adds the population
+    axes. ``byzantine`` / ``straggler`` may still be given explicitly, but
+    ``byzantine_fraction`` / ``speed_tiers`` are the population-scale way:
+    seeded draws, so the spec stays declarative at any n.
+    """
+
+    #: Dirichlet concentration for label skew (None = the IID parity recipe;
+    #: small alpha = extreme skew — tests/test_population.py quantifies it).
+    dirichlet_alpha: Optional[float] = None
+    #: cohort fraction/floor per round (1.0 = full-population committees,
+    #: the parity default).
+    cohort_fraction: float = 1.0
+    cohort_min: int = 1
+    #: hash-derived per-round unavailability (eligibility filter).
+    churn_rate: float = 0.0
+    #: seeded fraction of nodes poisoning their updates.
+    byzantine_fraction: float = 0.0
+    byzantine_attack: str = "signflip"
+    #: device-class speed multipliers, assigned to nodes by seeded draw and
+    #: mapped to the fused backend's ``node_speed`` tiers (fused-only;
+    #: trajectory-invariant by construction).
+    speed_tiers: Tuple[float, ...] = ()
+
+    def __post_init__(self) -> None:
+        if self.byzantine_fraction and not self.byzantine:
+            rng = np.random.default_rng(self.seed + 0x5EED)
+            k = int(round(self.byzantine_fraction * self.n_nodes))
+            for idx in rng.choice(self.n_nodes, size=k, replace=False):
+                self.byzantine[int(idx)] = self.byzantine_attack
+        super().__post_init__()
+        if not (0.0 < self.cohort_fraction <= 1.0):
+            raise ValueError(
+                f"cohort_fraction must be in (0, 1], got {self.cohort_fraction}"
+            )
+
+    @property
+    def run_id(self) -> str:
+        return (
+            f"population-s{self.seed}-n{self.n_nodes}-r{self.rounds}"
+            f"-c{self.cohort_fraction:g}"
+        )
+
+    @property
+    def cohort_k(self) -> int:
+        """The static per-round committee size (both backends')."""
+        return cohort_size(self.n_nodes, self.cohort_fraction, self.cohort_min)
+
+    def plan(self) -> CohortPlan:
+        """The scenario's cohort plan, pinned to the full name set so a
+        wire node with a briefly-stale neighbor view derives the same
+        cohort as the fused schedule."""
+        return CohortPlan(
+            seed=self.seed,
+            fraction=self.cohort_fraction,
+            min_size=self.cohort_min,
+            churn_rate=self.churn_rate,
+            names=tuple(self.node_names),
+        )
+
+    def schedule(self, start_round: int = 0) -> np.ndarray:
+        """The fused backend's ``[rounds, K]`` committee schedule."""
+        return committee_schedule(
+            self.plan(), self.node_names, self.rounds, start_round=start_round
+        )
+
+    def node_speed_array(self) -> Optional[np.ndarray]:
+        """Seeded device-class tiers as a ``node_speed`` array (None when
+        the scenario declares no tiers and no explicit stragglers)."""
+        if not self.speed_tiers and not self.straggler:
+            return None
+        speed = np.ones(self.n_nodes, np.float32)
+        if self.speed_tiers:
+            rng = np.random.default_rng(self.seed + 0x7153)
+            speed = np.asarray(self.speed_tiers, np.float32)[
+                rng.integers(0, len(self.speed_tiers), size=self.n_nodes)
+            ]
+        for idx, delay in self.straggler.items():
+            speed[int(idx)] = 1.0 + float(delay)
+        return speed
+
+    def data(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        if self.dirichlet_alpha is None:
+            return super().data()
+        rng = np.random.default_rng(self.seed)
+        n, s = self.n_nodes, self.samples_per_node
+        templates = rng.uniform(0.0, 1.0, size=(10, 28, 28)).astype(np.float32)
+        counts = dirichlet_label_counts(rng, n, s, 10, self.dirichlet_alpha)
+        y = np.empty((n, s), np.int32)
+        for i in range(n):
+            y[i] = rng.permutation(np.repeat(np.arange(10, dtype=np.int32), counts[i]))
+        x = templates[y] + rng.normal(0.0, 0.35, size=(n, s, 28, 28)).astype(
+            np.float32
+        )
+        x = np.clip(x, 0.0, 1.0).astype(np.float32)
+        return x, y, np.ones((n, s), np.float32)
+
+
+class PopulationLearner(ParityLearner):
+    """Cohort-aware wire learner: trains with the mesh kernel and the
+    mesh's key schedule, but derives its per-fit ``(round, rank, K)`` from
+    the scenario's cohort plan — node ``i`` only fits in rounds whose
+    cohort contains it, at the RNG key of its rank in the sorted cohort
+    (exactly the key the fused schedule row assigns that member)."""
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        super().__init__(*args, **kwargs)
+        scn = self.scenario
+        if not isinstance(scn, PopulationScenario):
+            raise ValueError("PopulationLearner needs a PopulationScenario")
+        plan = scn.plan()
+        names = scn.node_names
+        me = names[self.node_idx]
+        self._slots: List[Tuple[int, int, int]] = []
+        for r in range(scn.rounds):
+            cohort = plan.cohort(r, names)
+            if me in cohort:
+                self._slots.append((r, cohort.index(me), len(cohort)))
+
+    def fit(self):
+        import jax
+
+        from p2pfl_tpu.parallel.simulation import poison_delta
+
+        slot = self._fits
+        self._fits += 1
+        if slot >= len(self._slots):
+            raise RuntimeError(
+                f"{self._self_addr}: fit #{slot} but the cohort plan "
+                f"schedules this node for only {len(self._slots)} rounds — "
+                "the wire solicited a non-member (cohort gate broken?)"
+            )
+        r, rank, k = self._slots[slot]
+        if self._delay_s > 0.0:
+            time.sleep(self._delay_s)
+        scn = self.scenario
+        keys = round_member_keys(scn.seed, r, k)
+        model = self.get_model()
+        start = model.params
+        new_params, _loss = self._train_fn(
+            start, self._x, self._y, self._w, keys[rank]
+        )
+        if self._attack:
+            new_params = jax.tree.map(
+                lambda new, old: poison_delta(new, old, self._attack).astype(
+                    new.dtype
+                ),
+                new_params,
+                start,
+            )
+        model.set_parameters(new_params)
+        model.set_contribution([self._self_addr], int(self._w.sum()))
+        return model
+
+
+def stitch_observer_stream(
+    scn: PopulationScenario, events_by_node: Dict[str, List[Dict[str, Any]]]
+) -> List[Dict[str, Any]]:
+    """The wire federation's certified trajectory under cohort sampling.
+
+    A non-member adopts each round's aggregate via gossip but never
+    witnesses the folds, so no single node's ledger spans the whole
+    trajectory. Rotate the observer instead: round ``r``'s events come from
+    the round's FIRST (sorted) cohort member — a train-set node whose
+    aggregator folded every contribution and whose commit carries the
+    content hash. The concatenation is one stream ``parity_diff`` aligns
+    against the fused ledger (same rotation both runs, so two wire runs
+    also compare)."""
+    plan = scn.plan()
+    names = scn.node_names
+    stream: List[Dict[str, Any]] = []
+    for r in range(scn.rounds):
+        observer = plan.cohort(r, names)[0]
+        stream.extend(
+            e for e in events_by_node.get(observer, ())
+            if e.get("round") == r
+        )
+    return stream
+
+
+# --- backend runners ----------------------------------------------------------
+
+
+def run_scenario_wire(
+    scn: PopulationScenario,
+    ledger_dir: Optional[str] = None,
+    timeout_s: float = 600.0,
+) -> Dict[str, Any]:
+    """Run the scenario on the REAL wire with cohort sampling live: the
+    plan is installed ambiently, so ``VoteTrainSetStage`` filters its
+    candidates to the round's cohort and (with ``TRAIN_SET_SIZE == K``)
+    elects exactly the cohort, deterministically. Returns the parity
+    runner's shape plus ``"stitched"`` — the rotating-observer stream for
+    ``parity_diff``."""
+    from p2pfl_tpu.chaos import CHAOS
+    from p2pfl_tpu.comm.memory.registry import InMemoryRegistry
+    from p2pfl_tpu.learning.aggregators import CanonicalFedAvg
+    from p2pfl_tpu.learning.dataset.dataset import FederatedDataset
+    from p2pfl_tpu.node import Node
+    from p2pfl_tpu.telemetry.ledger import LEDGERS
+    from p2pfl_tpu.utils.utils import set_test_settings, wait_convergence
+
+    snap = Settings.snapshot()
+    names = scn.node_names
+    x, y, w = scn.data()
+    template = scn.template_model()
+    train_fn = build_train_fn(
+        template.apply_fn, scn.lr, scn.batch_size, scn.epochs
+    )
+    nodes: List[Any] = []
+    try:
+        set_test_settings()
+        Settings.LOG_LEVEL = "WARNING"
+        Settings.RESOURCE_MONITOR_PERIOD = 0
+        Settings.LEDGER_ENABLED = True
+        # K-sized committees: the cohort filter leaves exactly K candidates,
+        # so every vote outcome elects the whole cohort (deterministic
+        # election — the same scoped-RNG argument as the parity harness's
+        # full committee, one level down).
+        Settings.TRAIN_SET_SIZE = scn.cohort_k
+        Settings.WIRE_COMPRESSION = "none"
+        Settings.VOTE_TIMEOUT = 20.0
+        Settings.AGGREGATION_TIMEOUT = 120.0
+        Settings.AGGREGATION_STALL_PATIENCE = 60.0
+        Settings.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS = 400
+        Settings.GOSSIP_MODELS_PER_ROUND = scn.n_nodes
+        CHAOS.reset()
+        if scn.drop_rate > 0.0:
+            Settings.CHAOS_ENABLED = True
+            Settings.CHAOS_SEED = scn.seed
+            Settings.CHAOS_DROP_RATE = float(scn.drop_rate)
+        LEDGERS.reset()
+        LEDGERS.configure(scn.run_id)
+        install_plan(scn.plan())
+
+        for i, name in enumerate(names):
+            data = FederatedDataset.from_arrays(x[i], y[i])
+            nodes.append(
+                Node(
+                    template.build_copy(),
+                    data,
+                    addr=name,
+                    learner=PopulationLearner,
+                    aggregator=CanonicalFedAvg(),
+                    executor=False,
+                    node_idx=i,
+                    scenario=scn,
+                    arrays=(x[i], y[i], w[i]),
+                    train_fn=train_fn,
+                )
+            )
+        for nd in nodes:
+            nd.start()
+        for i in range(1, len(nodes)):
+            nodes[i].connect(nodes[0].addr)
+        wait_convergence(nodes, scn.n_nodes - 1, wait=30)
+        nodes[0].set_start_learning(rounds=scn.rounds, epochs=scn.epochs)
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if all(
+                not nd.learning_in_progress()
+                and nd.learning_workflow is not None
+                for nd in nodes
+            ):
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError("population wire federation did not finish")
+
+        out: Dict[str, Any] = {"ledgers": {}, "hashes": {}, "events": {}}
+        for name in names:
+            led = LEDGERS.peek(name)
+            events = led.canonical_events() if led is not None else []
+            out["events"][name] = events
+            out["hashes"][name] = {
+                ev["round"]: ev["hash"]
+                for ev in events
+                if ev["kind"] == "aggregate_committed" and "hash" in ev
+            }
+            path = None
+            if ledger_dir is not None and led is not None:
+                path = led.dump(
+                    os.path.join(ledger_dir, f"ledger_{name}.jsonl")
+                )
+            out["ledgers"][name] = path
+        out["stitched"] = stitch_observer_stream(scn, out["events"])
+        return out
+    finally:
+        clear_plan()
+        for nd in nodes:
+            try:
+                nd.stop()
+            except Exception:  # noqa: BLE001 — teardown must not mask results
+                pass
+        InMemoryRegistry.reset()
+        CHAOS.reset()
+        Settings.restore(snap)
+
+
+def run_scenario_fused(
+    scn: PopulationScenario, ledger_dir: Optional[str] = None, mesh=None
+) -> Dict[str, Any]:
+    """Run the scenario on the fused mesh: the plan compiles to a
+    committee schedule (``sim.run(committee_schedule=…)``), speed tiers map
+    to ``node_speed``, adversaries to the byzantine mask. Same return shape
+    as :func:`p2pfl_tpu.parity.run_fused`."""
+    import optax
+
+    from p2pfl_tpu.parallel.simulation import MeshSimulation
+    from p2pfl_tpu.telemetry.ledger import LEDGERS
+
+    snap = Settings.snapshot()
+    names = scn.node_names
+    x, y, w = scn.data()
+    byz_mask = None
+    attack = scn.byzantine_attack
+    if scn.byzantine:
+        byz_mask = np.zeros(scn.n_nodes, np.float32)
+        for idx, att in scn.byzantine.items():
+            byz_mask[int(idx)] = 1.0
+            attack = att
+    sim = None
+    try:
+        Settings.LEDGER_ENABLED = True
+        LEDGERS.configure(scn.run_id)
+        sim = MeshSimulation(
+            model=scn.template_model(),
+            partitions=(x, y, w),
+            test_data=None,
+            train_set_size=scn.cohort_k,
+            batch_size=scn.batch_size,
+            lr=scn.lr,
+            optimizer=optax.sgd(scn.lr),
+            seed=scn.seed,
+            byzantine_mask=byz_mask,
+            byzantine_attack=attack,
+            node_speed=scn.node_speed_array(),
+            canonical_committee=True,
+            mesh=mesh,
+        )
+        led = sim.attach_ledger(node="mesh-sim", node_names=names)
+        sim.run(
+            scn.rounds, epochs=scn.epochs, warmup=False, rounds_per_call=1,
+            committee_schedule=scn.schedule(),
+        )
+        events = led.canonical_events()
+        path = None
+        if ledger_dir is not None:
+            path = led.dump(os.path.join(ledger_dir, "ledger_mesh-sim.jsonl"))
+        return {
+            "ledger": path,
+            "events": events,
+            "hashes": {
+                ev["round"]: ev["hash"]
+                for ev in events
+                if ev["kind"] == "aggregate_committed" and "hash" in ev
+            },
+        }
+    finally:
+        if sim is not None:
+            sim.close()
+        Settings.restore(snap)
+
+
+__all__ = [
+    "PopulationLearner",
+    "PopulationScenario",
+    "dirichlet_label_counts",
+    "run_scenario_fused",
+    "run_scenario_wire",
+    "stitch_observer_stream",
+]

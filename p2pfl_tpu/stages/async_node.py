@@ -43,6 +43,7 @@ from typing import TYPE_CHECKING, List, Optional, Tuple, Type
 
 from p2pfl_tpu.comm.commands.impl import AsyncContributionCommand, AsyncDoneCommand
 from p2pfl_tpu.config import Settings
+from p2pfl_tpu.population.cohort import wire_cohort_filter
 from p2pfl_tpu.stages.base_node import TrainStage, establish_initial_model
 from p2pfl_tpu.stages.stage import Stage, check_early_stop
 from p2pfl_tpu.telemetry import REGISTRY, TRACER
@@ -69,6 +70,16 @@ def select_participants(node: "Node") -> Tuple[List[str], List[str]]:
     (stragglers excluded; their late contributions still fold on arrival).
     """
     peers = node.protocol.get_neighbors(only_direct=False)
+    # Population-scale cohort sampling (population/cohort.py): with a plan
+    # active, this window solicits only its hash-sampled cohort — the
+    # Papaya fan-in bound, applied at the async scheduler's single
+    # solicitation choke point. Self is included in the candidate pool so
+    # every node derives the same cohort; an empty intersection (stale
+    # membership under churn) falls back to the unfiltered peer set.
+    cohort = wire_cohort_filter(node.state.round or 0, list(peers) + [node.addr])
+    if cohort:
+        in_cohort = set(cohort)
+        peers = [p for p in peers if p in in_cohort]
     obs = node.observatory
     done = node.state.async_done_peers
     try:

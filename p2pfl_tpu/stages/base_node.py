@@ -26,6 +26,7 @@ from p2pfl_tpu.comm.commands.impl import (
 )
 from p2pfl_tpu.comm.envelope import Envelope
 from p2pfl_tpu.config import Settings
+from p2pfl_tpu.population.cohort import wire_cohort_filter
 from p2pfl_tpu.stages.stage import Stage, check_early_stop
 from p2pfl_tpu.telemetry import TRACER, tracing
 from p2pfl_tpu.telemetry.ledger import LEDGERS, canonical_params_hash
@@ -215,6 +216,17 @@ class VoteTrainSetStage(Stage):
         # RTT, and peers' recv:vote_train_set spans share its trace id.
         with TRACER.span("vote_rtt", node=node.addr, round=state.round):
             candidates = list(node.protocol.get_neighbors(only_direct=False)) + [node.addr]
+            # Population-scale cohort sampling (population/cohort.py): when a
+            # cohort plan is active, only the round's hash-sampled cohort is
+            # electable — every node derives the SAME cohort from (seed,
+            # round, names), so ballots agree on the candidate pool and, with
+            # TRAIN_SET_SIZE == K, the election is deterministic. No-op
+            # (identity) when sampling is off; an empty intersection (stale
+            # neighbor view during churn) falls back to the unfiltered pool
+            # rather than stalling the vote.
+            cohort = wire_cohort_filter(state.round or 0, candidates)
+            if cohort:
+                candidates = cohort
             num_votes = min(Settings.TRAIN_SET_SIZE, len(candidates))
             chosen = random.sample(candidates, num_votes)
             weights = [int((random.randint(0, 1000) / (i + 1))) for i in range(num_votes)]
@@ -241,6 +253,10 @@ class VoteTrainSetStage(Stage):
             if (
                 Settings.OVERLAP_TRAIN_DIFFUSE
                 and num_votes == len(candidates)
+                # Under cohort sampling the deterministic election covers
+                # only cohort members — a non-member must not prefit (its
+                # learner is not scheduled for this round).
+                and node.addr in candidates
                 and state.prefit is None
             ):
                 TrainStage._dispatch_prefit(node, state.round or 0)

@@ -726,6 +726,7 @@ def population_snapshot(
     z = np.maximum(0.0, (step - float(step.mean())) / std) if std > 1e-12 else np.zeros(n)
     straggler = lag + z
     order = np.argsort(-straggler, kind="stable")[: max(1, int(top_n))]
+    fill = arrays.get("cohort_fill")
     peers: Dict[str, Any] = {}
     for i in order.tolist():
         peers[node_names[i]] = {
@@ -740,6 +741,12 @@ def population_snapshot(
             "rx_bytes": 0.0,
             "rejections": {},
             "rejected_by_source": {},
+            # Realized solicitation fraction under cohort sampling (the
+            # population engine's fairness metric); None when the run
+            # carried no cohort_fill array — fed_top prints "-" then.
+            "cohort_fill": (
+                round(float(fill[i]), 4) if fill is not None else None
+            ),
             "scores": {
                 "straggler": round(float(straggler[i]), 4),
                 "suspect": round(float(arrays.get("rejections", np.zeros(n))[i]), 4),

@@ -106,8 +106,8 @@ def render(
         title += f" ({len(peers)} tracked + {fleet['overflow_peers']} sketch-folded)"
     header = (
         f"{'PEER':<23} {'ROUND':>7} {'STAGE':<22} {'STEP/S':>8} "
-        f"{'TX MiB':>8} {'RX MiB':>8} {'STALE':>6} {'EPS':>6} {'STRAG':>7} "
-        f"{'SUSP':>7} {'LINK':>6} {'AGE s':>6}"
+        f"{'TX MiB':>8} {'RX MiB':>8} {'STALE':>6} {'EPS':>6} {'COHORT':>7} "
+        f"{'STRAG':>7} {'SUSP':>7} {'LINK':>6} {'AGE s':>6}"
     )
     lines = [
         paint(_BOLD, title),
@@ -135,12 +135,18 @@ def render(
         # "inf" = -1 sentinel (non-private steps void the claim).
         eps = p.get("dp_epsilon")
         eps_s = "-" if eps is None else ("inf" if eps < 0 else f"{eps:.2f}")
+        # Cohort-fill: realized per-round solicitation fraction under the
+        # population engine's cohort sampling; "-" for real-wire peers and
+        # pre-population snapshots (field absent or null).
+        fill = p.get("cohort_fill")
+        fill_s = "-" if fill is None else f"{fill:.2f}"
         row = (
             f"{_short(addr):<23} {round_s:>7} {p.get('stage') or '-':<22.22} "
             f"{p.get('steps_per_s', 0.0):>8.1f} {_mib(p.get('tx_bytes', 0.0)):>8} "
             f"{_mib(p.get('rx_bytes', 0.0)):>8} "
             f"{(f'{stale:.1f}' if stale else '-'):>6} "
             f"{eps_s:>6} "
+            f"{fill_s:>7} "
             f"{s.get('straggler', 0.0):>7.2f} "
             f"{s.get('suspect', 0.0):>7.1f} {s.get('link', 0.0):>6.1f} "
             f"{s.get('age_s', 0.0):>6.1f}"

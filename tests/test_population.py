@@ -1,0 +1,256 @@
+"""Population subsystem: cohort sampler, scenario partitioner, padding,
+engine accounting.
+
+The statistical assertions (coverage fairness, Dirichlet skew) run on FIXED
+seeds — the sampler is a pure function of its inputs, so these are exact
+regression pins, not flaky tolerance tests.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.population.cohort import (
+    CohortPlan,
+    clear_plan,
+    cohort_for_round,
+    cohort_size,
+    committee_schedule,
+    install_plan,
+    wire_cohort_filter,
+)
+from p2pfl_tpu.population.scenarios import dirichlet_label_counts
+
+
+def _names(n: int) -> list:
+    return [f"vnode/{i:05d}" for i in range(n)]
+
+
+# --- sampler determinism ------------------------------------------------------
+
+
+def test_cohort_stream_seeded_and_order_independent():
+    names = _names(24)
+    plan = CohortPlan(seed=9, fraction=0.25, names=tuple(names))
+    stream = [plan.cohort(r, names) for r in range(20)]
+    # Same seed: the identical stream, even from a shuffled name order (the
+    # wire's discovery order is arbitrary; the fused backend's is indexed).
+    shuffled = list(names)
+    np.random.default_rng(0).shuffle(shuffled)
+    again = [plan.cohort(r, shuffled) for r in range(20)]
+    assert again == stream
+    assert all(c == sorted(c) and len(c) == 6 for c in stream)
+    # Different seed: a different stream (the sampler can disagree).
+    other = CohortPlan(seed=10, fraction=0.25, names=tuple(names))
+    assert [other.cohort(r, names) for r in range(20)] != stream
+
+
+def test_wire_filter_matches_fused_schedule():
+    """The two backends' cohort derivations are the same function: the wire
+    filter (ambient plan + live candidate list) must select exactly the
+    names the fused committee schedule indexes, every round."""
+    names = _names(12)
+    plan = CohortPlan(seed=3, fraction=0.5, churn_rate=0.1, names=tuple(names))
+    sched = committee_schedule(plan, names, rounds=8)
+    install_plan(plan)
+    try:
+        for r in range(8):
+            got = wire_cohort_filter(r, names)
+            assert sorted(got) == [names[i] for i in sched[r]]
+    finally:
+        clear_plan()
+
+
+def test_wire_filter_semantics():
+    # No plan installed: identity (as a list), any candidate order.
+    clear_plan()
+    cands = ["c", "a", "b"]
+    assert wire_cohort_filter(0, cands) == cands
+    plan = CohortPlan(seed=1, fraction=0.5)
+    install_plan(plan)
+    try:
+        got = wire_cohort_filter(2, cands)
+        # Subset of the candidates, preserved in CANDIDATE order (the vote
+        # stage's tie-breaks are positional).
+        assert [c for c in cands if c in got] == got
+        assert len(got) == 2
+    finally:
+        clear_plan()
+
+
+def test_committee_schedule_static_k_and_churn_exhaustion():
+    names = _names(10)
+    plan = CohortPlan(seed=5, fraction=0.4, names=tuple(names))
+    sched = committee_schedule(plan, names, rounds=6)
+    assert sched.shape == (6, 4) and sched.dtype == np.int32
+    assert all(list(row) == sorted(row) for row in sched)
+    # A churn trace that can leave < K nodes up must raise, not shrink the
+    # committee (the fused scan's shapes are static).
+    drowned = CohortPlan(
+        seed=5, fraction=0.4, churn_rate=0.95, names=tuple(names)
+    )
+    with pytest.raises(ValueError, match="churn left"):
+        committee_schedule(drowned, names, rounds=50)
+
+
+def test_cohort_size_clamps():
+    assert cohort_size(100, 0.01) == 1
+    assert cohort_size(100, 0.01, min_size=8) == 8
+    assert cohort_size(4, 0.9) == 4
+    assert cohort_size(100, 1.0) == 100
+
+
+# --- statistics ---------------------------------------------------------------
+
+
+def test_cohort_coverage_fairness():
+    """Per-round reshuffle ⇒ long-run participation concentrates at the
+    cohort fraction for EVERY node (no node starved or pinned)."""
+    n, rounds, fraction = 40, 300, 0.2
+    names = _names(n)
+    k = cohort_size(n, fraction)
+    counts = np.zeros(n)
+    for r in range(rounds):
+        for name in cohort_for_round(7, r, names, fraction):
+            counts[names.index(name)] += 1
+    expected = rounds * k / n
+    assert counts.sum() == rounds * k  # exactly K solicited per round
+    assert counts.min() > 0.5 * expected
+    assert counts.max() < 1.5 * expected
+
+
+def test_dirichlet_label_counts_exact_sizes_and_skew():
+    rng = np.random.default_rng(11)
+    n, s, c = 64, 40, 10
+    # Extreme concentration: every node nearly single-class.
+    skewed = dirichlet_label_counts(rng, n, s, c, alpha=0.05)
+    assert skewed.shape == (n, c)
+    assert (skewed.sum(axis=1) == s).all()  # fixed per-node sizes, any alpha
+    assert (skewed.max(axis=1) / s).mean() > 0.7
+    # Near-uniform concentration: no dominant class anywhere.
+    flat = dirichlet_label_counts(rng, n, s, c, alpha=1000.0)
+    assert (flat.sum(axis=1) == s).all()
+    assert (flat.max(axis=1) / s).mean() < 0.25
+
+
+# --- padding invariance (satellite: auto-pad to the mesh axis) ----------------
+
+
+def _tiny_sim(pad_to_multiple):
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.parallel.simulation import MeshSimulation
+
+    rng = np.random.default_rng(0)
+    n, s, feat, classes = 6, 8, 4, 3
+    x = rng.normal(size=(n, s, feat)).astype(np.float32)
+    y = rng.integers(0, classes, size=(n, s)).astype(np.int32)
+    w = np.ones((n, s), np.float32)
+    model = mlp_model(input_shape=(feat,), hidden_sizes=(4,), out_channels=classes, seed=0)
+    return MeshSimulation(
+        model, (x, y, w), train_set_size=3, batch_size=4, seed=0,
+        canonical_committee=True, pad_to_multiple=pad_to_multiple,
+    )
+
+
+def test_padded_population_matches_unpadded():
+    """Zero-weight fillers must be invisible: same committees, same node-0
+    trajectory, bit for bit."""
+    import jax
+
+    sim_a = _tiny_sim(pad_to_multiple=1)   # 6 stays 6
+    sim_b = _tiny_sim(pad_to_multiple=4)   # 6 pads to 8
+    try:
+        assert sim_b.num_nodes == 8 and sim_b.logical_num_nodes == 6
+        res_a = sim_a.run(rounds=2, warmup=False)
+        res_b = sim_b.run(rounds=2, warmup=False)
+        assert np.array_equal(res_a.committees, res_b.committees)
+        pa = jax.tree.map(lambda a: np.asarray(a[0]), sim_a.params_stack)
+        pb = jax.tree.map(lambda a: np.asarray(a[0]), sim_b.params_stack)
+        for la, lb in zip(jax.tree.leaves(pa), jax.tree.leaves(pb)):
+            np.testing.assert_array_equal(la, lb)
+    finally:
+        sim_a.close()
+        sim_b.close()
+
+
+# --- engine accounting --------------------------------------------------------
+
+
+def test_engine_cohort_fill_and_snapshot():
+    from p2pfl_tpu.population import PopulationEngine
+
+    with PopulationEngine(
+        16, cohort_fraction=0.25, seed=2, samples_per_node=8, hidden=(4,)
+    ) as eng:
+        res = eng.run(4)
+        fill = eng.cohort_fill()
+        assert np.isclose(fill.mean() * 16, eng.cohort_k)
+        # Fill is participation/rounds: each node's value is a multiple of
+        # 1/4 and the schedule rows are what was counted.
+        assert fill.sum() * 4 == np.asarray(res.committees).size
+        snap = eng.snapshot(res, top_n=4)
+        assert len(snap["peers"]) == 4
+        assert all(
+            p["cohort_fill"] is not None for p in snap["peers"].values()
+        )
+
+
+def test_engine_checkpoint_resume_replays_cohort_accounting(tmp_path):
+    from p2pfl_tpu.management.checkpoint import FLCheckpointer
+    from p2pfl_tpu.population import PopulationEngine
+    from p2pfl_tpu.telemetry.ledger import canonical_params_hash
+
+    kw = dict(cohort_fraction=0.5, seed=4, samples_per_node=8, hidden=(4,))
+    with PopulationEngine(8, **kw) as ref:
+        ref.run(3)
+        ref_fill = ref.cohort_fill()
+        ref_hash = canonical_params_hash(ref.gather_params(0))
+    ckpt = FLCheckpointer(str(tmp_path))
+    with PopulationEngine(8, **kw) as victim:
+        victim.run(2)
+        assert victim.save_to(ckpt)
+    with PopulationEngine(8, **kw) as healed:
+        assert healed.load_from(ckpt) == 2
+        healed.run(1)
+        assert canonical_params_hash(healed.gather_params(0)) == ref_hash
+        np.testing.assert_allclose(healed.cohort_fill(), ref_fill)
+
+
+# --- both backends, end to end ------------------------------------------------
+
+
+@pytest.mark.slow
+def test_scenario_parity_under_cohort_sampling(tmp_path):
+    """One seeded scenario (Dirichlet skew, 50% cohort), both backends:
+    the rotating-observer wire stream must align with the fused ledger and
+    every round's aggregate hash must be bit-exact."""
+    import importlib.util
+    import os
+
+    from p2pfl_tpu.population.scenarios import (
+        PopulationScenario,
+        run_scenario_fused,
+        run_scenario_wire,
+    )
+
+    spec = importlib.util.spec_from_file_location(
+        "parity_diff",
+        os.path.join(os.path.dirname(__file__), "..", "scripts", "parity_diff.py"),
+    )
+    parity_diff = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(parity_diff)
+
+    scn = PopulationScenario(
+        seed=77, n_nodes=4, rounds=2, samples_per_node=16, batch_size=8,
+        hidden=(8,), cohort_fraction=0.5, dirichlet_alpha=0.3,
+    )
+    wire = run_scenario_wire(scn, ledger_dir=str(tmp_path), timeout_s=180.0)
+    # Every node — member or not — committed the same bits each round.
+    ref = wire["hashes"][scn.node_names[0]]
+    assert len(ref) == scn.rounds
+    assert all(wire["hashes"][n] == ref for n in scn.node_names)
+    fused = run_scenario_fused(scn, ledger_dir=str(tmp_path))
+    report = parity_diff.compare_ledgers(wire["stitched"], fused["events"])
+    assert report["status"] == "OK", report.get("first_divergence")
+    assert report["hashes_compared"] == scn.rounds

@@ -306,14 +306,25 @@ def test_eval_every_reports_only_evaluated_rounds():
     np.testing.assert_allclose(res.test_acc, res2.test_acc, atol=1e-5)
 
 
-def test_indivisible_population_warns_loudly():
-    """N % mesh-nodes != 0 de-shards every population buffer (replication);
-    that fallback must be loud, not silent (round-3 verdict)."""
+def test_indivisible_population_pads_and_stays_sharded():
+    """N % mesh-nodes != 0 used to de-shard every population buffer
+    (replication, with a loud warning — round-3 verdict). Auto-padding
+    replaced that fallback: the population is padded to the mesh axis with
+    zero-weight fillers and every stacked buffer stays node-sharded."""
     parts6 = synthetic_mnist(n_train=384, n_test=64).generate_partitions(
         6, RandomIIDPartitionStrategy
     )
-    with pytest.warns(UserWarning, match="not divisible by the mesh"):
-        MeshSimulation(mlp_model(seed=0), parts6, train_set_size=2, batch_size=32, seed=0)
+    sim = MeshSimulation(
+        mlp_model(seed=0), parts6, train_set_size=2, batch_size=32, seed=0
+    )
+    assert sim.logical_num_nodes == 6
+    assert sim.num_nodes % sim.mesh.shape["nodes"] == 0
+    # Stacked leaves are sharded over the (padded) nodes axis, not replicated.
+    leaf = jax.tree.leaves(sim.params_stack)[0]
+    assert leaf.shape[0] == sim.num_nodes
+    assert "nodes" in leaf.sharding.spec
+    # Fillers carry zero samples: they cannot contribute aggregate weight.
+    assert float(np.asarray(sim.sample_mask[6:]).sum()) == 0.0
 
 
 @pytest.mark.slow

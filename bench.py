@@ -203,10 +203,28 @@ def _git_sha() -> str:
 _FORCED_CPU_AT_START = "cpu" in (os.environ.get("JAX_PLATFORMS") or "").lower()
 
 #: Why the last TPU probe failed ("tpu_probe_timeout" | "tpu_absent" |
-#: "tpu_probe_error"); None while no probe has failed. BENCH_r03–r05
-#: degraded silently and the trajectory doc had to reverse-engineer which —
-#: the meta block now records it.
+#: "tpu_probe_error" | "assumed_backend"); None while no probe has failed.
+#: BENCH_r03–r05 degraded silently and the trajectory doc had to
+#: reverse-engineer which — the meta block now records it.
 _TPU_FAIL_REASON: list = [None]
+
+#: Per-invocation probe verdict cache: ONE probe, all arms. Only DEFINITIVE
+#: verdicts are cached — a found chip ("up", kind) or a clean negative
+#: ("down", "tpu_absent" / "tpu_probe_error"). A timeout is a transient
+#: non-answer the wait ladder must keep re-asking, so it is never cached.
+_PROBE_CACHE: list = [None]
+
+
+def _assumed_backend() -> str:
+    """The validated ``P2PFL_TPU_BENCH_ASSUME_BACKEND`` knob ("" when the
+    operator made no assertion). "cpu" skips every probe and the whole wait
+    ladder (the r03+ budget burner) and stamps ``fallback_reason=
+    "assumed_backend"``; "tpu" asserts the tunnel is up. The orchestrator
+    also SELF-propagates its first settled verdict through this knob into
+    per-arm subprocesses."""
+    from p2pfl_tpu.config import Settings  # light import: config only
+
+    return str(Settings.BENCH_ASSUME_BACKEND)
 
 
 def _fallback_reason() -> str | None:
@@ -247,6 +265,13 @@ def _emit(out: dict, seed=None, backend=None, fallback_reason=None) -> None:
 def probe_backend(attempts: int = 2, timeout: float = 180.0) -> str:
     """Bounded, retried backend-init probe: a flaky TPU client must produce
     a JSON error line, not a hang or a bare rc=1 (round-1/2 failure mode)."""
+    if _assumed_backend() == "cpu":
+        # Operator (or the orchestrator's settled first verdict) asserts no
+        # chip: pin CPU before jax initializes instead of burning the
+        # timeout ladder against a dead tunnel. fallback_reason still
+        # stamps how this arm ended up on CPU.
+        _TPU_FAIL_REASON[0] = "assumed_backend"
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
     last_err: list[str] = ["backend probe never ran"]
 
     for attempt in range(1, attempts + 1):
@@ -302,6 +327,18 @@ def _subprocess_tpu_probe(
     (and perf_diff's backend refusal) keep firing.
     Returns the device kind (e.g. "TPU v5 lite") or None.
     """
+    assumed = _assumed_backend()
+    if assumed == "cpu":
+        _TPU_FAIL_REASON[0] = "assumed_backend"
+        return None
+    if assumed == "tpu":
+        return "TPU (assumed)"
+    if _PROBE_CACHE[0] is not None:
+        state, payload = _PROBE_CACHE[0]
+        if state == "up":
+            return payload
+        _TPU_FAIL_REASON[0] = payload
+        return None
     if timeout is None:
         timeout = _probe_timeout()
     env = dict(os.environ)
@@ -320,10 +357,13 @@ def _subprocess_tpu_probe(
             line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
             platform, _, kind = line.partition("|")
             if platform.lower() == "tpu" and kind:
+                _PROBE_CACHE[0] = ("up", kind)
                 return kind
             # The probe RAN and found no TPU platform — a different failure
-            # (and a different fix) than a hung tunnel.
+            # (and a different fix) than a hung tunnel. Definitive:
+            # re-asking cannot change it, so the verdict caches.
             _TPU_FAIL_REASON[0] = "tpu_absent"
+            _PROBE_CACHE[0] = ("down", "tpu_absent")
             return None
         except subprocess.TimeoutExpired:
             _TPU_FAIL_REASON[0] = "tpu_probe_timeout"
@@ -334,6 +374,7 @@ def _subprocess_tpu_probe(
                 )
         except Exception:  # noqa: BLE001 — a broken probe reads as "down"
             _TPU_FAIL_REASON[0] = "tpu_probe_error"
+            _PROBE_CACHE[0] = ("down", "tpu_probe_error")
             traceback.print_exc(file=sys.stderr)
             return None
     return None
@@ -344,6 +385,10 @@ def wait_for_tpu(deadline: float, probe_timeout: float | None = None) -> str | N
     answers or ``deadline`` (time.monotonic clock) nears. The outage
     pattern is hours-scale with spontaneous recovery, so patience here is
     the whole game — six minutes of it lost rounds 3 and 4."""
+    if _assumed_backend() == "cpu":
+        _phase("wait ladder skipped: P2PFL_TPU_BENCH_ASSUME_BACKEND=cpu")
+        _TPU_FAIL_REASON[0] = "assumed_backend"
+        return None
     if probe_timeout is None:
         probe_timeout = _probe_timeout()
     attempt = 0
@@ -361,6 +406,12 @@ def wait_for_tpu(deadline: float, probe_timeout: float | None = None) -> str | N
         if kind:
             _phase(f"wait ladder: tunnel UP after {attempt} probe(s): {kind}")
             return kind
+        if _PROBE_CACHE[0] is not None and _PROBE_CACHE[0][0] == "down":
+            # A clean negative verdict is definitive for the whole
+            # invocation — sleeping the ladder against it is the r03+
+            # budget burn this cache exists to stop.
+            _phase(f"wait ladder: definitive verdict ({_PROBE_CACHE[0][1]}) — done")
+            return None
         # Short sleeps early (catch a quick flap), 120s cruise after.
         time.sleep(min(120.0, 30.0 * attempt))
 
@@ -4399,6 +4450,266 @@ def run_population_bench() -> None:
     _emit(out, seed=locals().get("seed"), backend="cpu")
 
 
+def run_asyncpop_bench() -> None:
+    """Subprocess-style mode ``--asyncpop``: async-window population
+    acceptance run, four arms, all on the CPU venue (protocol/scale bench).
+
+    **Throughput arm** (``P2PFL_TPU_ASYNCPOP_BENCH_NODES`` vnodes, seeded
+    slow tier ``(1,1,1,2,5)``): one :class:`AsyncPopulationEngine` run of
+    ``P2PFL_TPU_ASYNCPOP_BENCH_WINDOWS`` windows; per-contribution
+    simulated-time throughput must be ≥2x the sync barrier's over the SAME
+    cohort stream at equal participation (``simulated_barrier_time`` over
+    the matching committee schedule — the sync engine pays the slowest
+    committee member every round; async windows close on fill).
+
+    **IID control arm**: same engine vs the sync fused baseline at zero
+    delay — final accuracy delta must be exactly 0.0 pp AND the global
+    params hash bit-identical (the zero-lag windows ARE the sync rounds).
+
+    **Flash-crowd arm**: the ``flash`` arrival trace (10x spike) must
+    sustain window throughput with bounded staleness: fold lag is capped by
+    ``ASYNCPOP_MAX_LAG`` by construction, and the scheduler's
+    stall-patience backpressure must keep the pending queue bounded.
+
+    **Ceiling arm**: doubling vnode loop (donation on, bf16 state, lean
+    per-vnode data) toward ``P2PFL_TPU_ASYNCPOP_BENCH_CEILING``; records
+    the max vnode count that completed windows and the limiting resource
+    if below 1M. Writes ``artifacts/ASYNCPOP_BENCH.json``.
+    """
+    out: dict = {}
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"  # protocol/scale bench: CPU venue
+        import numpy as np
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from p2pfl_tpu.config import Settings
+        from p2pfl_tpu.parallel.simulation import simulated_barrier_time
+        from p2pfl_tpu.population import AsyncPopulationEngine, PopulationEngine
+        from p2pfl_tpu.population.cohort import committee_schedule
+        from p2pfl_tpu.telemetry.ledger import canonical_params_hash
+
+        n = int(Settings.ASYNCPOP_BENCH_NODES)
+        windows = int(Settings.ASYNCPOP_BENCH_WINDOWS)
+        fraction = float(Settings.ASYNCPOP_BENCH_COHORT)
+        seed = 42
+        tiers = (1.0, 1.0, 1.0, 2.0, 5.0)
+        art = os.path.join(REPO, "artifacts")
+        os.makedirs(art, exist_ok=True)
+
+        # --- arm A: simulated-time throughput vs the sync barrier -------------
+        _phase(f"asyncpop throughput arm: n={n}, {windows} windows, cohort {fraction:g}")
+        t0 = time.monotonic()
+        eng = AsyncPopulationEngine(
+            n, cohort_fraction=fraction, seed=seed, speed_tiers=tiers,
+        )
+        build_s = time.monotonic() - t0
+        try:
+            cohort_k = eng.cohort_k
+            res = eng.run(windows, eval_every=max(1, windows // 2), warmup=True)
+            summ = res.summary()
+            snap_path = os.path.join(art, "asyncpop_snapshot.json")
+            eng.snapshot(res, path=snap_path)
+            node_speed = eng.node_speed
+            sync_plan = eng.plan.cohort_plan
+            names = eng.names
+        finally:
+            eng.close()
+        contribs = summ["contributions"]
+        async_ticks = summ["sim_time_ticks"]
+        # Equal participation: enough sync rounds to solicit the same number
+        # of contributions, each round paying its slowest member's tier.
+        sync_rounds = max(1, int(np.ceil(contribs / cohort_k)))
+        sync_comm = committee_schedule(sync_plan, names, sync_rounds, start_round=0)
+        sync_ticks = simulated_barrier_time(sync_comm, node_speed)
+        async_tpt = contribs / max(async_ticks, 1e-12)
+        sync_tpt = (sync_rounds * cohort_k) / max(sync_ticks, 1e-12)
+        speedup = async_tpt / max(sync_tpt, 1e-12)
+        if speedup < 2.0:
+            raise AssertionError(
+                f"async simulated-time throughput {async_tpt:.2f} contrib/tick "
+                f"is only {speedup:.2f}x the sync barrier's {sync_tpt:.2f} "
+                "(acceptance floor: 2x)"
+            )
+        _phase(
+            f"  n={n}: {res.seconds_per_window:.3f}s/window wall, "
+            f"{speedup:.1f}x sync simulated throughput "
+            f"({async_tpt:.1f} vs {sync_tpt:.1f} contrib/tick), "
+            f"mean lag {summ['mean_lag']:.2f}"
+        )
+
+        # --- arm B: IID zero-delay control vs the sync fused baseline ---------
+        n_ctl, r_ctl = 256, 5
+        ctl_kw = dict(
+            cohort_fraction=0.25, seed=seed + 1, samples_per_node=16,
+            hidden=(16,),
+        )
+        _phase(f"asyncpop IID control arm: n={n_ctl}, {r_ctl} rounds")
+        with PopulationEngine(n_ctl, **ctl_kw) as sync_eng:
+            sync_res = sync_eng.run(r_ctl)
+            sync_acc = float(sync_res.test_acc[-1])
+            sync_hash = canonical_params_hash(sync_eng.gather_params(0))
+        with AsyncPopulationEngine(n_ctl, **ctl_kw) as async_eng:
+            async_res = async_eng.run(r_ctl)
+            async_acc = float(async_res.test_acc[-1])
+            async_hash = canonical_params_hash(async_eng.global_params())
+        acc_delta_pp = abs(async_acc - sync_acc) * 100.0
+        if async_hash != sync_hash:
+            raise AssertionError(
+                f"IID control diverged: async hash {async_hash[:16]}… != "
+                f"sync {sync_hash[:16]}… — zero-lag windows must BE the "
+                "sync rounds"
+            )
+        if acc_delta_pp != 0.0:
+            raise AssertionError(
+                f"IID control accuracy delta {acc_delta_pp:.4f} pp != 0.0"
+            )
+        _phase(f"  IID control holds: acc {async_acc:.3f}, hash bit-identical")
+
+        # --- arm C: flash crowd sustains throughput, staleness bounded --------
+        n_fc, period = 4096, 8
+        fc_windows = 3 * period
+        _phase(f"asyncpop flash-crowd arm: n={n_fc}, {fc_windows} windows, 10x spike")
+        with AsyncPopulationEngine(
+            n_fc, cohort_fraction=0.05, seed=seed + 2, speed_tiers=tiers,
+            trace="flash", trace_period=period,
+        ) as fc_eng:
+            fc_k = fc_eng.cohort_k
+            fc_res = fc_eng.run(fc_windows, eval_every=fc_windows)
+            fc_sched = fc_res.schedule
+            fc_patience = fc_eng.plan.resolved()[2]
+        fc_summ = fc_res.summary()
+        fc_max_lag = int(fc_sched.lag[fc_sched.present].max()) if fc_sched.present.any() else 0
+        max_queue = int(fc_sched.queue_depth.max())
+        queue_bound = (fc_patience + 1) * fc_k
+        if fc_summ["contributions"] == 0:
+            raise AssertionError("flash-crowd arm folded zero contributions")
+        stalled = fc_summ["close_reasons"]["stall"]
+        if stalled > fc_windows // 2:
+            raise AssertionError(
+                f"flash-crowd arm stalled {stalled}/{fc_windows} windows — "
+                "throughput not sustained"
+            )
+        if fc_max_lag > int(Settings.ASYNCPOP_MAX_LAG):
+            raise AssertionError(
+                f"flash-crowd fold lag {fc_max_lag} exceeded the "
+                f"ASYNCPOP_MAX_LAG={Settings.ASYNCPOP_MAX_LAG} bound"
+            )
+        if max_queue > queue_bound:
+            raise AssertionError(
+                f"flash-crowd pending queue {max_queue} blew past the "
+                f"stall-patience backpressure bound {queue_bound}"
+            )
+        _phase(
+            f"  flash crowd holds: {fc_summ['contributions']} contribs, "
+            f"max lag {fc_max_lag} <= {Settings.ASYNCPOP_MAX_LAG}, "
+            f"max queue {max_queue} <= {queue_bound}, "
+            f"{fc_sched.dropped.sum()} dropped"
+        )
+
+        # --- arm D: vnode ceiling with donation + bf16 state ------------------
+        ceiling_target = int(Settings.ASYNCPOP_BENCH_CEILING)
+        probe_n = min(max(n, 125_000), ceiling_target)
+        max_ok, ceiling_log, limit_reason = 0, [], None
+        _phase(f"asyncpop ceiling arm: doubling from {probe_n} toward {ceiling_target}")
+        while probe_n <= ceiling_target:
+            try:
+                t0 = time.monotonic()
+                with AsyncPopulationEngine(
+                    probe_n, cohort_fraction=min(fraction, 2048 / probe_n),
+                    seed=seed + 3, speed_tiers=tiers,
+                    samples_per_node=8, feature_dim=16,
+                    state_dtype="bfloat16",
+                ) as ceil_eng:
+                    ceil_res = ceil_eng.run(2, eval_every=4)
+                dt = time.monotonic() - t0
+                max_ok = probe_n
+                ceiling_log.append(
+                    {"nodes": probe_n, "sec_per_window": round(ceil_res.seconds_per_window, 3),
+                     "total_s": round(dt, 1)}
+                )
+                _phase(f"  ceiling: n={probe_n} OK ({ceil_res.seconds_per_window:.2f}s/window)")
+            except (MemoryError, Exception) as e:  # noqa: BLE001 — record, stop
+                limit_reason = (
+                    f"{type(e).__name__} at n={probe_n}: {str(e)[:300]}"
+                )
+                _phase(f"  ceiling: n={probe_n} FAILED — {limit_reason}")
+                break
+            if probe_n == ceiling_target:
+                break
+            probe_n = min(probe_n * 2, ceiling_target)
+        if max_ok >= ceiling_target:
+            limiting_resource = None
+        elif limit_reason is None:
+            limiting_resource = "wall-clock budget (doubling loop ended early)"
+        else:
+            limiting_resource = (
+                "host RAM for the [N]-stacked per-vnode data arrays — the "
+                "history-ring engine carries no per-vnode params, so data "
+                f"rows dominate ({limit_reason})"
+            )
+
+        out = {
+            "metric": "asyncpop_speedup_vs_sync_barrier",
+            "value": round(speedup, 3),
+            "unit": f"x sim-time throughput at n={n}, cohort K={cohort_k}",
+            "vs_baseline": None,
+            "extra": {
+                "nodes": n,
+                "windows": windows,
+                "cohort_k": cohort_k,
+                "engine_build_s": round(build_s, 2),
+                "sec_per_window_wall": round(res.seconds_per_window, 4),
+                "contributions": contribs,
+                "async_sim_ticks": round(async_ticks, 1),
+                "sync_sim_ticks": round(sync_ticks, 1),
+                "async_contribs_per_tick": round(async_tpt, 2),
+                "sync_contribs_per_tick": round(sync_tpt, 2),
+                "mean_fold_lag": round(summ["mean_lag"], 3),
+                "close_reasons": summ["close_reasons"],
+                "snapshot": snap_path,
+                "iid_control": {
+                    "nodes": n_ctl,
+                    "rounds": r_ctl,
+                    "acc_delta_pp": acc_delta_pp,
+                    "params_hash_match": True,
+                    "final_acc": round(async_acc, 4),
+                },
+                "flash_crowd": {
+                    "nodes": n_fc,
+                    "windows": fc_windows,
+                    "period": period,
+                    "contributions": fc_summ["contributions"],
+                    "max_fold_lag": fc_max_lag,
+                    "max_lag_bound": int(Settings.ASYNCPOP_MAX_LAG),
+                    "max_queue_depth": max_queue,
+                    "queue_bound": queue_bound,
+                    "dropped": int(fc_sched.dropped.sum()),
+                    "close_reasons": fc_summ["close_reasons"],
+                },
+                "ceiling": {
+                    "target": ceiling_target,
+                    "max_vnodes_ok": max_ok,
+                    "donation": True,
+                    "state_dtype": "bfloat16",
+                    "limiting_resource": limiting_resource,
+                    "log": ceiling_log,
+                },
+            },
+        }
+        out["meta"] = _bench_meta(seed=seed, backend="cpu")
+        with open(os.path.join(art, "ASYNCPOP_BENCH.json"), "w") as f:
+            json.dump(out, f, indent=1)
+        _phase(
+            f"asyncpop bench done: {speedup:.1f}x sync, IID 0.0 pp, "
+            f"flash crowd bounded, ceiling {max_ok}"
+        )
+    except Exception as e:  # noqa: BLE001
+        traceback.print_exc(file=sys.stderr)
+        out["error"] = f"{type(e).__name__}: {e}"
+    _emit(out, seed=locals().get("seed"), backend="cpu")
+
+
 def run_critical_path_bench() -> None:
     """Subprocess-style mode ``--critical-path``: performance-attribution
     acceptance run.
@@ -5268,6 +5579,10 @@ def main() -> None:
             )
 
         # --- tunnel is up: full measurement, subprocess-contained ---------
+        # Self-propagate the settled verdict: every arm subprocess below
+        # inherits it through the knob and skips its own probe ladder (one
+        # probe, all arms). setdefault — an operator assertion wins.
+        os.environ.setdefault("P2PFL_TPU_BENCH_ASSUME_BACKEND", "tpu")
         remaining = soft_budget - (time.monotonic() - t_start)
         metric_cap = max(420.0, remaining - 420.0)  # keep ~7 min for baseline
         _phase(f"TPU up ({kind}): metric subprocess (cap {metric_cap:.0f}s)")
@@ -5354,6 +5669,8 @@ if __name__ == "__main__":
         run_observatory_bench()
     elif "--fleetobs" in sys.argv:
         run_fleetobs_bench()
+    elif "--asyncpop" in sys.argv:
+        run_asyncpop_bench()
     elif "--population" in sys.argv:
         run_population_bench()
     elif "--critical-path" in sys.argv:

@@ -56,6 +56,18 @@ def _env_float(name: str, default: float, lo: float, hi: float) -> float:
     return v
 
 
+def _env_choice(name: str, default: str, choices: tuple) -> str:
+    """Fail-fast enumerated env override: the value must be one of
+    ``choices`` (a typo'd backend/trace name fails at import, not after a
+    100k-vnode warmup)."""
+    v = str(_env_override(name, default))
+    if v not in choices:
+        raise ValueError(
+            f"P2PFL_TPU_{name}={v!r} must be one of {sorted(choices)}"
+        )
+    return v
+
+
 class Settings:
     """Process-wide tunables.
 
@@ -422,6 +434,47 @@ class Settings:
     POP_BENCH_ROUNDS: int = _env_int("POP_BENCH_ROUNDS", 10, 1, 10_000)
     POP_BENCH_COHORT: float = _env_float("POP_BENCH_COHORT", 0.01, 0.0, 1.0)
 
+    # --- async population windows (population/async_engine.py) --------------
+    # FedBuff-style windows over the fused mesh: each scanned step is one
+    # WINDOW, fill target = FILL_FRACTION of the solicited cohort K (clamped
+    # to >= 1). A window past its fill target closes "fill"; one that sat
+    # TIMEOUT_TICKS virtual ticks without reaching it closes "timeout"; an
+    # EMPTY window is tolerated for STALL_PATIENCE consecutive windows (the
+    # backpressure rule of arxiv 2208.09740) before closing "stall" with the
+    # global carried unchanged. MAX_LAG bounds both the staleness-anchor
+    # history ring and the fold (contributions older are dropped+counted),
+    # mirroring ASYNC_MAX_STALENESS on the wire.
+    ASYNCPOP_FILL_FRACTION: float = _env_float("ASYNCPOP_FILL_FRACTION", 0.5, 0.0, 1.0)
+    ASYNCPOP_TIMEOUT_TICKS: int = _env_int("ASYNCPOP_TIMEOUT_TICKS", 8, 1, 1 << 16)
+    ASYNCPOP_STALL_PATIENCE: int = _env_int("ASYNCPOP_STALL_PATIENCE", 4, 1, 1 << 16)
+    ASYNCPOP_MAX_LAG: int = _env_int("ASYNCPOP_MAX_LAG", 4, 1, 64)
+    # Population-state dtype for the async engine's model/optimizer stacks:
+    # bfloat16 halves the dominant per-vnode memory term when pushing the
+    # vnode ceiling (bench ceiling arm); float32 is the parity default (the
+    # wire path is f32, so bf16 state is NOT bit-comparable).
+    ASYNCPOP_STATE_DTYPE: str = _env_choice(
+        "ASYNCPOP_STATE_DTYPE", "float32", ("float32", "bfloat16")
+    )
+    # Arrival-trace process feeding window fill targets + per-vnode delays
+    # (population/arrivals.py): uniform (constant intensity), diurnal
+    # (sinusoid of period ARRIVAL_TRACE_PERIOD windows), regional (three
+    # phase-shifted diurnal waves), flash (ARRIVAL_FLASH_MULT x spike over
+    # the middle fifth of the run).
+    ASYNCPOP_ARRIVAL_TRACE: str = _env_choice(
+        "ASYNCPOP_ARRIVAL_TRACE", "uniform",
+        ("uniform", "diurnal", "regional", "flash"),
+    )
+    ARRIVAL_TRACE_PERIOD: int = _env_int("ARRIVAL_TRACE_PERIOD", 24, 2, 1 << 16)
+    ARRIVAL_FLASH_MULT: float = _env_float("ARRIVAL_FLASH_MULT", 10.0, 1.0, 1000.0)
+    # bench.py --asyncpop shape (overridable for CI-scale smoke runs);
+    # CEILING caps the vnode-ceiling doubling probe.
+    ASYNCPOP_BENCH_NODES: int = _env_int("ASYNCPOP_BENCH_NODES", 100_000, 8, 1 << 24)
+    ASYNCPOP_BENCH_WINDOWS: int = _env_int("ASYNCPOP_BENCH_WINDOWS", 12, 1, 10_000)
+    ASYNCPOP_BENCH_COHORT: float = _env_float("ASYNCPOP_BENCH_COHORT", 0.01, 0.0, 1.0)
+    ASYNCPOP_BENCH_CEILING: int = _env_int(
+        "ASYNCPOP_BENCH_CEILING", 1_000_000, 8, 1 << 26
+    )
+
     # --- bench TPU probe ----------------------------------------------------
     # Per-attempt timeout for the throwaway TPU probe subprocess bench.py
     # spawns before committing to the chip (BENCH_r03-r05 regression: hung
@@ -429,6 +482,14 @@ class Settings:
     # value fails at import; bench.py retries one extra probe on timeout and
     # stamps fallback_reason either way so perf_diff's backend refusal fires.
     BENCH_PROBE_TIMEOUT: float = _env_float("BENCH_PROBE_TIMEOUT", 90.0, 1.0, 3600.0)
+    # Skip the probe + wait ladder entirely and assume this backend ("cpu"
+    # or "tpu"; empty = probe as usual). bench.py also self-propagates the
+    # first probe's verdict through this knob into its per-arm subprocesses
+    # so one invocation probes ONCE — fallback_reason is still stamped
+    # ("assumed_backend") so perf_diff's backend refusal keeps working.
+    BENCH_ASSUME_BACKEND: str = _env_choice(
+        "BENCH_ASSUME_BACKEND", "", ("", "cpu", "tpu")
+    )
 
     # Continuous performance profiling (management/profiler.py): when set,
     # the stage machine captures ONE windowed jax.profiler device trace of

@@ -2,6 +2,7 @@
 
 from p2pfl_tpu.learning.aggregators.async_buffer import (  # noqa: F401
     AsyncBufferedAggregator,
+    staleness_discount,
     staleness_weight,
 )
 from p2pfl_tpu.learning.aggregators.base import Aggregator  # noqa: F401
@@ -22,5 +23,5 @@ from p2pfl_tpu.learning.aggregators.scaffold import Scaffold  # noqa: F401
 __all__ = [
     "Aggregator", "AsyncBufferedAggregator", "CanonicalFedAvg", "FedAvg",
     "FedMedian", "GeometricMedian", "Krum", "MaskedFedAvg", "MultiKrum",
-    "TrimmedMean", "Scaffold", "staleness_weight",
+    "TrimmedMean", "Scaffold", "staleness_discount", "staleness_weight",
 ]

@@ -73,16 +73,33 @@ _WINDOW_CLOSE = REGISTRY.counter(
 )
 
 
-def staleness_weight(lag: int, alpha: Optional[float] = None) -> float:
-    """Polynomial staleness discount ``(1 + lag) ** -alpha``.
+def staleness_discount(lag, alpha) -> jnp.ndarray:
+    """THE staleness formula — a pure, jittable ``(1 + max(lag, 0)) ** -alpha``.
 
-    Monotonically non-increasing in ``lag``; exactly ``1.0`` at ``lag = 0``
-    for every alpha (which is what makes a fresh window bit-exact FedAvg),
-    and identically ``1.0`` for ``alpha = 0`` (discount disabled).
+    Single source of truth for both execution paths: the wire buffer's
+    :meth:`AsyncBufferedAggregator.aggregate_weighted` and the fused async
+    window fold (:mod:`p2pfl_tpu.population.async_engine`) both weight a
+    lag-``l`` contribution by ``num_samples * staleness_discount(l, alpha)``
+    through this one function, which is what makes their aggregates
+    bit-comparable. Accepts scalars or arrays; float32 in, float32 out —
+    the dtype the weighted-FedAvg kernel consumes.
+
+    Exactly ``1.0`` at ``lag = 0`` for every alpha (``1.0 ** -a == 1.0``
+    bit-for-bit, so a fresh window aggregates as plain FedAvg) and
+    identically ``1.0`` for ``alpha = 0`` (discount disabled).
+    """
+    lag_f = jnp.maximum(jnp.asarray(lag, jnp.float32), jnp.float32(0.0))
+    return (jnp.float32(1.0) + lag_f) ** (-jnp.float32(alpha))
+
+
+def staleness_weight(lag: int, alpha: Optional[float] = None) -> float:
+    """Host-scalar convenience wrapper over :func:`staleness_discount`
+    (Settings-defaulted alpha, int lag). Monotonically non-increasing in
+    ``lag``; exactly ``1.0`` at ``lag = 0`` — see the pure function for
+    the bit-exactness contract.
     """
     a = Settings.ASYNC_STALENESS_ALPHA if alpha is None else float(alpha)
-    lag = max(0, int(lag))
-    return float((1.0 + lag) ** (-a))
+    return float(staleness_discount(max(0, int(lag)), a))
 
 
 class AsyncBufferedAggregator:
@@ -259,19 +276,19 @@ class AsyncBufferedAggregator:
     ) -> ModelHandle:
         """Staleness-weighted FedAvg over ``models``.
 
-        Weights are ``num_samples * staleness_weight(lag)``; at all-zero lag
-        this is float-for-float the same kernel invocation as
-        :meth:`FedAvg.aggregate` (weights reduce to the plain sample counts),
-        hence bit-exact.
+        Weights are ``num_samples * staleness_discount(lag, alpha)`` computed
+        as a float32 product — the SAME float order as the fused window fold
+        in :mod:`p2pfl_tpu.population.async_engine`, so the two paths'
+        aggregates are bit-comparable at any lag, and at all-zero lag this is
+        float-for-float the same kernel invocation as :meth:`FedAvg.aggregate`
+        (the discount is exactly 1.0, weights reduce to the plain sample
+        counts), hence bit-exact.
         """
+        a = Settings.ASYNC_STALENESS_ALPHA if alpha is None else float(alpha)
         stacked = agg_ops.tree_stack([m.params for m in models])
         weights = jnp.asarray(
-            [
-                m.get_num_samples() * staleness_weight(lag, alpha)
-                for m, lag in zip(models, lags)
-            ],
-            jnp.float32,
-        )
+            [m.get_num_samples() for m in models], jnp.float32
+        ) * staleness_discount(jnp.asarray([int(l) for l in lags]), a)
         out = agg_ops.fedavg(stacked, weights)
         contributors: List[str] = []
         for m in models:
@@ -287,4 +304,4 @@ class AsyncBufferedAggregator:
         self._event.set()
 
 
-__all__ = ["AsyncBufferedAggregator", "staleness_weight"]
+__all__ = ["AsyncBufferedAggregator", "staleness_discount", "staleness_weight"]

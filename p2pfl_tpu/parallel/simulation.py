@@ -174,6 +174,25 @@ class SimulationResult:
         }
 
 
+def simulated_barrier_time(
+    committees: np.ndarray, node_speed: Optional[np.ndarray]
+) -> float:
+    """Virtual ticks a SYNC barrier run costs over ``committees`` rows:
+    every round waits for its slowest committee member's speed tier (one
+    tick = one tier-1.0 device round). This is the denominator the async
+    window engine's ``sim_time_ticks`` is compared against in
+    ``bench.py --asyncpop`` — async windows close on fill, so a tier-5
+    straggler costs its own lagged fold, not five ticks of everyone's
+    barrier."""
+    comm = np.asarray(committees)
+    if comm.ndim != 2:
+        raise ValueError(f"committees must be [rounds, k], got {comm.shape}")
+    if node_speed is None:
+        return float(comm.shape[0])
+    speed = np.asarray(node_speed, np.float64)
+    return float(speed[comm].max(axis=1).sum())
+
+
 def vote_committee(key: jax.Array, n: int, k: int) -> jax.Array:
     """The reference's committee election as a jitted kernel.
 

@@ -21,8 +21,27 @@ The shared primitive is :mod:`p2pfl_tpu.population.cohort`: an
 order-independent hash sampler both backends call with the same
 ``(seed, round, names)`` — cohort equality across backends is by
 construction, not by luck.
+
+The async half (PR 16) rides the same primitive:
+:mod:`p2pfl_tpu.population.arrivals` streams trace-driven arrival windows
+from the blake2b cohort stream, and
+:mod:`p2pfl_tpu.population.async_engine` scans those *windows* (FedBuff)
+instead of barrier rounds on the fused mesh — staleness-weighted folds,
+history-ring anchors, bit-exact against both the sync fused engine (zero
+lag) and the wire async buffer (seeded small-n parity).
 """
 
+from p2pfl_tpu.population.arrivals import (
+    AsyncWindowPlan,
+    WindowSchedule,
+    compile_window_schedule,
+    trace_intensity,
+)
+from p2pfl_tpu.population.async_engine import (
+    AsyncPopulationEngine,
+    AsyncRunResult,
+    wire_window_replay,
+)
 from p2pfl_tpu.population.cohort import (
     CohortPlan,
     active_plan,
@@ -40,15 +59,22 @@ from p2pfl_tpu.population.sharding import (
 )
 
 __all__ = [
+    "AsyncPopulationEngine",
+    "AsyncRunResult",
+    "AsyncWindowPlan",
     "CohortPlan",
     "PopulationEngine",
+    "WindowSchedule",
     "PopulationScenario",
     "active_plan",
     "clear_plan",
     "cohort_for_round",
     "committee_schedule",
+    "compile_window_schedule",
     "install_plan",
     "make_shard_and_gather_fns",
     "match_partition_rules",
     "population_partition_rules",
+    "trace_intensity",
+    "wire_window_replay",
 ]

@@ -1,0 +1,953 @@
+"""AsyncPopulationEngine — vectorized FedBuff windows on the fused mesh.
+
+The sync population engine scans *rounds*: every scanned step gathers a
+committee, trains it, and BARRIERS on all of it — one tier-5 device in the
+cohort sets the round's virtual clock. This module scans *windows* instead
+(Papaya / FedBuff, arxiv 2111.04877): the streaming scheduler in
+:mod:`p2pfl_tpu.population.arrivals` decides host-side which cohort members'
+contributions land in each window, and the jitted window body — one
+``lax.scan`` step, static shapes throughout — trains exactly those members
+against the HISTORICAL global they were solicited with, folds them with the
+``num_samples * staleness_discount(lag)`` weight
+(:func:`~p2pfl_tpu.learning.aggregators.async_buffer.staleness_discount` —
+the same pure function the wire buffer multiplies through), and closes the
+window by fill / timeout / stall-patience with masked segment reductions.
+
+Why this can be bit-exact against both reference programs:
+
+* **vs the sync fused engine** — at zero delay (all speed tiers 1.0,
+  uniform trace) every window folds its full cohort fresh: same sorted
+  member order, same ``split(kt, K)[rank]`` member keys, discount exactly
+  1.0, so the weighted fold IS the sync round's FedAvg call. The IID
+  control in ``bench.py --asyncpop`` asserts hash equality, not an
+  accuracy tolerance.
+* **vs the wire async buffer** — the compiled
+  :class:`~p2pfl_tpu.population.arrivals.WindowSchedule` is replayed
+  through the REAL :class:`AsyncBufferedAggregator` by
+  :func:`wire_window_replay` (same anchors, same keys, same fold order,
+  same f32 weight product), and ``scripts/parity_diff.py`` aligns the two
+  ledgers event-for-event, aggregate hashes included.
+
+Memory model (the vnode-ceiling lever): there is NO per-vnode parameter
+stack. Every vnode trains from the shared global, so the engine carries a
+``[max_lag + 1]``-deep *history ring* of globals (a member folding with lag
+``l`` anchors at ``history[l]``) plus the ``[N]`` optimizer stack — for the
+default SGD that is an empty pytree, leaving per-vnode DATA as the only
+O(N) state. Window chunks donate the carry buffers exactly like
+``MeshSimulation.run``'s round chunks, and ``ASYNCPOP_STATE_DTYPE=bfloat16``
+halves the history/eval footprint for ceiling probes (not bit-comparable
+to the f32 wire path — parity runs keep float32).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.learning.aggregators.async_buffer import staleness_discount
+from p2pfl_tpu.learning.learner import softmax_cross_entropy
+from p2pfl_tpu.ops import aggregation as agg_ops
+from p2pfl_tpu.parallel.mesh import make_mesh
+from p2pfl_tpu.parallel.simulation import local_train_step
+from p2pfl_tpu.population.arrivals import (
+    CLOSE_FILL,
+    CLOSE_REASONS,
+    CLOSE_STALL,
+    CLOSE_TIMEOUT,
+    AsyncWindowPlan,
+    WindowSchedule,
+    compile_window_schedule,
+)
+from p2pfl_tpu.population.cohort import cohort_size
+from p2pfl_tpu.population.engine import population_data, vnode_names
+
+Pytree = Any
+
+
+@dataclass
+class AsyncRunResult:
+    """Per-window metrics for one :meth:`AsyncPopulationEngine.run` call."""
+
+    windows: int
+    seconds_total: float
+    seconds_per_window: float
+    #: virtual ticks the whole call cost (sum of per-window durations — the
+    #: number the sync comparison divides by; see ``simulated_barrier_time``).
+    sim_time_ticks: float
+    fills: np.ndarray  #: [W] folded contributions per window
+    close_codes: np.ndarray  #: [W] CLOSE_FILL / CLOSE_TIMEOUT / CLOSE_STALL
+    durations: np.ndarray  #: [W] virtual ticks per window
+    lag_sums: np.ndarray  #: [W] summed fold lag (mean lag = lag_sum/fill)
+    test_acc: List[float] = field(default_factory=list)
+    test_loss: List[float] = field(default_factory=list)
+    schedule: Optional[WindowSchedule] = None
+
+    def summary(self) -> Dict[str, Any]:
+        contribs = int(self.fills.sum())
+        closes = {
+            name: int((self.close_codes == code).sum())
+            for code, name in CLOSE_REASONS.items()
+        }
+        return {
+            "windows": self.windows,
+            "contributions": contribs,
+            "mean_fill": float(self.fills.mean()) if self.windows else 0.0,
+            "sim_time_ticks": self.sim_time_ticks,
+            "contribs_per_tick": contribs / max(self.sim_time_ticks, 1e-12),
+            "sec_per_window": self.seconds_per_window,
+            "mean_lag": float(self.lag_sums.sum()) / max(1, contribs),
+            "close_reasons": closes,
+            "final_test_acc": self.test_acc[-1] if self.test_acc else float("nan"),
+        }
+
+
+class AsyncPopulationEngine:
+    """Cohort-streamed async windows over a sharded fused mesh.
+
+    Mirrors :class:`~p2pfl_tpu.population.engine.PopulationEngine`'s
+    population concerns (names, plan, absolute cursor, checkpoint replay)
+    but owns its own window program — the round machinery in
+    ``MeshSimulation`` stays sync-only.
+    """
+
+    def __init__(
+        self,
+        num_nodes: int,
+        cohort_fraction: float = 1.0,
+        cohort_min: int = 1,
+        churn_rate: float = 0.0,
+        seed: int = 0,
+        samples_per_node: int = 16,
+        feature_dim: int = 32,
+        num_classes: int = 10,
+        hidden: Tuple[int, ...] = (32,),
+        batch_size: int = 8,
+        lr: float = 0.05,
+        dirichlet_alpha: Optional[float] = None,
+        speed_tiers: Tuple[float, ...] = (),
+        trace: Optional[str] = None,
+        trace_period: Optional[int] = None,
+        flash_mult: Optional[float] = None,
+        fill_fraction: Optional[float] = None,
+        timeout_ticks: Optional[int] = None,
+        stall_patience: Optional[int] = None,
+        max_lag: Optional[int] = None,
+        mesh: Any = None,
+        state_dtype: Optional[str] = None,
+        optimizer: Any = None,
+    ) -> None:
+        from p2pfl_tpu.models import mlp_model
+
+        if num_nodes < 1:
+            raise ValueError(f"num_nodes must be >= 1, got {num_nodes}")
+        self.num_nodes = int(num_nodes)
+        self.seed = int(seed)
+        self.names = vnode_names(self.num_nodes)
+        self.plan = AsyncWindowPlan(
+            seed=self.seed,
+            fraction=float(cohort_fraction),
+            min_size=int(cohort_min),
+            churn_rate=float(churn_rate),
+            names=tuple(self.names),
+            trace=trace if trace is not None else Settings.ASYNCPOP_ARRIVAL_TRACE,
+            period=trace_period,
+            flash_mult=flash_mult,
+            fill_fraction=fill_fraction,
+            timeout_ticks=timeout_ticks,
+            stall_patience=stall_patience,
+            max_lag=max_lag,
+        )
+        self.cohort_k = cohort_size(
+            self.num_nodes, float(cohort_fraction), int(cohort_min)
+        )
+        (_, self._timeout_ticks, _, self.max_lag) = self.plan.resolved()
+        # Config pins the wire replay rebuilds its inputs from (pure
+        # functions of the seed — no host array copies are kept).
+        self.config: Dict[str, Any] = dict(
+            samples_per_node=int(samples_per_node),
+            feature_dim=int(feature_dim),
+            num_classes=int(num_classes),
+            hidden=tuple(hidden),
+            batch_size=int(batch_size),
+            lr=float(lr),
+            dirichlet_alpha=dirichlet_alpha,
+            speed_tiers=tuple(speed_tiers),
+        )
+        (x, y, w), (x_eval, y_eval) = population_data(
+            self.seed,
+            self.num_nodes,
+            samples_per_node=samples_per_node,
+            feature_dim=feature_dim,
+            num_classes=num_classes,
+            dirichlet_alpha=dirichlet_alpha,
+        )
+        # Same tier derivation as PopulationEngine (seed + 0x7153), so a
+        # sync baseline at the same seed shares this fleet's speed tiers.
+        if speed_tiers:
+            rng = np.random.default_rng(self.seed + 0x7153)
+            self.node_speed = np.asarray(speed_tiers, np.float32)[
+                rng.integers(0, len(speed_tiers), size=self.num_nodes)
+            ]
+        else:
+            self.node_speed = np.ones(self.num_nodes, np.float32)
+        self.batch_size = int(batch_size)
+        self.optimizer = optimizer if optimizer is not None else optax.sgd(lr)
+        model = mlp_model(
+            input_shape=(feature_dim,),
+            hidden_sizes=tuple(hidden),
+            out_channels=num_classes,
+            seed=self.seed,
+        )
+        self.model = model
+        self.apply_fn = model.apply_fn
+        self.mesh = mesh if mesh is not None else make_mesh()
+
+        # --- [N] data, padded to the mesh nodes axis and sharded ------------
+        self.logical_num_nodes = self.num_nodes
+        mult = int(self.mesh.shape["nodes"])
+        n_pad = (-self.num_nodes) % mult
+        if n_pad:
+
+            def _zero_rows(a: np.ndarray) -> np.ndarray:
+                return np.concatenate(
+                    [a, np.zeros((n_pad,) + a.shape[1:], a.dtype)], axis=0
+                )
+
+            x, y, w = _zero_rows(x), _zero_rows(y), _zero_rows(w)
+        self._n_padded = self.num_nodes + n_pad
+
+        def shard_stacked(a: np.ndarray) -> jax.Array:
+            spec = P("nodes") if a.shape[0] % mult == 0 else P()
+            return jax.device_put(a, NamedSharding(self.mesh, spec))
+
+        self.x, self.y, self.sample_mask = (
+            shard_stacked(x), shard_stacked(y), shard_stacked(w),
+        )
+        self.num_samples = jnp.sum(jnp.asarray(self.sample_mask), axis=1)  # [Np] f32
+        self.speed = jax.device_put(
+            np.concatenate(
+                [self.node_speed, np.ones(n_pad, np.float32)]
+            ),
+            NamedSharding(self.mesh, P()),
+        )
+        self.x_test = jnp.asarray(x_eval)
+        self.y_test = jnp.asarray(y_eval)
+
+        # --- carry state: history ring [H, ...] + [N] optimizer stack -------
+        # Population state dtype: f32 for parity, bf16 for ceiling probes.
+        dt = state_dtype if state_dtype is not None else Settings.ASYNCPOP_STATE_DTYPE
+        if dt not in ("float32", "bfloat16"):
+            raise ValueError(f"state_dtype must be float32|bfloat16, got {dt!r}")
+        self.state_dtype = jnp.bfloat16 if dt == "bfloat16" else jnp.float32
+        template = jax.tree.map(
+            lambda p: jnp.asarray(p, self.state_dtype), model.params
+        )
+        self._template = template
+        hist_depth = self.max_lag + 1
+        self.history_depth = hist_depth
+        hist_shardings = jax.tree.map(
+            lambda p: NamedSharding(self.mesh, P()), template
+        )
+
+        @partial(jax.jit, out_shardings=hist_shardings)
+        def broadcast_history(t: Pytree) -> Pytree:
+            return jax.tree.map(
+                lambda p: jnp.broadcast_to(p[None], (hist_depth,) + p.shape), t
+            )
+
+        self._broadcast_history = broadcast_history
+        self.history = broadcast_history(template)
+
+        n_total = self._n_padded
+
+        def opt_sharding(s) -> NamedSharding:
+            spec = [None] * len(s.shape)
+            if s.shape and s.shape[0] == n_total and n_total % mult == 0:
+                spec[0] = "nodes"
+            return NamedSharding(self.mesh, P(*spec))
+
+        opt_shapes = jax.eval_shape(
+            lambda t: jax.tree.map(
+                lambda a: jnp.broadcast_to(a[None], (n_total,) + a.shape),
+                self.optimizer.init(t),
+            ),
+            template,
+        )
+        opt_shardings = jax.tree.map(opt_sharding, opt_shapes)
+
+        @partial(jax.jit, out_shardings=opt_shardings)
+        def init_opt(t: Pytree) -> Pytree:
+            # All vnodes start from the identical template, so vmapped init
+            # == broadcast init (init is pure) — without materializing an
+            # [N]-params stack just to feed vmap.
+            one = self.optimizer.init(t)
+            return jax.tree.map(
+                lambda a: jnp.broadcast_to(a[None], (n_total,) + a.shape), one
+            )
+
+        self._init_opt = init_opt
+        self.opt_stack = init_opt(template)
+
+        self._ledger = None
+        self._stall = 0
+        self.completed_windows = 0
+        self._fold_counts = np.zeros(self.num_nodes, np.float64)
+        self._last_fold_window = np.full(self.num_nodes, -1, np.float64)
+        self._lag_totals = np.zeros(self.num_nodes, np.float64)
+        self._pristine = True
+        self._closed = False
+
+    # --- schedule ------------------------------------------------------------
+
+    def schedule(self, windows: int, start_window: Optional[int] = None) -> WindowSchedule:
+        """The next ``windows`` fold rows at the absolute window cursor —
+        resume-safe exactly like ``PopulationEngine.schedule``: a rebuilt
+        engine that restored a checkpoint re-streams the identical
+        window/arrival stream the dead one would have used."""
+        start = self.completed_windows if start_window is None else int(start_window)
+        return compile_window_schedule(
+            self.plan, self.names, windows,
+            start_window=start, speeds=self.node_speed,
+        )
+
+    def _chunk_inputs(self, sched: WindowSchedule) -> Tuple[jax.Array, ...]:
+        """Schedule arrays -> device inputs for one compiled chunk: member
+        keys assembled host-side (one ``split(kt, K)`` per distinct origin,
+        gathered by rank — the sync committee derivation, so zero-lag
+        windows reuse the sync keys bit-for-bit) and absent slots remapped
+        to distinct idle REAL vnodes (their no-op write-backs then never
+        collide with a folding member's scatter, and their throwaway
+        training runs on real data — finite, so the zero-weight fold terms
+        stay exact zeros)."""
+        members = sched.members.copy()
+        for w_row in range(members.shape[0]):
+            pres = sched.present[w_row]
+            if pres.all():
+                continue
+            used = set(members[w_row, pres].tolist())
+            spare = (i for i in range(self.logical_num_nodes) if i not in used)
+            for s in np.flatnonzero(~pres):
+                members[w_row, s] = next(spare)
+        base = jax.random.key(self.seed)
+        origins = np.unique(sched.origin)
+        per_origin = jnp.stack(
+            [
+                jax.random.split(
+                    jax.random.split(jax.random.fold_in(base, int(o)))[1],
+                    self.cohort_k,
+                )
+                for o in origins.tolist()
+            ]
+        )  # [O, K] typed keys
+        pos = np.searchsorted(origins, sched.origin)
+        keys = per_origin[jnp.asarray(pos), jnp.asarray(sched.rank)]  # [W, K]
+        return (
+            jnp.asarray(members),
+            jnp.asarray(sched.present),
+            jnp.asarray(sched.lag),
+            jnp.asarray(sched.target),
+            keys,
+        )
+
+    # --- jitted window program ----------------------------------------------
+
+    def _batch_loss(self, params, bx, by, bw):
+        return softmax_cross_entropy(self.apply_fn(params, bx), by, bw)
+
+    def _local_train(self, params, opt_state, key, x, y, w, *, epochs: int):
+        return local_train_step(
+            params, opt_state, key, x, y, w, {},
+            c_global={},
+            epochs=epochs,
+            batch_loss=self._batch_loss,
+            optimizer=self.optimizer,
+            batch_size=self.batch_size,
+        )
+
+    @partial(
+        jax.jit,
+        static_argnames=("self", "windows", "epochs", "eval_every"),
+        donate_argnames=("history", "opt_stack"),
+    )
+    def _run_jit(
+        self, history, opt_stack, stall0, data, members, present, lag, target,
+        keys, start_window, final_window, *, windows: int, epochs: int,
+        eval_every: int = 1,
+    ):
+        x, y, sample_mask, num_samples, speed, xt, yt = data
+        alpha = float(Settings.ASYNC_STALENESS_ALPHA)
+        idx = start_window + jnp.arange(windows)
+        do_eval = ((idx + 1) % eval_every == 0) | (idx == final_window)
+
+        def body(carry, xs_w):
+            history, opt_stack, stall = carry
+            m, pr, lg, tg, keys_w, do_ev = xs_w
+            prf = pr.astype(jnp.float32)
+            # Anchor each contribution at the global it trained against:
+            # lag l -> the ring slot l windows back (history[0] is the
+            # global entering THIS window). Absent slots anchor fresh.
+            anchors = jax.tree.map(lambda h: h[lg], history)
+            o_k = jax.tree.map(lambda a: a[m], opt_stack)
+            p_new, o_new, losses = jax.vmap(
+                partial(self._local_train, epochs=epochs)
+            )(anchors, o_k, keys_w, x[m], y[m], sample_mask[m])
+            # Fold: the wire weight product, slot for slot — (present *
+            # num_samples) is exact for present slots, exact zero for
+            # absent ones, then ONE f32 multiply by the shared discount.
+            wgt = (prf * num_samples[m]) * staleness_discount(lg, alpha)
+            fill = jnp.sum(pr.astype(jnp.int32))
+            cur = jax.tree.map(lambda h: h[0], history)
+            new_global = jax.lax.cond(
+                fill > 0,
+                lambda: jax.tree.map(
+                    lambda a, c: a.astype(c.dtype),
+                    agg_ops.fedavg(p_new, wgt),
+                    cur,
+                ),
+                lambda: cur,
+            )
+            # The ring shifts EVERY window (empty ones too): slot l must
+            # always mean "the global l windows back".
+            history = jax.tree.map(
+                lambda h, g: jnp.concatenate(
+                    [g[None].astype(h.dtype), h[:-1]], axis=0
+                ),
+                history,
+                new_global,
+            )
+            # Optimizer write-back, masked: absent slots write their own
+            # member's UNCHANGED state back (slot remapping made the
+            # indices distinct, so the scatter is deterministic).
+            o_fin = jax.tree.map(
+                lambda new, old: jnp.where(
+                    pr.reshape((-1,) + (1,) * (new.ndim - 1)), new, old
+                ),
+                o_new,
+                o_k,
+            )
+            opt_stack = jax.tree.map(
+                lambda a, u: a.at[m].set(u), opt_stack, o_fin
+            )
+            # Window close, inside the scan with static shapes: fill-target
+            # met -> FILL; empty -> STALL (patience counter carried);
+            # under-target -> TIMEOUT (it waited out its ticks).
+            closed_fill = fill >= tg
+            empty = fill == 0
+            stall = jnp.where(empty, stall + 1, 0)
+            close_code = jnp.where(
+                closed_fill,
+                jnp.int32(CLOSE_FILL),
+                jnp.where(empty, jnp.int32(CLOSE_STALL), jnp.int32(CLOSE_TIMEOUT)),
+            )
+            # Virtual duration: the async clock is FIXED-CADENCE — one tick
+            # per window however it closed. The arrival model is already
+            # denominated in window ticks (a tier-s member returns its
+            # update up to ceil(s)-1 windows late and folds with the
+            # staleness discount), so the straggler cost async pays is LAG,
+            # not time — the sync barrier instead stretches every round to
+            # its slowest committee member (``simulated_barrier_time``).
+            dur = jnp.float32(1.0)
+            lag_sum = jnp.sum(prf * lg.astype(jnp.float32))
+
+            def _eval(_):
+                logits = self.apply_fn(new_global, xt)
+                loss = softmax_cross_entropy(
+                    logits, yt, jnp.ones_like(yt, jnp.float32)
+                )
+                acc = jnp.mean((jnp.argmax(logits, -1) == yt).astype(jnp.float32))
+                return loss, acc
+
+            loss, acc = jax.lax.cond(
+                do_ev,
+                _eval,
+                lambda _: (jnp.float32(jnp.nan), jnp.float32(jnp.nan)),
+                operand=None,
+            )
+            return (
+                (history, opt_stack, stall),
+                (fill, close_code, dur, lag_sum, losses.mean(), loss, acc),
+            )
+
+        carry, outs = jax.lax.scan(
+            body,
+            (history, opt_stack, stall0),
+            (members, present, lag, target, keys, do_eval),
+        )
+        history, opt_stack, stall = carry
+        return (history, opt_stack, stall) + tuple(outs)
+
+    # --- driving -------------------------------------------------------------
+
+    def run(
+        self,
+        windows: int,
+        epochs: int = 1,
+        eval_every: int = 1,
+        warmup: bool = False,
+        windows_per_call: Optional[int] = None,
+    ) -> AsyncRunResult:
+        """Execute ``windows`` async windows on the mesh.
+
+        Chunking, donation and failure semantics mirror
+        ``MeshSimulation.run``: the compiled unit is a
+        ``windows_per_call``-window program, the carry buffers are DONATED
+        to each chunk (peak HBM ~1x state), a pristine engine donates its
+        real state to the warmup and deterministically rebuilds it, and a
+        failed donated chunk leaves the state ``None`` with an explicit
+        RuntimeError (restore via :meth:`load_from`).
+        """
+        if self._closed:
+            raise RuntimeError(
+                "engine is closed — construct a new AsyncPopulationEngine"
+            )
+        if self.history is None:
+            raise RuntimeError(
+                "population state lost in a failed donated chunk — "
+                "load_from(checkpointer) to restore before running again"
+            )
+        windows = int(windows)
+        per_call = max(1, min(windows_per_call or windows, windows))
+        chunks = [per_call] * (windows // per_call)
+        if windows % per_call:
+            chunks.append(windows % per_call)
+        start = self.completed_windows
+        sched = self.schedule(windows)
+        data = (
+            self.x, self.y, self.sample_mask, self.num_samples, self.speed,
+            self.x_test, self.y_test,
+        )
+
+        if warmup:
+            # Warmup cursor past the real run (a remote backend replaying a
+            # cached (program, inputs) execution would fake the first timed
+            # chunk otherwise) — see MeshSimulation.run.
+            wsched = self.schedule(chunks[0], start_window=start + windows + 1)
+            if self._pristine:
+                wh, wo = self.history, self.opt_stack
+            else:
+                wh, wo = jax.tree.map(jnp.copy, (self.history, self.opt_stack))
+            try:
+                out = self._run_jit(
+                    wh, wo, jnp.int32(self._stall), data,
+                    *self._chunk_inputs(wsched),
+                    jnp.int32(start + windows + 1),
+                    jnp.int32(start + windows + chunks[0]),
+                    windows=chunks[0], epochs=epochs, eval_every=eval_every,
+                )
+                jax.block_until_ready(out[0])
+                np.asarray(out[3])  # force true retirement before timing
+                del out
+            finally:
+                if self._pristine:
+                    self._reinit_population()
+
+        history, opt_stack = self.history, self.opt_stack
+        stall = jnp.int32(self._stall)
+        fills, codes, durs, lag_sums, test_loss, test_acc = [], [], [], [], [], []
+        t0 = time.monotonic()
+        done = 0
+        try:
+            for chunk in chunks:
+                row = slice(done, done + chunk)
+                sub = WindowSchedule(
+                    start_window=start + done,
+                    cohort_k=sched.cohort_k,
+                    members=sched.members[row],
+                    present=sched.present[row],
+                    origin=sched.origin[row],
+                    lag=sched.lag[row],
+                    rank=sched.rank[row],
+                    target=sched.target[row],
+                    solicited=sched.solicited[row],
+                    queue_depth=sched.queue_depth[row],
+                    dropped=sched.dropped[row],
+                )
+                history, opt_stack, stall, fl, cc, du, ls, _tr, tl, ta = (
+                    self._run_jit(
+                        history, opt_stack, stall, data,
+                        *self._chunk_inputs(sub),
+                        jnp.int32(start + done),
+                        jnp.int32(start + windows - 1),
+                        windows=chunk, epochs=epochs, eval_every=eval_every,
+                    )
+                )
+                fills.append(fl)
+                codes.append(cc)
+                durs.append(du)
+                lag_sums.append(ls)
+                test_loss.append(tl)
+                test_acc.append(ta)
+                done += chunk
+                if self._ledger is not None:
+                    self._ledger_emit_chunk(sub, history)
+        except BaseException as e:
+            self.history = self.opt_stack = None
+            self._pristine = False
+            raise RuntimeError(
+                "async window chunk failed after its population buffers "
+                "were donated; restore with load_from(checkpointer) before "
+                "running again"
+            ) from e
+        jax.block_until_ready(history)
+        np.asarray(lag_sums[-1])  # force retirement — dt is honest
+        dt = time.monotonic() - t0
+
+        self.history, self.opt_stack = history, opt_stack
+        self._stall = int(np.asarray(stall))
+        self.completed_windows = start + windows
+        self._pristine = False
+        fills_np = np.concatenate([np.asarray(f) for f in fills]).astype(np.int64)
+        durs_np = np.concatenate([np.asarray(d) for d in durs]).astype(np.float64)
+        # Cumulative per-vnode fold accounting (fed_top's WINDOW / FILL
+        # columns), from the compiled schedule — the device outputs carry
+        # only the aggregate counters.
+        for wi in range(windows):
+            folded = sched.members[wi][sched.present[wi]]
+            np.add.at(self._fold_counts, folded, 1.0)
+            self._last_fold_window[folded] = float(start + wi)
+            np.add.at(
+                self._lag_totals, folded,
+                sched.lag[wi][sched.present[wi]].astype(np.float64),
+            )
+        acc_all = np.concatenate([np.asarray(t) for t in test_acc])
+        loss_all = np.concatenate([np.asarray(t) for t in test_loss])
+        evaluated = ~np.isnan(acc_all)
+        return AsyncRunResult(
+            windows=windows,
+            seconds_total=dt,
+            seconds_per_window=dt / max(1, windows),
+            sim_time_ticks=float(durs_np.sum()),
+            fills=fills_np,
+            close_codes=np.concatenate([np.asarray(c) for c in codes]).astype(np.int64),
+            durations=durs_np,
+            lag_sums=np.concatenate([np.asarray(s) for s in lag_sums]).astype(np.float64),
+            test_acc=[float(a) for a in acc_all[evaluated]],
+            test_loss=[float(l) for l in loss_all[evaluated]],
+            schedule=sched,
+        )
+
+    def _reinit_population(self) -> None:
+        self.history = self._broadcast_history(self._template)
+        self.opt_stack = self._init_opt(self._template)
+
+    # --- observability -------------------------------------------------------
+
+    def attach_ledger(
+        self, node: str = "asyncpop-engine", run_id: Optional[str] = None
+    ):
+        """Emit the canonical window event stream (window_open /
+        contribution_folded(lag=...) / aggregate_committed / window_close)
+        — the same schema the wire buffer path emits, so
+        ``scripts/parity_diff.py`` aligns fused-async against wire-async."""
+        from p2pfl_tpu.telemetry.ledger import LEDGERS
+
+        if run_id is not None:
+            LEDGERS.configure(run_id)
+        self._ledger = LEDGERS.get(node)
+        return self._ledger
+
+    def _ledger_emit_chunk(self, sched: WindowSchedule, history) -> None:
+        led = self._ledger
+        if led is None:
+            return
+        samples = np.asarray(self.num_samples)
+        # The post-chunk hash describes the global after the chunk's LAST
+        # fold — attach it to the last non-empty window (trailing empty
+        # windows leave the global untouched, so it still matches).
+        fills = sched.fill()
+        hash_at = int(np.max(np.flatnonzero(fills > 0))) if (fills > 0).any() else -1
+        for wi in range(sched.windows):
+            w = sched.start_window + wi
+            slots = np.flatnonzero(sched.present[wi])
+            names = [self.names[int(sched.members[wi, s])] for s in slots]
+            led.emit("window_open", round=w, members=sorted(names))
+            total = 0
+            for s, name in zip(slots, names):
+                n_i = int(samples[int(sched.members[wi, s])])
+                total += n_i
+                led.emit(
+                    "contribution_folded", round=w, sender=name,
+                    lag=int(sched.lag[wi, s]), num_samples=n_i,
+                )
+            if len(slots):
+                commit: Dict[str, Any] = {
+                    "contributors": sorted(names),
+                    "num_samples": total,
+                    "origin": "mesh",
+                }
+                if wi == hash_at:
+                    from p2pfl_tpu.telemetry.ledger import canonical_params_hash
+
+                    commit["hash"] = canonical_params_hash(self.global_params(history))
+                led.emit("aggregate_committed", round=w, **commit)
+            led.emit("window_close", round=w)
+
+    def global_params(self, history=None) -> Pytree:
+        """The current global model (history slot 0) as host numpy."""
+        h = self.history if history is None else history
+        if h is None:
+            raise RuntimeError("population state lost — load_from() to restore")
+        return jax.tree.map(lambda a: np.asarray(a[0]), h)
+
+    def window_fill(self) -> np.ndarray:
+        """Realized per-vnode fold fraction across every window this engine
+        ran (the async analogue of ``PopulationEngine.cohort_fill``)."""
+        return self._fold_counts / float(max(1, self.completed_windows))
+
+    def snapshot(
+        self,
+        result: AsyncRunResult,
+        top_n: int = 16,
+        path: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """fed_top-renderable population snapshot with the async columns:
+        per-peer ``window`` (last fold) and ``window_fill`` (realized fold
+        fraction), straggler ordering by mean fold lag + speed tier."""
+        from p2pfl_tpu.telemetry.observatory import (
+            population_snapshot,
+            write_snapshot_doc,
+        )
+
+        n = self.num_nodes
+        mean_lag = self._lag_totals / np.maximum(1.0, self._fold_counts)
+        metrics = {
+            "participation": self._fold_counts,
+            "step_time": self.node_speed * float(result.seconds_per_window),
+            "round_lag": mean_lag,
+            "round": self._last_fold_window,
+            "rejections": np.zeros(n),
+            "window": self._last_fold_window,
+            "window_fill": self.window_fill(),
+        }
+        snap = population_snapshot(
+            observer="asyncpop-engine",
+            node_names=self.names,
+            metrics=metrics,
+            top_n=top_n,
+        )
+        if path is not None:
+            write_snapshot_doc(path, snap)
+        return snap
+
+    # --- recovery ------------------------------------------------------------
+
+    def state_dict(self) -> Pytree:
+        if self._closed:
+            raise RuntimeError("engine is closed — snapshot state before close()")
+        return {"history": self.history, "opt_stack": self.opt_stack}
+
+    def save_to(self, checkpointer) -> bool:
+        return checkpointer.save(
+            self.completed_windows,
+            self.state_dict(),
+            {
+                "completed_windows": self.completed_windows,
+                "seed": self.seed,
+                "stall": self._stall,
+            },
+        )
+
+    def load_from(self, checkpointer, step: Optional[int] = None) -> int:
+        """Restore state; the window/arrival stream then resumes at the
+        restored ABSOLUTE cursor — :meth:`schedule` re-streams from window
+        0, so the healed engine replays the exact stream an uninterrupted
+        run would have produced (tests/test_asyncpop.py asserts this)."""
+        if self._closed:
+            raise RuntimeError("engine is closed — construct a new one")
+        meta = checkpointer.restore_meta(step)
+        if not meta:
+            return 0
+        if int(meta.get("seed", self.seed)) != self.seed:
+            raise ValueError(
+                f"checkpoint seed {meta.get('seed')} != engine seed "
+                f"{self.seed} — the window stream would diverge"
+            )
+        template = {
+            "history": self.history
+            if self.history is not None
+            else self._broadcast_history(self._template),
+            "opt_stack": self.opt_stack
+            if self.opt_stack is not None
+            else self._init_opt(self._template),
+        }
+        state, _ = checkpointer.restore(template, step)
+        self.history = state["history"]
+        self.opt_stack = state["opt_stack"]
+        restored = int(meta.get("completed_windows", 0))
+        self._stall = int(meta.get("stall", 0))
+        self.completed_windows = restored
+        self._pristine = False
+        # Fold accounting is a pure function of the stream: replay it.
+        self._fold_counts = np.zeros(self.num_nodes, np.float64)
+        self._last_fold_window = np.full(self.num_nodes, -1, np.float64)
+        self._lag_totals = np.zeros(self.num_nodes, np.float64)
+        if restored:
+            sched = self.schedule(restored, start_window=0)
+            for wi in range(restored):
+                folded = sched.members[wi][sched.present[wi]]
+                np.add.at(self._fold_counts, folded, 1.0)
+                self._last_fold_window[folded] = float(wi)
+                np.add.at(
+                    self._lag_totals, folded,
+                    sched.lag[wi][sched.present[wi]].astype(np.float64),
+                )
+        return restored
+
+    def close(self) -> None:
+        self.history = self.opt_stack = None
+        self.x = self.y = self.sample_mask = self.num_samples = None
+        self.x_test = self.y_test = None
+        self._template = None
+        self._pristine = False
+        self._closed = True
+        jax.clear_caches()
+
+    def __enter__(self) -> "AsyncPopulationEngine":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+# --- wire replay (the parity arm's other half) --------------------------------
+
+
+def wire_window_replay(
+    engine: AsyncPopulationEngine,
+    windows: int,
+    epochs: int = 1,
+    node: str = "wire-async",
+    run_id: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Drive the REAL wire async buffer through the engine's compiled
+    window stream — the parity gate's wire half.
+
+    Rebuilds the engine's data/model from its seed (pure functions — no
+    shared arrays), then for each window: opens the buffer window, trains
+    each scheduled contribution with the SAME anchor (the historical
+    global), the SAME rng key and the same single
+    :func:`~p2pfl_tpu.parallel.simulation.local_train_step` kernel the
+    fused scan vmaps, folds it into an
+    :class:`~p2pfl_tpu.learning.aggregators.async_buffer.AsyncBufferedAggregator`
+    in slot order, and drains the window through the buffer's own
+    staleness-weighted aggregation. Emits the canonical ledger stream
+    (window_open / contribution_folded — from the buffer itself /
+    aggregate_committed with a hash every folded window / window_close).
+
+    Returns ``{"events": [...], "hashes": [...], "fills": [...]}``. Meant
+    for SMALL n (every contribution is a separate host-side train call).
+    """
+    from p2pfl_tpu.learning.aggregators.async_buffer import AsyncBufferedAggregator
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.telemetry.ledger import LEDGERS, canonical_params_hash
+
+    cfg = engine.config
+    (x, y, w), _ = population_data(
+        engine.seed,
+        engine.num_nodes,
+        samples_per_node=cfg["samples_per_node"],
+        feature_dim=cfg["feature_dim"],
+        num_classes=cfg["num_classes"],
+        dirichlet_alpha=cfg["dirichlet_alpha"],
+    )
+    ns = w.sum(axis=1).astype(np.int64)
+    model = mlp_model(
+        input_shape=(cfg["feature_dim"],),
+        hidden_sizes=cfg["hidden"],
+        out_channels=cfg["num_classes"],
+        seed=engine.seed,
+    )
+    optimizer = engine.optimizer
+
+    def batch_loss(params, bx, by, bw):
+        return softmax_cross_entropy(model.apply_fn(params, bx), by, bw)
+
+    train_one = jax.jit(
+        partial(
+            local_train_step,
+            c_global={},
+            epochs=epochs,
+            batch_loss=batch_loss,
+            optimizer=optimizer,
+            batch_size=cfg["batch_size"],
+        )
+    )
+    sched = engine.schedule(windows, start_window=0)
+    k = sched.cohort_k
+    base = jax.random.key(engine.seed)
+
+    def member_key(origin: int, rank: int) -> jax.Array:
+        kt = jax.random.split(jax.random.fold_in(base, origin))[1]
+        return jax.random.split(kt, k)[rank]
+
+    if run_id is not None:
+        LEDGERS.configure(run_id)
+    led = LEDGERS.get(node)
+    buf = AsyncBufferedAggregator(node)
+    template = jax.tree.map(lambda p: jnp.asarray(p, jnp.float32), model.params)
+    #: hist[w] = the global entering window w.
+    hist: List[Pytree] = [template]
+    opt_states: Dict[int, Pytree] = {}
+    hashes: List[Optional[str]] = []
+    fills: List[int] = []
+    for wi in range(windows):
+        buf.open_window(wi)
+        slots = np.flatnonzero(sched.present[wi])
+        names = [engine.names[int(sched.members[wi, s])] for s in slots]
+        led.emit("window_open", round=wi, members=sorted(names))
+        for s, name in zip(slots, names):
+            i = int(sched.members[wi, s])
+            org = int(sched.origin[wi, s])
+            key = member_key(org, int(sched.rank[wi, s]))
+            o_st = opt_states.get(i)
+            if o_st is None:
+                o_st = optimizer.init(template)
+            p_new, o_new, _loss = train_one(
+                hist[org], o_st, key,
+                jnp.asarray(x[i]), jnp.asarray(y[i]), jnp.asarray(w[i]), {},
+            )
+            opt_states[i] = o_new
+            handle = model.build_copy(
+                params=p_new, contributors=[name], num_samples=int(ns[i])
+            )
+            buf.fold(handle, origin_window=org, sender=name)
+        if len(slots):
+            agg = buf.wait_window(target_fn=lambda: buf.fill(), timeout=60.0)
+            g = jax.tree.map(lambda a: jnp.asarray(a, jnp.float32), agg.params)
+            h = canonical_params_hash(g)
+            led.emit(
+                "aggregate_committed", round=wi,
+                contributors=sorted(names),
+                num_samples=int(agg.get_num_samples()),
+                hash=h, origin="wire",
+            )
+            hashes.append(h)
+            hist.append(g)
+        else:
+            hashes.append(None)
+            hist.append(hist[-1])
+        fills.append(len(slots))
+        led.emit("window_close", round=wi)
+    return {
+        "events": led.events(),
+        "hashes": hashes,
+        "fills": fills,
+        "final_params": jax.tree.map(np.asarray, hist[-1]),
+    }
+
+
+__all__ = [
+    "AsyncPopulationEngine",
+    "AsyncRunResult",
+    "wire_window_replay",
+]

@@ -747,6 +747,18 @@ def population_snapshot(
             "cohort_fill": (
                 round(float(fill[i]), 4) if fill is not None else None
             ),
+            # Async-window population runs: the last window this vnode's
+            # contribution FOLDED into (-1: never folded) and its realized
+            # fold fraction across all windows. None on sync runs —
+            # fed_top prints "-" then.
+            "window": (
+                int(arrays["window"][i]) if "window" in arrays else None
+            ),
+            "window_fill": (
+                round(float(arrays["window_fill"][i]), 4)
+                if "window_fill" in arrays
+                else None
+            ),
             "scores": {
                 "straggler": round(float(straggler[i]), 4),
                 "suspect": round(float(arrays.get("rejections", np.zeros(n))[i]), 4),

@@ -1,0 +1,180 @@
+"""asyncpop-check — the async-window population gate (fast CI shape, ~60 s).
+
+Certifies the FedBuff window contract on a small fused population so CI
+catches a broken scheduler or fold before the expensive
+``bench.py --asyncpop`` acceptance run does:
+
+1. a 32-node :class:`~p2pfl_tpu.population.AsyncPopulationEngine` with a
+   seeded slow tier ``(1,1,2,5)`` closes every window by FILL (the
+   stall-patience backpressure keeps the stream flowing), fold lag stays
+   within ``ASYNCPOP_MAX_LAG``, and the window stream is replay-identical
+   when driven in chunks (3 + 5 windows vs one 8-window call — same global
+   params hash);
+2. the 10x flash-crowd arrival trace sustains throughput: contributions
+   keep folding through the spike-and-trough cycle, no unbounded pending
+   queue, staleness bounded by construction;
+3. wire-vs-fused async parity at n=4: the REAL
+   :class:`~p2pfl_tpu.learning.aggregators.async_buffer.AsyncBufferedAggregator`
+   replaying the same compiled window stream produces a ledger that aligns
+   event-for-event with the fused engine's — every aggregate hash
+   bit-exact (``scripts/parity_diff.py`` is the comparator).
+
+Exit 0 on pass, 1 on failure. ``make asyncpop-check`` wires it next to
+``population-check``.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from p2pfl_tpu.config import Settings
+    from p2pfl_tpu.population import AsyncPopulationEngine, wire_window_replay
+    from p2pfl_tpu.telemetry.ledger import LEDGERS, canonical_params_hash
+
+    t0 = time.monotonic()
+    n, windows, seed = 32, 8, 1234
+    tiers = (1.0, 1.0, 2.0, 5.0)
+    eng_kw = dict(
+        cohort_fraction=0.5, seed=seed, samples_per_node=8, feature_dim=8,
+        num_classes=4, hidden=(8,), batch_size=4, speed_tiers=tiers,
+    )
+    print(
+        f"asyncpop-check: n={n} windows={windows} tiers={tiers} seed={seed} "
+        "— slow-tier window arm...",
+        file=sys.stderr,
+    )
+    with AsyncPopulationEngine(n, **eng_kw) as eng:
+        res = eng.run(windows, eval_every=windows)
+        hash_single = canonical_params_hash(eng.global_params())
+        sched = res.schedule
+    max_lag = int(sched.lag[sched.present].max()) if sched.present.any() else 0
+    if not (res.close_codes == 0).all():
+        print(
+            f"FAIL: windows closed by {res.close_codes.tolist()} under the "
+            "slow tier — expected every close by FILL (code 0)",
+            file=sys.stderr,
+        )
+        return 1
+    if max_lag > int(Settings.ASYNCPOP_MAX_LAG):
+        print(
+            f"FAIL: fold lag {max_lag} > ASYNCPOP_MAX_LAG "
+            f"{Settings.ASYNCPOP_MAX_LAG}",
+            file=sys.stderr,
+        )
+        return 1
+    with AsyncPopulationEngine(n, **eng_kw) as eng2:
+        eng2.run(3, eval_every=10)
+        eng2.run(5, eval_every=10)
+        hash_chunked = canonical_params_hash(eng2.global_params())
+    if hash_chunked != hash_single:
+        print(
+            f"FAIL: chunked window stream hash {hash_chunked[:16]}… != "
+            f"single-call {hash_single[:16]}…",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"PASS: {windows} slow-tier windows all closed by fill, max lag "
+        f"{max_lag} <= {Settings.ASYNCPOP_MAX_LAG}, chunked replay "
+        "bit-identical",
+        file=sys.stderr,
+    )
+
+    # Flash crowd: the 10x spike must not stall the stream or grow the
+    # pending queue past the stall-patience backpressure bound.
+    period, fc_windows = 6, 18
+    with AsyncPopulationEngine(
+        128, cohort_fraction=0.25, seed=seed + 1, samples_per_node=8,
+        feature_dim=8, num_classes=4, hidden=(8,), batch_size=4,
+        speed_tiers=tiers, trace="flash", trace_period=period,
+    ) as fc:
+        fc_res = fc.run(fc_windows, eval_every=fc_windows)
+        fc_sched = fc_res.schedule
+        patience = fc.plan.resolved()[2]
+        fc_k = fc.cohort_k
+    contribs = int(fc_res.fills.sum())
+    stalls = int((fc_res.close_codes == 2).sum())
+    max_queue = int(fc_sched.queue_depth.max())
+    bound = (patience + 1) * fc_k
+    if contribs == 0 or stalls > fc_windows // 2:
+        print(
+            f"FAIL: flash crowd did not sustain throughput "
+            f"({contribs} contribs, {stalls}/{fc_windows} stalls)",
+            file=sys.stderr,
+        )
+        return 1
+    if max_queue > bound:
+        print(
+            f"FAIL: flash-crowd pending queue {max_queue} > backpressure "
+            f"bound {bound}",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"PASS: flash crowd sustained {contribs} contributions over "
+        f"{fc_windows} windows ({stalls} stalls, max queue {max_queue} <= "
+        f"{bound})",
+        file=sys.stderr,
+    )
+
+    # Wire-vs-fused parity at n=4: same stream, real async buffer, every
+    # aggregate hash bit-exact.
+    spec = importlib.util.spec_from_file_location(
+        "parity_diff", os.path.join(REPO, "scripts", "parity_diff.py")
+    )
+    parity_diff = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(parity_diff)
+    par_kw = dict(
+        cohort_fraction=1.0, seed=seed + 2, samples_per_node=8,
+        feature_dim=8, num_classes=4, hidden=(8,), batch_size=4,
+        speed_tiers=(1.0, 1.0, 2.0, 3.0),
+    )
+    par_windows = 5
+    LEDGERS.reset()
+    with AsyncPopulationEngine(4, **par_kw) as fused:
+        led = fused.attach_ledger("fused-async")
+        fused.run(par_windows, eval_every=100, windows_per_call=1)
+        fused_ev = led.canonical_events()
+    wire_window_replay(
+        AsyncPopulationEngine(4, **par_kw), par_windows, node="wire-async"
+    )
+    wire_ev = LEDGERS.get("wire-async").canonical_events()
+    report = parity_diff.compare_ledgers(wire_ev, fused_ev)
+    if report["status"] != "OK":
+        print(
+            f"FAIL: wire-vs-fused async parity diverged: "
+            f"{report['first_divergence']}",
+            file=sys.stderr,
+        )
+        return 1
+    if report["hashes_compared"] < 1:
+        print("FAIL: parity compared zero aggregate hashes", file=sys.stderr)
+        return 1
+    print(
+        f"PASS: wire-vs-fused async parity OK ({report['compared_events']} "
+        f"events aligned, {report['hashes_compared']} hashes bit-exact)",
+        file=sys.stderr,
+    )
+    print(
+        f"asyncpop-check PASSED in {time.monotonic() - t0:.1f}s",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

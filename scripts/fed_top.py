@@ -107,6 +107,7 @@ def render(
     header = (
         f"{'PEER':<23} {'ROUND':>7} {'STAGE':<22} {'STEP/S':>8} "
         f"{'TX MiB':>8} {'RX MiB':>8} {'STALE':>6} {'EPS':>6} {'COHORT':>7} "
+        f"{'WINDOW':>7} {'FILL':>6} "
         f"{'STRAG':>7} {'SUSP':>7} {'LINK':>6} {'AGE s':>6}"
     )
     lines = [
@@ -140,6 +141,13 @@ def render(
         # pre-population snapshots (field absent or null).
         fill = p.get("cohort_fill")
         fill_s = "-" if fill is None else f"{fill:.2f}"
+        # Async population columns: last window this vnode's contribution
+        # folded into (w-prefixed; "-" = never folded or a sync snapshot)
+        # and its realized fold fraction across all windows so far.
+        window = p.get("window")
+        window_s = "-" if window is None else ("-" if window < 0 else f"w{window}")
+        wfill = p.get("window_fill")
+        wfill_s = "-" if wfill is None else f"{wfill:.2f}"
         row = (
             f"{_short(addr):<23} {round_s:>7} {p.get('stage') or '-':<22.22} "
             f"{p.get('steps_per_s', 0.0):>8.1f} {_mib(p.get('tx_bytes', 0.0)):>8} "
@@ -147,6 +155,8 @@ def render(
             f"{(f'{stale:.1f}' if stale else '-'):>6} "
             f"{eps_s:>6} "
             f"{fill_s:>7} "
+            f"{window_s:>7} "
+            f"{wfill_s:>6} "
             f"{s.get('straggler', 0.0):>7.2f} "
             f"{s.get('suspect', 0.0):>7.1f} {s.get('link', 0.0):>6.1f} "
             f"{s.get('age_s', 0.0):>6.1f}"

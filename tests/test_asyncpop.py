@@ -1,0 +1,267 @@
+"""Async-window population subsystem: arrival traces, the streaming
+scheduler's invariants, and the AsyncPopulationEngine's bit-exactness
+contracts.
+
+Every assertion here is an exact regression pin on FIXED seeds — the
+scheduler is a pure function of ``(plan, names, speeds)`` and the fused
+window fold is constructed to reproduce the sync FedAvg (zero lag) and the
+wire async buffer (any lag) bit for bit, so there are no tolerance knobs to
+hide behind.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import math
+import os
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.population.arrivals import (
+    CLOSE_FILL,
+    AsyncWindowPlan,
+    arrival_delay,
+    compile_window_schedule,
+    trace_intensity,
+)
+from p2pfl_tpu.population.engine import vnode_names
+
+
+def _load_parity_diff():
+    spec = importlib.util.spec_from_file_location(
+        "parity_diff",
+        os.path.join(os.path.dirname(__file__), "..", "scripts", "parity_diff.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --- arrival model ------------------------------------------------------------
+
+
+def test_trace_intensity_profiles():
+    p = 8
+    assert all(trace_intensity("uniform", w, p) == 1.0 for w in range(3 * p))
+    for trace in ("diurnal", "regional"):
+        vals = [trace_intensity(trace, w, p) for w in range(3 * p)]
+        assert all(0.0 < v <= 1.0 for v in vals)
+        # Periodic in the ABSOLUTE window index — the resume-safety property.
+        assert vals[:p] == vals[p : 2 * p]
+    spike = max(1, p // 5)
+    for w in range(2 * p):
+        got = trace_intensity("flash", w, p, flash_mult=10.0)
+        assert got == (1.0 if (w % p) < spike else pytest.approx(0.1))
+    with pytest.raises(ValueError, match="unknown arrival trace"):
+        trace_intensity("bursty", 0, p)
+
+
+def test_arrival_delay_tiers_and_determinism():
+    # Tier <= 1.0 is always fresh; tier s is in [0, ceil(s) - 1]; the draw
+    # is a pure function of (seed, window, name).
+    assert all(arrival_delay(9, w, "vnode/00003", 1.0) == 0 for w in range(50))
+    for speed in (2.0, 3.0, 5.0):
+        draws = [
+            arrival_delay(9, w, f"vnode/{i:05d}", speed)
+            for w in range(20)
+            for i in range(8)
+        ]
+        assert min(draws) >= 0
+        assert max(draws) <= math.ceil(speed) - 1
+        assert max(draws) > 0  # the slow tier really is late sometimes
+    assert arrival_delay(9, 4, "vnode/00001", 5.0) == arrival_delay(
+        9, 4, "vnode/00001", 5.0
+    )
+    assert arrival_delay(10, 4, "vnode/00001", 5.0) != arrival_delay(
+        9, 4, "vnode/00001", 5.0
+    ) or arrival_delay(9, 5, "vnode/00001", 5.0) != arrival_delay(
+        9, 4, "vnode/00001", 5.0
+    )
+
+
+# --- streaming scheduler ------------------------------------------------------
+
+
+def _speeds(n: int, tiers, seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed + 0x7153)
+    return np.asarray(tiers, np.float32)[rng.integers(0, len(tiers), size=n)]
+
+
+def test_window_schedule_chunk_and_cursor_invariance():
+    """Resume-safety: the stream is a pure function of the plan — compiling
+    [0, 8) in one call or as [0, 5) + [5, 8) yields identical rows, which is
+    what lets a restored checkpoint replay the dead engine's exact stream."""
+    n, seed = 24, 3
+    names = vnode_names(n)
+    speeds = _speeds(n, (1.0, 1.0, 2.0, 5.0), seed)
+    plan = AsyncWindowPlan(seed=seed, fraction=0.25, names=tuple(names))
+    whole = compile_window_schedule(plan, names, 8, start_window=0, speeds=speeds)
+    head = compile_window_schedule(plan, names, 5, start_window=0, speeds=speeds)
+    tail = compile_window_schedule(plan, names, 3, start_window=5, speeds=speeds)
+    for attr in (
+        "members", "present", "origin", "lag", "rank",
+        "target", "solicited", "queue_depth", "dropped",
+    ):
+        joined = np.concatenate([getattr(head, attr), getattr(tail, attr)])
+        np.testing.assert_array_equal(joined, getattr(whole, attr), err_msg=attr)
+    assert whole.windows == 8 and tail.start_window == 5
+    np.testing.assert_array_equal(whole.fill(), whole.present.sum(axis=1))
+    # Lag bookkeeping is exact: every present slot's lag is fold - origin.
+    w_abs = np.arange(8)[:, None]
+    np.testing.assert_array_equal(
+        whole.lag[whole.present], (w_abs - whole.origin)[whole.present]
+    )
+
+
+def test_window_schedule_backpressure_and_staleness_gate():
+    n, seed = 64, 11
+    names = vnode_names(n)
+    slow = np.full(n, 5.0, np.float32)  # everyone up to 4 windows late
+    plan = AsyncWindowPlan(
+        seed=seed, fraction=0.25, names=tuple(names),
+        trace="flash", period=6, stall_patience=2, max_lag=4,
+    )
+    sched = compile_window_schedule(plan, names, 24, speeds=slow)
+    k = sched.cohort_k
+    # Stall-patience backpressure: solicitation pauses while the queue is
+    # deeper than patience*K, so it can never exceed (patience + 1) * K.
+    assert sched.queue_depth.max() <= (2 + 1) * k
+    assert (sched.lag[sched.present] <= 4).all()
+    # A max_lag=0 gate under the same slow fleet drops the late arrivals
+    # instead of folding them stale.
+    strict = AsyncWindowPlan(
+        seed=seed, fraction=0.25, names=tuple(names),
+        trace="flash", period=6, stall_patience=2, max_lag=0,
+    )
+    sgate = compile_window_schedule(strict, names, 24, speeds=slow)
+    assert (sgate.lag[sgate.present] == 0).all()
+    assert int(sgate.dropped.sum()) > 0
+
+
+def test_staleness_discount_is_the_wire_weight():
+    """The fused fold and the wire buffer multiply through ONE shared pure
+    function — jitted it must match the wire's scalar weight exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    from p2pfl_tpu.learning.aggregators import staleness_discount, staleness_weight
+
+    alpha = float(Settings.ASYNC_STALENESS_ALPHA)
+    lags = jnp.arange(0, 6, dtype=jnp.int32)
+    fused = np.asarray(jax.jit(lambda l: staleness_discount(l, alpha))(lags))
+    wire = np.asarray([staleness_weight(int(l)) for l in range(6)], np.float32)
+    np.testing.assert_allclose(fused, wire, rtol=1e-6)
+    assert fused[0] == 1.0  # fresh contributions are undiscounted
+    assert (np.diff(fused) < 0).all()  # strictly decaying in lag
+
+
+# --- engine: bit-exactness contracts -----------------------------------------
+
+
+def test_zero_lag_async_matches_sync_engine():
+    """All tiers 1.0 + uniform trace: every window folds its full cohort
+    fresh with discount exactly 1.0, so the async window program IS the
+    sync round program — same hash, bit for bit (not an accuracy check)."""
+    from p2pfl_tpu.population import AsyncPopulationEngine, PopulationEngine
+    from p2pfl_tpu.telemetry.ledger import canonical_params_hash
+
+    kw = dict(
+        cohort_fraction=0.5, seed=7, samples_per_node=8, feature_dim=8,
+        num_classes=4, hidden=(8,), batch_size=4, lr=0.05,
+    )
+    with PopulationEngine(12, **kw) as sync:
+        sync.run(5)
+        sync_hash = canonical_params_hash(sync.gather_params(0))
+    with AsyncPopulationEngine(12, **kw) as a:
+        res = a.run(5, eval_every=5)
+        async_hash = canonical_params_hash(a.global_params())
+    assert async_hash == sync_hash
+    assert (res.close_codes == CLOSE_FILL).all()
+    assert (res.schedule.lag[res.schedule.present] == 0).all()
+
+
+def test_async_checkpoint_resume_replays_window_stream(tmp_path):
+    """Kill after 4 windows, restore, run 3 more: the healed engine must
+    re-stream the identical window/arrival schedule from the absolute
+    cursor — same global hash AND same per-vnode fold accounting as the
+    uninterrupted 7-window reference."""
+    from p2pfl_tpu.management.checkpoint import FLCheckpointer
+    from p2pfl_tpu.population import AsyncPopulationEngine
+    from p2pfl_tpu.telemetry.ledger import canonical_params_hash
+
+    kw = dict(
+        cohort_fraction=0.5, seed=4, samples_per_node=8, feature_dim=8,
+        num_classes=4, hidden=(8,), batch_size=4,
+        speed_tiers=(1.0, 2.0, 5.0),
+    )
+    with AsyncPopulationEngine(12, **kw) as ref:
+        ref.run(7, eval_every=10)
+        ref_hash = canonical_params_hash(ref.global_params())
+        ref_fill = ref.window_fill()
+    ckpt = FLCheckpointer(str(tmp_path))
+    with AsyncPopulationEngine(12, **kw) as victim:
+        victim.run(4, eval_every=10)
+        assert victim.save_to(ckpt)
+    with AsyncPopulationEngine(12, **kw) as healed:
+        assert healed.load_from(ckpt) == 4
+        healed.run(3, eval_every=10)
+        assert canonical_params_hash(healed.global_params()) == ref_hash
+        np.testing.assert_allclose(healed.window_fill(), ref_fill)
+    # A seed-mismatched checkpoint must refuse (the stream would diverge).
+    with AsyncPopulationEngine(12, **{**kw, "seed": 5}) as wrong:
+        with pytest.raises(ValueError, match="seed"):
+            wrong.load_from(ckpt)
+
+
+def test_wire_vs_fused_async_parity_n4():
+    """The REAL AsyncBufferedAggregator replaying the compiled window
+    stream must emit a ledger that aligns with the fused engine's —
+    aggregate hashes bit-exact, final params bit-equal (staleness weights
+    and all)."""
+    import jax
+
+    from p2pfl_tpu.population import AsyncPopulationEngine, wire_window_replay
+    from p2pfl_tpu.telemetry.ledger import LEDGERS
+
+    parity_diff = _load_parity_diff()
+    par_kw = dict(
+        cohort_fraction=1.0, seed=1236, samples_per_node=8, feature_dim=8,
+        num_classes=4, hidden=(8,), batch_size=4,
+        speed_tiers=(1.0, 1.0, 2.0, 3.0),
+    )
+    windows = 4
+    LEDGERS.reset()
+    with AsyncPopulationEngine(4, **par_kw) as fused:
+        led = fused.attach_ledger("fused-async-test")
+        res = fused.run(windows, eval_every=100, windows_per_call=1)
+        fused_ev = led.canonical_events()
+        fused_params = fused.global_params()
+    assert res.schedule.lag[res.schedule.present].max() > 0  # staleness live
+    weng = AsyncPopulationEngine(4, **par_kw)
+    wire = wire_window_replay(weng, windows, node="wire-async-test")
+    weng.close()
+    wire_ev = LEDGERS.get("wire-async-test").canonical_events()
+    report = parity_diff.compare_ledgers(wire_ev, fused_ev)
+    assert report["status"] == "OK", report
+    assert report["hashes_compared"] >= 1
+    for la, lb in zip(
+        jax.tree.leaves(wire["final_params"]), jax.tree.leaves(fused_params)
+    ):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_async_snapshot_carries_window_columns():
+    from p2pfl_tpu.population import AsyncPopulationEngine
+
+    with AsyncPopulationEngine(
+        8, cohort_fraction=0.5, seed=3, samples_per_node=8, feature_dim=8,
+        num_classes=4, hidden=(8,), batch_size=4,
+    ) as eng:
+        res = eng.run(3, eval_every=3)
+        snap = eng.snapshot(res, top_n=4)
+    assert len(snap["peers"]) == 4
+    for peer in snap["peers"].values():
+        assert peer["window"] is not None and peer["window"] >= 0
+        assert peer["window_fill"] is not None and 0.0 <= peer["window_fill"] <= 1.0

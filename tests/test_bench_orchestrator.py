@@ -149,3 +149,85 @@ def test_aux_captures_mutate_attached_dict_in_place(monkeypatch):
     assert out is attached
     # By the time leg 2 ran, leg 1's completed result was already attached.
     assert seen_at_leg2.get("cifar_resnet_trio") == {"metric": "--cifar"}
+
+
+# --- probe verdict cache + the assume-backend knob (PR 16) --------------------
+
+
+@pytest.fixture
+def _clean_probe_state():
+    """Probe verdicts are per-invocation module state; isolate each test."""
+    bench._PROBE_CACHE[0] = None
+    bench._TPU_FAIL_REASON[0] = None
+    yield
+    bench._PROBE_CACHE[0] = None
+    bench._TPU_FAIL_REASON[0] = None
+
+
+def test_probe_cache_up_verdict_reused(_clean_probe_state):
+    """A found chip is definitive for the whole invocation: later probes
+    must answer from the cache without spawning a subprocess (timeout so
+    small a real probe could never succeed)."""
+    bench._PROBE_CACHE[0] = ("up", "TPU v5 lite")
+    assert bench._subprocess_tpu_probe(timeout=0.001) == "TPU v5 lite"
+    assert bench._TPU_FAIL_REASON[0] is None
+
+
+def test_probe_cache_down_verdict_stops_wait_ladder(monkeypatch, _clean_probe_state):
+    """A clean negative ("tpu_absent") is definitive — the wait ladder must
+    stop after ONE probe instead of sleeping its budget against it (the
+    r03+ burn the cache exists to stop)."""
+    calls = []
+
+    def fake_probe(t=90.0):
+        calls.append(t)
+        bench._TPU_FAIL_REASON[0] = "tpu_absent"
+        bench._PROBE_CACHE[0] = ("down", "tpu_absent")
+        return None
+
+    sleeps = []
+    real_probe = bench._subprocess_tpu_probe
+    monkeypatch.setattr(bench, "_subprocess_tpu_probe", fake_probe)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: sleeps.append(s))
+    out = bench.wait_for_tpu(deadline=time.monotonic() + 3600.0, probe_timeout=1.0)
+    assert out is None
+    assert len(calls) == 1 and sleeps == []
+    # And a later direct probe answers from the cache, stamping the reason.
+    bench._TPU_FAIL_REASON[0] = None
+    assert real_probe(timeout=0.001) is None
+    assert bench._TPU_FAIL_REASON[0] == "tpu_absent"
+
+
+def test_probe_timeout_is_never_cached(_clean_probe_state):
+    """A timeout is a transient non-answer: the ladder must keep re-asking,
+    so it must NOT settle the verdict cache."""
+    assert bench._subprocess_tpu_probe(timeout=0.05) is None
+    assert bench._TPU_FAIL_REASON[0] == "tpu_probe_timeout"
+    assert bench._PROBE_CACHE[0] is None
+
+
+def test_assume_cpu_skips_probe_and_ladder(monkeypatch, _clean_probe_state):
+    """P2PFL_TPU_BENCH_ASSUME_BACKEND=cpu skips every probe AND the whole
+    wait ladder, while fallback_reason still records how the arm degraded."""
+    from p2pfl_tpu.config import Settings
+
+    monkeypatch.setattr(Settings, "BENCH_ASSUME_BACKEND", "cpu")
+    assert bench._subprocess_tpu_probe(timeout=0.001) is None
+    assert bench._TPU_FAIL_REASON[0] == "assumed_backend"
+    calls = []
+    monkeypatch.setattr(bench, "_subprocess_tpu_probe", lambda t=90.0: calls.append(t))
+    t0 = time.monotonic()
+    out = bench.wait_for_tpu(deadline=time.monotonic() + 3600.0, probe_timeout=30.0)
+    assert out is None and calls == []
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_assume_tpu_short_circuits_probe(monkeypatch, _clean_probe_state):
+    """The settled-verdict self-propagation path: an arm subprocess spawned
+    with ASSUME_BACKEND=tpu answers instantly without re-probing."""
+    from p2pfl_tpu.config import Settings
+
+    monkeypatch.setattr(Settings, "BENCH_ASSUME_BACKEND", "tpu")
+    assert bench._subprocess_tpu_probe(timeout=0.001) == "TPU (assumed)"
+    assert bench._TPU_FAIL_REASON[0] is None
+    assert bench._PROBE_CACHE[0] is None  # an assumption is not a verdict

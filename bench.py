@@ -4710,6 +4710,252 @@ def run_asyncpop_bench() -> None:
     _emit(out, seed=locals().get("seed"), backend="cpu")
 
 
+def run_devobs_bench() -> None:
+    """Subprocess-style mode ``--devobs``: device-observatory acceptance
+    run, three arms, all on the CPU venue (protocol/scale bench).
+
+    **Overhead arm** (``P2PFL_TPU_DEVOBS_BENCH_NODES`` vnodes, default the
+    100k north-star shape): the SAME seeded cohort-sampled population runs
+    twice — in-scan telemetry on, then off — warmup first, best-of-two
+    timed calls each. Gates: wall ratio on/off under
+    ``DEVOBS_BENCH_MAX_OVERHEAD`` (default 1.05 — the aux stream rides the
+    scan's ys side, so <5% is the contract, not a hope) AND the node-0
+    canonical params hash BIT-IDENTICAL between the two arms (telemetry
+    must never touch the math). The on-arm's sketch stream
+    (``update_norm`` / ``train_loss``), the ``p2pfl_mesh_*`` Prometheus
+    family, and a fed_top render with the LOSS/GNORM columns populated are
+    all asserted, and the ``perf.devobs`` block (device peak bytes,
+    compile seconds, AOT scan FLOPs/bytes) is stamped for
+    ``scripts/perf_diff.py``'s devobs gate.
+
+    **Tripwire arm** (small population, seeded NaN injection via
+    ``DEVOBS_NAN_INJECT_ROUND``): with ``park`` the run must stop within
+    the injected round's chunk, return a partial result carrying the trip
+    record, and dump the flight recorder; with ``abort`` the same trip
+    must raise with state parked (params still readable).
+
+    Shape overrides: the ``P2PFL_TPU_DEVOBS_BENCH_*`` Settings knobs — CI
+    runs a small population; the default is the acceptance shape.
+    """
+    out: dict = {}
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"  # protocol/scale bench: CPU venue
+        import numpy as np  # noqa: F401
+
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from p2pfl_tpu.config import Settings
+        from p2pfl_tpu.management.profiler import (
+            device_memory_watermark,
+            perf_section,
+        )
+        from p2pfl_tpu.population import PopulationEngine
+        from p2pfl_tpu.telemetry import REGISTRY
+        from p2pfl_tpu.telemetry.export import render_prometheus
+        from p2pfl_tpu.telemetry.ledger import canonical_params_hash
+        from p2pfl_tpu.telemetry.sketches import SKETCHES
+
+        n = int(Settings.DEVOBS_BENCH_NODES)
+        rounds = int(Settings.DEVOBS_BENCH_ROUNDS)
+        fraction = float(Settings.DEVOBS_BENCH_COHORT)
+        max_overhead = float(Settings.DEVOBS_BENCH_MAX_OVERHEAD)
+        rpc = max(1, rounds // 2)
+        seed = 42
+        art = os.path.join(REPO, "artifacts")
+        os.makedirs(art, exist_ok=True)
+        snap_path = os.path.join(art, "federation_snapshot.json")
+
+        def _timed_arm(devobs_on: bool, snapshot: bool):
+            """(best wall, params hash, compile wall, engine extras)."""
+            Settings.DEVOBS_ENABLED = devobs_on
+            Settings.DEVOBS_NAN_INJECT_ROUND = -1
+            eng = PopulationEngine(
+                n, cohort_fraction=fraction, seed=seed,
+                speed_tiers=(1.0, 1.0, 1.0, 2.0, 5.0),
+            )
+            try:
+                t0 = time.monotonic()
+                res = eng.run(rounds, warmup=True, rounds_per_call=rpc)
+                compile_s = (time.monotonic() - t0) - res.seconds_total
+                walls = [res.seconds_total]
+                res2 = eng.run(rounds, rounds_per_call=rpc)
+                walls.append(res2.seconds_total)
+                h = canonical_params_hash(eng.gather_params(0))
+                extra: dict = {}
+                if snapshot:
+                    eng.snapshot(res2, path=snap_path)
+                    # AOT cost analysis of the exact scanned program (the
+                    # perf.devobs gate's FLOPs/bytes source).
+                    extra["cost"] = eng.sim.round_cost_analysis(
+                        rounds_per_call=rpc, devobs=devobs_on
+                    )
+                return min(walls), h, compile_s, extra
+            finally:
+                eng.close()
+
+        _phase(
+            f"devobs overhead arm: n={n}, {rounds} rounds x2 calls, "
+            f"cohort {fraction:g}, telemetry ON"
+        )
+        REGISTRY.reset()
+        SKETCHES.reset()
+        on_wall, on_hash, compile_s, on_extra = _timed_arm(True, snapshot=True)
+        for metric in ("update_norm", "train_loss"):
+            sk = SKETCHES.get(metric, "mesh-sim")
+            if sk is None or sk.count <= 0:
+                raise AssertionError(
+                    f"devobs on-arm streamed no {metric} sketch buckets"
+                )
+        prom = render_prometheus(REGISTRY)
+        if "p2pfl_mesh_train_loss" not in prom or "p2pfl_mesh_round" not in prom:
+            raise AssertionError(
+                "p2pfl_mesh_* family missing from the Prometheus exposition"
+            )
+        wm = device_memory_watermark()
+        _phase(f"devobs overhead arm: telemetry OFF (same seed/shape)")
+        off_wall, off_hash, _, _ = _timed_arm(False, snapshot=False)
+        overhead = on_wall / max(off_wall, 1e-9)
+        if on_hash != off_hash:
+            raise AssertionError(
+                f"telemetry changed the math: on-hash {on_hash} != "
+                f"off-hash {off_hash}"
+            )
+        if overhead > max_overhead:
+            raise AssertionError(
+                f"devobs overhead {overhead:.3f}x exceeds the "
+                f"{max_overhead:g}x gate (on {on_wall:.2f}s / off "
+                f"{off_wall:.2f}s)"
+            )
+        # Acceptance surface is the rendered view: LOSS/GNORM must be
+        # populated (not '-') for the tracked virtual rows.
+        top = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "fed_top.py"),
+             snap_path, "--once"],
+            capture_output=True, text=True, timeout=60,
+        )
+        if top.returncode != 0 or "LOSS" not in top.stdout:
+            raise AssertionError(
+                f"fed_top render failed (rc={top.returncode}): "
+                f"{top.stderr[-500:]}"
+            )
+        with open(snap_path) as f:
+            snap_doc = json.load(f)
+        grafted = [
+            p for p in snap_doc["peers"].values()
+            if p.get("stage") == "virtual" and p.get("loss") is not None
+        ]
+        if not grafted:
+            raise AssertionError(
+                "no virtual peer row carries the in-scan loss graft"
+            )
+        _phase(
+            f"devobs overhead: {overhead:.3f}x (on {on_wall:.2f}s / off "
+            f"{off_wall:.2f}s), params hash identical"
+        )
+
+        # --- tripwire arm ----------------------------------------------------
+        n_trip = min(n, 256)
+        inject_at = 3  # chunk 1 with rounds_per_call=2
+        trip_rpc = 2
+        _phase(
+            f"devobs tripwire arm: n={n_trip}, NaN injected at round "
+            f"{inject_at}, park then abort"
+        )
+        Settings.DEVOBS_ENABLED = True
+        Settings.DEVOBS_NAN_INJECT_ROUND = inject_at
+        Settings.DEVOBS_TRIP_ACTION = "park"
+        with PopulationEngine(n_trip, cohort_fraction=0.25, seed=seed + 1) as eng:
+            res = eng.run(8, rounds_per_call=trip_rpc)
+            trip = res.tripped
+            if trip is None or trip["kind"] != "nonfinite":
+                raise AssertionError(f"park arm did not trip: {trip}")
+            if trip["round"] != inject_at:
+                raise AssertionError(
+                    f"tripped at round {trip['round']}, injected {inject_at}"
+                )
+            # Within one chunk: the run stopped at the tripping chunk's
+            # boundary, not after the full schedule.
+            tripped_chunk_end = (inject_at // trip_rpc + 1) * trip_rpc
+            if res.rounds != tripped_chunk_end:
+                raise AssertionError(
+                    f"park arm ran {res.rounds} rounds; expected to stop at "
+                    f"the tripping chunk boundary {tripped_chunk_end}"
+                )
+            flightrec = trip.get("flightrec")
+            if not flightrec or not os.path.exists(flightrec):
+                raise AssertionError(
+                    f"tripwire flight-recorder dump missing: {flightrec}"
+                )
+        Settings.DEVOBS_TRIP_ACTION = "abort"
+        abort_raised = False
+        eng = PopulationEngine(n_trip, cohort_fraction=0.25, seed=seed + 2)
+        try:
+            try:
+                eng.run(8, rounds_per_call=trip_rpc)
+            except RuntimeError as err:
+                abort_raised = "devobs tripwire" in str(err)
+            if not abort_raised:
+                raise AssertionError("abort arm did not raise the trip contract")
+            if eng.sim.params_stack is None:
+                raise AssertionError("abort arm nuked state; expected it parked")
+            canonical_params_hash(eng.gather_params(0))  # parked == readable
+        finally:
+            eng.close()
+        Settings.DEVOBS_NAN_INJECT_ROUND = -1
+        Settings.DEVOBS_TRIP_ACTION = "abort"
+        _phase("devobs tripwire arm: park partial + abort raise both honored")
+
+        cost = on_extra.get("cost") or {}
+        out = {
+            "bench": "p2pfl_tpu",
+            "mode": "devobs",
+            "metric": "devobs_overhead_ratio",
+            "value": round(overhead, 4),
+            "unit": "x_on_over_off",
+            "extra": {
+                "nodes": n,
+                "rounds_per_call": rpc,
+                "rounds_per_arm": rounds,
+                "wall_s_on": round(on_wall, 4),
+                "wall_s_off": round(off_wall, 4),
+                "max_overhead": max_overhead,
+                "params_hash_match": True,
+                "snapshot": snap_path,
+                "tripwire": {
+                    "nodes": n_trip,
+                    "inject_round": inject_at,
+                    "park_rounds_run": tripped_chunk_end,
+                    "flightrec": flightrec,
+                    "abort_raised": True,
+                },
+            },
+        }
+        out["perf"] = perf_section(
+            REGISTRY,
+            cost=cost or None,
+            extra={
+                "devobs": {
+                    "device_peak_bytes": wm["peak_bytes_in_use"],
+                    "compile_seconds": round(max(0.0, compile_s), 4),
+                    "scan_flops": cost.get("flops"),
+                    "scan_bytes": cost.get("bytes_accessed"),
+                }
+            },
+        )
+        out["meta"] = _bench_meta(seed=seed, backend="cpu")
+        with open(os.path.join(art, "DEVOBS_BENCH.json"), "w") as f:
+            json.dump(out, f, indent=1)
+        _phase(
+            f"devobs bench done: {overhead:.3f}x overhead, hash identical, "
+            f"NaN tripped in-chunk at round {inject_at}"
+        )
+    except Exception as e:  # noqa: BLE001
+        traceback.print_exc(file=sys.stderr)
+        out["error"] = f"{type(e).__name__}: {e}"
+    _emit(out, seed=locals().get("seed"), backend="cpu")
+
+
 def run_critical_path_bench() -> None:
     """Subprocess-style mode ``--critical-path``: performance-attribution
     acceptance run.
@@ -5671,6 +5917,8 @@ if __name__ == "__main__":
         run_fleetobs_bench()
     elif "--asyncpop" in sys.argv:
         run_asyncpop_bench()
+    elif "--devobs" in sys.argv:
+        run_devobs_bench()
     elif "--population" in sys.argv:
         run_population_bench()
     elif "--critical-path" in sys.argv:

@@ -410,6 +410,46 @@ class Settings:
     PARITY_NODES: int = _env_int("PARITY_NODES", 3, 2, 64)
     PARITY_ROUNDS: int = _env_int("PARITY_ROUNDS", 2, 1, 100)
     PARITY_SEED: int = _env_int("PARITY_SEED", 1234, 0, 2**31 - 1)
+    # Device observatory (in-scan telemetry for the fused population
+    # engines): when enabled, the compiled round/window body emits a
+    # static-shape auxiliary stream — cohort loss, fold-weight mass,
+    # update-norm sketch buckets, NaN/Inf + loss-divergence tripwire flags —
+    # that the host folds into the SKETCHES registry and the p2pfl_mesh_*
+    # Prometheus family per chunk. The aux stream rides only the scan's
+    # outputs: the parameter math is bit-identical with telemetry on or off.
+    DEVOBS_ENABLED: bool = _env_override("DEVOBS_ENABLED", True)
+    # What a tripped health guard does at the next chunk boundary:
+    # "abort" raises (state already safe — the trip is detected between
+    # chunks, after donation completed), "park" stops launching chunks and
+    # returns the partial result with the trip stamped on it.
+    DEVOBS_TRIP_ACTION: str = _env_choice("DEVOBS_TRIP_ACTION", "abort", ("abort", "park"))
+    # Leading timed chunks wrapped in device_trace_window (per-chunk device
+    # profiles + memory watermarks); 0 disables per-chunk profiling.
+    DEVOBS_PROFILE_CHUNKS: int = _env_int("DEVOBS_PROFILE_CHUNKS", 1, 0, 1024)
+    # Loss-divergence tripwire: trip when a round's cohort loss exceeds this
+    # multiple of the best (lowest) finite loss seen so far in the chunk.
+    DEVOBS_LOSS_DIVERGE_MULT: float = _env_float(
+        "DEVOBS_LOSS_DIVERGE_MULT", 100.0, 1.0, 1e9
+    )
+    # TTL for the cached live-array byte sum backing device_mem_bytes():
+    # summing jax.live_arrays() on every digest beat is O(live arrays).
+    DEVOBS_MEM_TTL_S: float = _env_float("DEVOBS_MEM_TTL_S", 5.0, 0.0, 3600.0)
+    # Seeded fault injection for the tripwire gates (bench --devobs NaN arm,
+    # make devobs-check): corrupt the aggregate with NaN at this ABSOLUTE
+    # round/window index inside the compiled scan. -1 (default) disables —
+    # and with it the injection branch is not even traced.
+    DEVOBS_NAN_INJECT_ROUND: int = _env_int("DEVOBS_NAN_INJECT_ROUND", -1, -1, 1 << 30)
+    # bench.py --devobs shape (overridable for CI-scale smoke runs): the
+    # telemetry-overhead arm runs this population twice (devobs on vs off,
+    # same seed) and gates the on/off wall ratio + params-hash equality.
+    DEVOBS_BENCH_NODES: int = _env_int("DEVOBS_BENCH_NODES", 100_000, 8, 1 << 24)
+    DEVOBS_BENCH_ROUNDS: int = _env_int("DEVOBS_BENCH_ROUNDS", 8, 2, 10_000)
+    DEVOBS_BENCH_COHORT: float = _env_float("DEVOBS_BENCH_COHORT", 0.01, 0.0, 1.0)
+    # Max telemetry-on / telemetry-off wall-clock ratio the bench accepts
+    # (ISSUE acceptance: <5% overhead at the population shape).
+    DEVOBS_BENCH_MAX_OVERHEAD: float = _env_float(
+        "DEVOBS_BENCH_MAX_OVERHEAD", 1.05, 1.0, 10.0
+    )
 
     # --- population-scale engine (population/) ------------------------------
     # Cohort sampling (Papaya, arxiv 2111.04877): each round/window solicits

@@ -165,6 +165,66 @@ def captured_device_traces() -> List[str]:
         return list(_captured_traces)
 
 
+# (monotonic stamp, byte sum) of the last live-array sweep; None = never.
+_live_sum_cache: Optional[tuple] = None
+
+
+def live_arrays_bytes(ttl_s: Optional[float] = None) -> float:
+    """Sum of live jax array buffer bytes, cached for
+    ``Settings.DEVOBS_MEM_TTL_S`` (override with ``ttl_s``; 0 = resweep).
+
+    The sweep is O(live arrays) — a 100k-vnode population holds thousands
+    of buffers, and the digest beat used to pay that walk on EVERY beat.
+    All beat-path callers now share one sweep per TTL. Never raises.
+    """
+    global _live_sum_cache
+    try:
+        if ttl_s is None:
+            from p2pfl_tpu.config import Settings
+
+            ttl_s = float(Settings.DEVOBS_MEM_TTL_S)
+        now = time.monotonic()
+        cached = _live_sum_cache
+        if cached is not None and ttl_s > 0 and now - cached[0] <= ttl_s:
+            return cached[1]
+        import jax
+
+        val = float(sum(int(a.nbytes) for a in jax.live_arrays()))
+        _live_sum_cache = (now, val)
+        return val
+    except Exception:  # noqa: BLE001 — observation must not raise
+        return 0.0
+
+
+def device_memory_watermark() -> Dict[str, float]:
+    """``{"bytes_in_use", "peak_bytes_in_use"}`` of device 0, best effort.
+
+    Backend ``memory_stats()`` when the platform exposes them (TPU/GPU
+    report a true allocator peak), else the TTL-cached live-array sum (CPU:
+    in-use only — the peak then equals in-use). Never raises; all-zero when
+    JAX is absent. The device observatory stamps this around every timed
+    chunk (flight-recorder chunk events, bench ``devobs`` perf block)."""
+    try:
+        import jax
+
+        stats = None
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+        except Exception:  # noqa: BLE001 — CPU backend has no memory_stats
+            stats = None
+        if stats and stats.get("bytes_in_use"):
+            in_use = float(stats.get("bytes_in_use", 0.0) or 0.0)
+            peak = float(stats.get("peak_bytes_in_use", 0.0) or 0.0)
+            return {
+                "bytes_in_use": in_use,
+                "peak_bytes_in_use": max(in_use, peak),
+            }
+        live = live_arrays_bytes()
+        return {"bytes_in_use": live, "peak_bytes_in_use": live}
+    except Exception:  # noqa: BLE001
+        return {"bytes_in_use": 0.0, "peak_bytes_in_use": 0.0}
+
+
 def _gauge_by_node(registry: Any, name: str) -> Dict[str, float]:
     """Counter/gauge family -> {node label: value} (empty when absent)."""
     fam = registry.get(name)

@@ -53,6 +53,10 @@ from p2pfl_tpu.learning.privacy import resolve_seed
 from p2pfl_tpu.models.model_handle import ModelHandle
 from p2pfl_tpu.ops import aggregation as agg_ops
 from p2pfl_tpu.parallel.mesh import make_mesh
+from p2pfl_tpu.telemetry.sketches import (
+    device_bucket_spec,
+    device_bucket_stats,
+)
 
 Pytree = Any
 
@@ -164,6 +168,10 @@ class SimulationResult:
     test_acc: List[float] = field(default_factory=list)
     test_loss: List[float] = field(default_factory=list)
     committees: Optional[np.ndarray] = None  # [rounds, K] node indices
+    #: device-observatory tripwire record (None = clean run): {kind:
+    #: nonfinite|loss_diverge, round, chunk, action, flightrec}. Present
+    #: only on parked runs — DEVOBS_TRIP_ACTION=abort raises instead.
+    tripped: Optional[Dict[str, Any]] = None
 
     def summary(self) -> Dict[str, float]:
         return {
@@ -216,6 +224,87 @@ def vote_committee(key: jax.Array, n: int, k: int) -> jax.Array:
     tally = jnp.zeros((n,), jnp.float32).at[cands.reshape(-1)].add(weights.reshape(-1))
     # stable argsort on -tally -> top-k by weight with index tie-break
     return jnp.argsort(-tally, stable=True)[:k]
+
+
+def fold_devobs_chunk(
+    aux: Dict[str, Any],
+    train_loss: Any,
+    *,
+    first_round: int,
+    node: str,
+    spec: Tuple[float, int, int],
+    last: Dict[str, Any],
+) -> Optional[Dict[str, Any]]:
+    """Host-side fold of one chunk's in-scan devobs aux stream — shared by
+    the sync round engine and the async window engine (same aux schema,
+    different ``node`` label).
+
+    Device bucket counts go into the ``SKETCHES`` registry
+    (``update_norm``), per-round/-window cohort losses into the
+    ``train_loss`` sketch, headline values into the ``p2pfl_mesh_*``
+    gauges, and the freshest values into ``last`` (the engine's
+    ``_devobs_last`` — what snapshots graft onto peer rows). Returns the
+    chunk's first tripwire trip ``{kind, round}`` or ``None``.
+    """
+    from p2pfl_tpu.telemetry.observatory import mesh_chunk_telemetry
+    from p2pfl_tpu.telemetry.sketches import SKETCHES
+
+    gamma_log, lo_idx, _ = spec
+    counts = np.asarray(aux["un_counts"])  # [rounds, nbins]
+    tr = np.asarray(train_loss, np.float64)  # [rounds]
+    vmin = float(np.asarray(aux["un_min"]).min())
+    vmax = float(np.asarray(aux["un_max"]).max())
+    SKETCHES.fold_buckets(
+        "update_norm", node, gamma_log, lo_idx, counts.sum(axis=0),
+        zeros=float(np.asarray(aux["un_zeros"]).sum()),
+        vsum=float(np.asarray(aux["un_sum"]).sum()),
+        vmin=vmin if np.isfinite(vmin) else None,
+        vmax=vmax if np.isfinite(vmax) else None,
+    )
+    finite_tr = tr[np.isfinite(tr)]
+    for v in finite_tr:
+        SKETCHES.observe("train_loss", node, float(v))
+    last_loss = float(finite_tr[-1]) if finite_tr.size else None
+    mesh_chunk_telemetry(
+        node,
+        round_cursor=first_round + tr.shape[0] - 1,
+        train_loss=last_loss,
+        weight_mass=float(np.asarray(aux["weight_mass"])[-1]),
+        participants=float(np.asarray(aux["participants"]).sum()),
+    )
+    last["train_loss"] = last_loss
+    sk = SKETCHES.get("update_norm", node)
+    if sk is not None and sk.count > 0:
+        last["update_norm_p90"] = round(sk.quantile(0.9), 6)
+    trips = []
+    nf = np.flatnonzero(np.asarray(aux["nonfinite"]))
+    dv = np.flatnonzero(np.asarray(aux["diverged"]))
+    if nf.size:
+        trips.append(("nonfinite", first_round + int(nf[0])))
+    if dv.size:
+        trips.append(("loss_diverge", first_round + int(dv[0])))
+    if not trips:
+        return None
+    kind, rnd = min(trips, key=lambda kv: kv[1])
+    return {"kind": kind, "round": rnd}
+
+
+def devobs_summary_for(
+    node: str, last: Dict[str, Any]
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """``(extras, extra_sketches)`` for one engine's devobs stream — the
+    snapshot graft inputs (:func:`~p2pfl_tpu.telemetry.observatory.
+    population_snapshot` ``extras``/``extra_sketches``)."""
+    from p2pfl_tpu.telemetry.sketches import SKETCHES
+
+    extras = dict(last)
+    extras.setdefault("tripped", None)
+    sketches: Dict[str, Any] = {}
+    for metric in ("update_norm", "train_loss"):
+        sk = SKETCHES.get(metric, node)
+        if sk is not None and sk.count > 0:
+            sketches[metric] = sk
+    return extras, sketches
 
 
 class MeshSimulation:
@@ -382,6 +471,15 @@ class MeshSimulation:
         # Trajectory-ledger attachment (attach_ledger): None = no emission.
         self._ledger = None
         self._ledger_names: Optional[List[str]] = None
+        # Device observatory (config.DEVOBS_*): the static bucket spec the
+        # in-scan sketch aux uses (trace-time constants — part of the
+        # compiled program), the engine's flight recorder (lazy), and the
+        # last chunk's host-folded summary that fleet_snapshot grafts onto
+        # the population document.
+        self._devobs_spec = device_bucket_spec()
+        self._devobs_node = "mesh-sim"
+        self._recorder: Any = None
+        self._devobs_last: Dict[str, Any] = {}
         self.task = task
         self.algorithm = algorithm
         self.scaffold_global_lr = float(scaffold_global_lr)
@@ -676,6 +774,7 @@ class MeshSimulation:
     def _round_body(
         self, carry, key: jax.Array, do_eval: jax.Array, data, epochs: int,
         committee: Optional[jax.Array] = None,
+        round_idx: Optional[jax.Array] = None, devobs: bool = False,
     ):
         params_stack, opt_stack, c_stack, c_global = carry
         x, y, sample_mask, num_samples, xt, yt = data
@@ -811,6 +910,66 @@ class MeshSimulation:
                 )
                 c_global = {"server_opt": new_sstate}
 
+        if round_idx is not None and int(Settings.DEVOBS_NAN_INJECT_ROUND) >= 0:
+            # Seeded fault injection for the tripwire bench/gate: corrupt
+            # the aggregate with NaNs at one absolute round index. Python-
+            # gated — with the knob at -1 (default) this branch is never
+            # even traced, so production programs carry zero cost.
+            bad = round_idx == jnp.int32(int(Settings.DEVOBS_NAN_INJECT_ROUND))
+            agg = jax.tree.map(
+                lambda a: jnp.where(bad, jnp.full_like(a, jnp.nan), a), agg
+            )
+
+        # Device-observatory aux stream: static-shape telemetry riding the
+        # scan's ys side ONLY — nothing here feeds back into the carry, so
+        # the param math (and the final params hash) is bit-identical with
+        # devobs on or off. `devobs` is a trace-time flag: off emits zeros
+        # of the same shapes (the unpack stays uniform) and XLA dead-code-
+        # eliminates the real computation.
+        gamma_log, lo_idx, nbins = self._devobs_spec
+        if devobs:
+            sq = jax.tree.map(
+                lambda new, old: jnp.sum(
+                    (new.astype(jnp.float32) - old.astype(jnp.float32)) ** 2,
+                    axis=tuple(range(1, new.ndim)),
+                ),
+                p_k_new,
+                p_k,
+            )
+            # Per-member round-delta global norms -> DDSketch-compatible
+            # bucket counts, computed on device (sketches.device_bucket_*);
+            # the host folds them into SKETCHES["update_norm"] per chunk.
+            norms = jnp.sqrt(sum(jax.tree.leaves(sq)) + 1e-12)  # [K]
+            st = device_bucket_stats(
+                norms, gamma_log=gamma_log, lo_idx=lo_idx, nbins=nbins
+            )
+            agg_finite = jnp.bool_(True)
+            for leaf in jax.tree.leaves(agg):
+                agg_finite &= jnp.isfinite(leaf).all()
+            aux = {
+                "un_counts": st["counts"],
+                "un_zeros": st["zeros"],
+                "un_sum": st["sum"].astype(jnp.float32),
+                "un_min": st["min"].astype(jnp.float32),
+                "un_max": st["max"].astype(jnp.float32),
+                "weight_mass": num_samples[committee]
+                .sum()
+                .astype(jnp.float32),
+                "participants": jnp.int32(k_members),
+                "nonfinite": (~agg_finite) | (~jnp.isfinite(losses).all()),
+            }
+        else:
+            aux = {
+                "un_counts": jnp.zeros((nbins,), jnp.int32),
+                "un_zeros": jnp.int32(0),
+                "un_sum": jnp.float32(0),
+                "un_min": jnp.float32(0),
+                "un_max": jnp.float32(0),
+                "weight_mass": jnp.float32(0),
+                "participants": jnp.int32(0),
+                "nonfinite": jnp.bool_(False),
+            }
+
         # Diffusion: every node adopts the aggregated model (gossip's fixed
         # point); committee members keep their updated optimizer state.
         params_stack = jax.tree.map(
@@ -852,18 +1011,18 @@ class MeshSimulation:
             )
         return (
             (params_stack, opt_stack, c_stack, c_global),
-            (committee, losses.mean(), loss, acc),
+            (committee, losses.mean(), loss, acc, aux),
         )
 
     @partial(
         jax.jit,
-        static_argnames=("self", "rounds", "epochs", "eval_every"),
+        static_argnames=("self", "rounds", "epochs", "eval_every", "devobs"),
         donate_argnames=("params_stack", "opt_stack", "c_stack", "c_global"),
     )
     def _run_jit(
         self, params_stack, opt_stack, c_stack, c_global, data, start_round,
         final_round, committee_schedule=None, *, rounds: int, epochs: int,
-        eval_every: int = 1,
+        eval_every: int = 1, devobs: bool = False,
     ):
         # Per-round keys are position-independent (fold_in on the absolute
         # round index): chunking and checkpoint-resume replay identically.
@@ -873,24 +1032,55 @@ class MeshSimulation:
         # Eval cadence on ABSOLUTE round indices (chunk-invariant), plus the
         # final round unconditionally so final_test_acc always exists.
         do_eval = ((idx + 1) % eval_every == 0) | (idx == final_round)
-        carry = (params_stack, opt_stack, c_stack, c_global)
+        diverge_mult = jnp.float32(float(Settings.DEVOBS_LOSS_DIVERGE_MULT))
+
+        # The devobs loss-divergence tripwire threads the chunk's best
+        # finite cohort loss through the scan carry (initialized to +inf
+        # here, dropped at return — the public state signature is
+        # unchanged and stays donation-compatible).
+        def body(c, ke):
+            inner, floor = c
+            if committee_schedule is None:
+                inner, (committee, tr, tl, ta, aux) = self._round_body(
+                    inner, ke[0], ke[1], data, epochs,
+                    round_idx=ke[2], devobs=devobs,
+                )
+            else:
+                # Cohort sampling: one precomputed [rounds, K] committee
+                # row per scanned round (population/cohort.py). None-vs-
+                # array is a trace-time (pytree-structure) distinction, so
+                # the voted and scheduled programs are separate compiled
+                # executables.
+                inner, (committee, tr, tl, ta, aux) = self._round_body(
+                    inner, ke[0], ke[1], data, epochs, committee=ke[3],
+                    round_idx=ke[2], devobs=devobs,
+                )
+            if devobs:
+                finite = jnp.isfinite(tr)
+                aux["diverged"] = (
+                    finite & jnp.isfinite(floor) & (tr > diverge_mult * floor)
+                )
+                floor = jnp.where(finite, jnp.minimum(floor, tr), floor)
+            else:
+                aux["diverged"] = jnp.bool_(False)
+            return (inner, floor), (committee, tr, tl, ta, aux)
+
         if committee_schedule is None:
-            body = lambda c, ke: self._round_body(c, ke[0], ke[1], data, epochs)  # noqa: E731
-            xs: Any = (keys, do_eval)
+            xs: Any = (keys, do_eval, idx)
         else:
-            # Cohort sampling: one precomputed [rounds, K] committee row per
-            # scanned round (population/cohort.py). None-vs-array is a
-            # trace-time (pytree-structure) distinction, so the voted and
-            # scheduled programs are separate compiled executables.
-            body = lambda c, ke: self._round_body(  # noqa: E731
-                c, ke[0], ke[1], data, epochs, committee=ke[2]
-            )
-            xs = (keys, do_eval, committee_schedule)
-        carry, (committees, train_loss, test_loss, test_acc) = jax.lax.scan(
-            body, carry, xs
+            xs = (keys, do_eval, idx, committee_schedule)
+        carry = (
+            (params_stack, opt_stack, c_stack, c_global),
+            jnp.float32(jnp.inf),
         )
-        params_stack, opt_stack, c_stack, c_global = carry
-        return params_stack, opt_stack, c_stack, c_global, committees, train_loss, test_loss, test_acc
+        carry, (committees, train_loss, test_loss, test_acc, aux) = (
+            jax.lax.scan(body, carry, xs)
+        )
+        (params_stack, opt_stack, c_stack, c_global), _ = carry
+        return (
+            params_stack, opt_stack, c_stack, c_global, committees,
+            train_loss, test_loss, test_acc, aux,
+        )
 
     # --- public API ----------------------------------------------------------
 
@@ -986,6 +1176,10 @@ class MeshSimulation:
                     "(mesh-axis fillers are not electable)"
                 )
 
+        # Device observatory: `devobs` is a STATIC jit argument — read once
+        # per run so every chunk (warmup included) compiles one program.
+        devobs = bool(Settings.DEVOBS_ENABLED)
+
         if warmup:
             # Population/opt buffers are donated to the round program (the
             # state is updated in place — half the HBM high-water of a
@@ -1013,6 +1207,7 @@ class MeshSimulation:
                     jnp.int32(start + rounds + chunks[0]),
                     None if sched is None else jnp.asarray(sched[: chunks[0]]),
                     rounds=chunks[0], epochs=epochs, eval_every=eval_every,
+                    devobs=devobs,
                 )
                 jax.block_until_ready(out[0])
                 # Force true retirement (see the matching fetch after the
@@ -1026,31 +1221,53 @@ class MeshSimulation:
                     # deletes it) — rebuild the identical initial population.
                     self._reinit_population()
 
-        from p2pfl_tpu.management.profiler import device_trace_window
+        from p2pfl_tpu.management.profiler import (
+            device_memory_watermark,
+            device_trace_window,
+        )
 
         if profile_dir is None:
             profile_dir = Settings.PERF_TRACE_DIR
+        profile_chunks = int(Settings.DEVOBS_PROFILE_CHUNKS)
+        rec = self._devobs_recorder() if devobs else self._recorder
 
         params_stack, opt_stack = self.params_stack, self.opt_stack
         c_stack, c_global = self.c_stack, self.c_global
         committees, test_loss, test_acc = [], [], []
+        trip: Optional[Dict[str, Any]] = None
         t0 = time.monotonic()
         done = 0
         try:
             for i, chunk in enumerate(chunks):
+                # The leading DEVOBS_PROFILE_CHUNKS timed chunks each get a
+                # windowed device trace (distinct labels cooperate with the
+                # window's capture-once-per-label contract).
                 window = (
-                    device_trace_window(profile_dir, label="mesh_round_chunk")
-                    if i == 0
+                    device_trace_window(
+                        profile_dir, label=f"mesh_round_chunk{i}"
+                    )
+                    if i < profile_chunks
                     else contextlib.nullcontext()
                 )
+                t_chunk = time.monotonic()
+                if rec is not None:
+                    rec.record(
+                        "chunk_start", chunk=i, rounds=chunk,
+                        first_round=start + done,
+                        bytes_in_use=device_memory_watermark()["bytes_in_use"],
+                    )
                 with window:
-                    params_stack, opt_stack, c_stack, c_global, comm, _tr, tl, ta = self._run_jit(
+                    (
+                        params_stack, opt_stack, c_stack, c_global, comm,
+                        tr, tl, ta, aux,
+                    ) = self._run_jit(
                         params_stack, opt_stack, c_stack, c_global,
                         data, jnp.int32(start + done), jnp.int32(start + rounds - 1),
                         None
                         if sched is None
                         else jnp.asarray(sched[done: done + chunk]),
                         rounds=chunk, epochs=epochs, eval_every=eval_every,
+                        devobs=devobs,
                     )
                 committees.append(comm)
                 test_loss.append(tl)
@@ -1070,6 +1287,30 @@ class MeshSimulation:
                     self._dp_steps_per_node += chunk * epochs * steps_per_epoch
                 else:
                     self._nonprivate_steps_per_node += chunk * epochs * steps_per_epoch
+                if devobs:
+                    # Fold the chunk's in-scan aux stream host-side: sketch
+                    # buckets into SKETCHES, headline gauges into
+                    # p2pfl_mesh_*, tripwire flags into a trip record. The
+                    # tiny aux fetch forces chunk retirement, so the
+                    # chunk_end wall/watermark below are honest.
+                    trip = self._devobs_fold_chunk(
+                        aux, tr, first_round=start + done - chunk
+                    )
+                wm = device_memory_watermark()
+                self._devobs_last["mem_bytes"] = wm["peak_bytes_in_use"]
+                if rec is not None:
+                    rec.record(
+                        "chunk_end", chunk=i, rounds=chunk,
+                        wall_s=round(time.monotonic() - t_chunk, 4),
+                        bytes_in_use=wm["bytes_in_use"],
+                        peak_bytes=wm["peak_bytes_in_use"],
+                    )
+                if trip is not None:
+                    # Tripwire: stop launching chunks (the side effects —
+                    # dump, gauges, ledger — run after the loop, outside
+                    # the donation-failure except).
+                    trip["chunk"] = i
+                    break
                 # Save on the cadence, and always after the final chunk so the
                 # end-of-run state is never memory-only.
                 if checkpointer is not None and (
@@ -1103,8 +1344,31 @@ class MeshSimulation:
         # takes seconds). Fetching a tiny output that data-depends on the
         # final chunk forces true completion, so dt is honest.
         np.asarray(test_loss[-1])
+        if trip is not None:
+            # A trip is postmortem-worthy: count it, flight-recorder dump,
+            # membership-style ledger event. Outside the timed try block —
+            # a broken observability sink must not masquerade as a donated-
+            # buffer failure.
+            from p2pfl_tpu.telemetry.observatory import mesh_trip
+
+            trip["action"] = str(Settings.DEVOBS_TRIP_ACTION)
+            mesh_trip(self._devobs_node, trip["kind"])
+            self._devobs_last["tripped"] = trip["kind"]
+            if rec is not None:
+                rec.record(
+                    "devobs_trip", trip_kind=trip["kind"],
+                    round=trip["round"], chunk=trip["chunk"],
+                    action=trip["action"],
+                )
+                trip["flightrec"] = rec.dump("devobs_trip")
+            if self._ledger is not None:
+                self._ledger.emit(
+                    "membership", event="devobs_trip", peer=self._devobs_node
+                )
         dt = time.monotonic() - t0
-        total_rounds = sum(chunks)
+        # On a tripwire trip `done` < `rounds`: the result covers only the
+        # chunks that actually executed.
+        total_rounds = done
 
         self.params_stack, self.opt_stack = params_stack, opt_stack
         self.c_stack, self.c_global = c_stack, c_global
@@ -1115,17 +1379,32 @@ class MeshSimulation:
         acc_all = np.concatenate([np.asarray(t) for t in test_acc])
         loss_all = np.concatenate([np.asarray(t) for t in test_loss])
         evaluated = ~np.isnan(acc_all)
-        return SimulationResult(
+        result = SimulationResult(
             rounds=total_rounds,
             seconds_total=dt,
-            seconds_per_round=dt / total_rounds,
+            seconds_per_round=dt / max(1, total_rounds),
             test_acc=[float(a) for a in acc_all[evaluated]],
             test_loss=[float(l) for l in loss_all[evaluated]],
             committees=np.concatenate([np.asarray(c) for c in committees]),
+            tripped=trip,
         )
+        if trip is not None and trip.get("action") == "abort":
+            # Population state is PARKED (valid, handed off above,
+            # completed_rounds at the last finished chunk) — the raise is
+            # the abort contract, not a donation failure.
+            raise RuntimeError(
+                f"devobs tripwire: {trip['kind']} at round {trip['round']} "
+                f"(chunk {trip['chunk']}); flight recorder dump: "
+                f"{trip.get('flightrec')}; state parked at round "
+                f"{self.completed_rounds} — set "
+                "P2PFL_TPU_DEVOBS_TRIP_ACTION=park to receive partial "
+                "results instead"
+            )
+        return result
 
     def round_cost_analysis(
-        self, epochs: int = 1, rounds_per_call: int = 1, eval_every: int = 1
+        self, epochs: int = 1, rounds_per_call: int = 1, eval_every: int = 1,
+        devobs: Optional[bool] = None,
     ) -> Optional[Dict[str, float]]:
         """XLA's own cost model for one compiled round program.
 
@@ -1151,6 +1430,13 @@ class MeshSimulation:
                 self.c_global, data, jnp.int32(start),
                 jnp.int32(start + rounds_per_call - 1),
                 rounds=rounds_per_call, epochs=epochs, eval_every=eval_every,
+                # Default: cost the program run() would actually execute —
+                # the devobs aux stream is part of the compiled scan.
+                devobs=(
+                    bool(Settings.DEVOBS_ENABLED)
+                    if devobs is None
+                    else bool(devobs)
+                ),
             )
             ca = lowered.compile().cost_analysis()
         except Exception:  # noqa: BLE001 — cost analysis is best-effort
@@ -1251,29 +1537,37 @@ class MeshSimulation:
             led.emit("aggregate_committed", round=r, **commit)
             led.emit("round_close", round=r)
 
-    # --- fused-mesh observability --------------------------------------------
+    # --- fused-mesh observability (device observatory) -----------------------
 
-    @staticmethod
-    @partial(jax.jit, static_argnames=("n", "rounds"))
-    def _fleet_summary_jit(
-        committees: jax.Array, speed: jax.Array, byz: jax.Array,
-        base_step_s: jax.Array, *, n: int, rounds: int,
-    ):
-        """On-device per-virtual-node health: one scatter-add over the
-        round committees plus elementwise math — O(R*K + N) on the mesh, so
-        a 100k-population summary never round-trips per-node Python."""
-        participation = (
-            jnp.zeros((n,), jnp.float32).at[committees.reshape(-1)].add(1.0)
+    def _devobs_recorder(self) -> Any:
+        """The simulation's flight recorder (lazy): chunk boundary events
+        and tripwire dumps share the wire nodes' recorder machinery."""
+        if self._recorder is None:
+            from p2pfl_tpu.telemetry.flight_recorder import FlightRecorder
+
+            self._recorder = FlightRecorder(self._devobs_node)
+        return self._recorder
+
+    def _devobs_fold_chunk(
+        self, aux: Dict[str, Any], train_loss: Any, first_round: int
+    ) -> Optional[Dict[str, Any]]:
+        """Host-side fold of one chunk's in-scan aux stream: device bucket
+        counts into the ``SKETCHES`` registry (``update_norm``), per-round
+        cohort losses into the ``train_loss`` sketch, headline values into
+        the ``p2pfl_mesh_*`` gauges. Returns the chunk's first tripwire
+        trip ``{kind, round}`` or ``None``."""
+        return fold_devobs_chunk(
+            aux, train_loss, first_round=first_round,
+            node=self._devobs_node, spec=self._devobs_spec,
+            last=self._devobs_last,
         )
-        step_time = base_step_s * speed
-        # A tier-s node's virtual clock covers rounds/s rounds in the time
-        # the fleet covers `rounds`: its round index lags by the rest
-        # (faster-than-baseline tiers clamp to zero lag — there is no
-        # "ahead of the fleet" in round indices).
-        round_lag = jnp.maximum(0.0, jnp.floor(rounds * (1.0 - 1.0 / speed)))
-        round_idx = rounds - round_lag
-        rejections = byz * participation
-        return participation, step_time, round_lag, round_idx, rejections
+
+    def devobs_summary(self) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """``(extras, extra_sketches)`` from the last run's device-
+        observatory stream — what :meth:`fleet_snapshot` and the population
+        engines graft onto their snapshot documents (fed_top's LOSS / GNORM
+        / HBM / TRIP columns and the fleet quantile rows)."""
+        return devobs_summary_for(self._devobs_node, self._devobs_last)
 
     def fleet_health(self, result: SimulationResult, epochs: int = 1) -> Dict[str, np.ndarray]:
         """Per-virtual-node health arrays for the completed ``result``.
@@ -1285,6 +1579,11 @@ class MeshSimulation:
         tiers to the MEASURED mean step time (the fused round is lockstep,
         so per-node wall clocks are a model, and an honest one: a real
         deployment of these tiers would show exactly these lags).
+
+        Plain numpy on purpose: one scatter-add plus elementwise math over
+        [N] arrays is microseconds even at 100k nodes, and keeping it off
+        the device spares a jit program + executable-cache entry per
+        simulation (the in-scan devobs aux stream is the on-device path).
         """
         if result.committees is None:
             raise ValueError("result carries no committee history")
@@ -1292,28 +1591,37 @@ class MeshSimulation:
         rounds = int(result.committees.shape[0])
         steps_per_round = max(1, (int(self.x.shape[1]) // self.batch_size) * epochs)
         base_step_s = result.seconds_per_round / steps_per_round
-        speed = jnp.asarray(
-            self.node_speed if self.node_speed is not None else np.ones(n, np.float32)
+        speed = (
+            np.asarray(self.node_speed, np.float32)
+            if self.node_speed is not None
+            else np.ones(n, np.float32)
         )
-        byz = self._byz if self._byz is not None else jnp.zeros((n,), jnp.float32)
-        participation, step_time, round_lag, round_idx, rejections = (
-            self._fleet_summary_jit(
-                jnp.asarray(result.committees), speed, byz,
-                jnp.float32(base_step_s), n=n, rounds=rounds,
-            )
+        byz = (
+            np.asarray(self._byz, np.float32)
+            if self._byz is not None
+            else np.zeros(n, np.float32)
         )
+        comm = np.asarray(result.committees).reshape(-1)
+        participation = np.zeros(n, np.float32)
+        np.add.at(participation, comm, 1.0)
+        step_time = np.float32(base_step_s) * speed
+        # A tier-s node's virtual clock covers rounds/s rounds in the time
+        # the fleet covers `rounds`: its round index lags by the rest
+        # (faster-than-baseline tiers clamp to zero lag — there is no
+        # "ahead of the fleet" in round indices).
+        round_lag = np.maximum(0.0, np.floor(rounds * (1.0 - 1.0 / speed)))
         return {
-            "participation": np.asarray(participation),
-            "step_time": np.asarray(step_time),
-            "round_lag": np.asarray(round_lag),
-            "round": np.asarray(round_idx),
-            "rejections": np.asarray(rejections),
+            "participation": participation,
+            "step_time": step_time,
+            "round_lag": round_lag.astype(np.float32),
+            "round": (rounds - round_lag).astype(np.float32),
+            "rejections": byz * participation,
             # Cohort-fill: the fraction of this run's rounds the node was
             # solicited in. Under full-population rounds this is just
             # committee luck; under a cohort schedule it is the sampler's
             # realized coverage — the population engine's fairness metric
             # (fed_top renders it as the COHORT column).
-            "cohort_fill": np.asarray(participation) / float(max(1, rounds)),
+            "cohort_fill": participation / np.float32(max(1, rounds)),
         }
 
     def fleet_snapshot(
@@ -1337,8 +1645,13 @@ class MeshSimulation:
 
         health = self.fleet_health(result, epochs=epochs)
         names = [f"vnode/{i:05d}" for i in range(self.logical_num_nodes)]
+        extras, extra_sketches = self.devobs_summary()
+        if result.tripped is not None:
+            extras["tripped"] = result.tripped.get("kind")
         snap = population_snapshot(
-            observer="mesh-sim", node_names=names, metrics=health, top_n=top_n
+            observer="mesh-sim", node_names=names, metrics=health,
+            top_n=top_n, extras=extras or None,
+            extra_sketches=extra_sketches or None,
         )
         if path is not None:
             write_snapshot_doc(path, snap)

@@ -9,7 +9,7 @@ Two halves:
   over the ``nodes`` axis of a (multihost) mesh, with per-round cohort
   sampling driven by explicit committee schedules, auto-padding to the mesh
   axis, and the full observability surface (``population_snapshot`` with a
-  cohort-fill column, trajectory ledger, ``_fleet_summary_jit``) still on;
+  cohort-fill column, trajectory ledger, in-scan device observatory) on;
 * **scenario engine** (:mod:`p2pfl_tpu.population.scenarios`) — a
   declarative, seeded scenario spec composing Dirichlet non-IID
   partitioning, hash-derived availability/churn traces, device-class speed

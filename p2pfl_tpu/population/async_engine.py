@@ -58,7 +58,11 @@ from p2pfl_tpu.learning.aggregators.async_buffer import staleness_discount
 from p2pfl_tpu.learning.learner import softmax_cross_entropy
 from p2pfl_tpu.ops import aggregation as agg_ops
 from p2pfl_tpu.parallel.mesh import make_mesh
-from p2pfl_tpu.parallel.simulation import local_train_step
+from p2pfl_tpu.parallel.simulation import (
+    devobs_summary_for,
+    fold_devobs_chunk,
+    local_train_step,
+)
 from p2pfl_tpu.population.arrivals import (
     CLOSE_FILL,
     CLOSE_REASONS,
@@ -70,6 +74,7 @@ from p2pfl_tpu.population.arrivals import (
 )
 from p2pfl_tpu.population.cohort import cohort_size
 from p2pfl_tpu.population.engine import population_data, vnode_names
+from p2pfl_tpu.telemetry.sketches import device_bucket_spec, device_bucket_stats
 
 Pytree = Any
 
@@ -91,6 +96,10 @@ class AsyncRunResult:
     test_acc: List[float] = field(default_factory=list)
     test_loss: List[float] = field(default_factory=list)
     schedule: Optional[WindowSchedule] = None
+    #: Device-observatory tripwire record ``{kind, round, chunk, action,
+    #: flightrec}`` — present only on parked runs (``kind`` is
+    #: nonfinite | loss_diverge); DEVOBS_TRIP_ACTION=abort raises instead.
+    tripped: Optional[Dict[str, Any]] = None
 
     def summary(self) -> Dict[str, Any]:
         contribs = int(self.fills.sum())
@@ -299,6 +308,13 @@ class AsyncPopulationEngine:
         self.opt_stack = init_opt(template)
 
         self._ledger = None
+        # Device observatory (config.DEVOBS_*): same static bucket spec and
+        # host-side fold the sync mesh uses, under this engine's own node
+        # label so the sketch/gauge families stay per-backend.
+        self._devobs_spec = device_bucket_spec()
+        self._devobs_node = "asyncpop-engine"
+        self._recorder: Any = None
+        self._devobs_last: Dict[str, Any] = {}
         self._stall = 0
         self.completed_windows = 0
         self._fold_counts = np.zeros(self.num_nodes, np.float64)
@@ -376,22 +392,23 @@ class AsyncPopulationEngine:
 
     @partial(
         jax.jit,
-        static_argnames=("self", "windows", "epochs", "eval_every"),
+        static_argnames=("self", "windows", "epochs", "eval_every", "devobs"),
         donate_argnames=("history", "opt_stack"),
     )
     def _run_jit(
         self, history, opt_stack, stall0, data, members, present, lag, target,
         keys, start_window, final_window, *, windows: int, epochs: int,
-        eval_every: int = 1,
+        eval_every: int = 1, devobs: bool = False,
     ):
         x, y, sample_mask, num_samples, speed, xt, yt = data
         alpha = float(Settings.ASYNC_STALENESS_ALPHA)
         idx = start_window + jnp.arange(windows)
         do_eval = ((idx + 1) % eval_every == 0) | (idx == final_window)
+        diverge_mult = jnp.float32(float(Settings.DEVOBS_LOSS_DIVERGE_MULT))
 
         def body(carry, xs_w):
-            history, opt_stack, stall = carry
-            m, pr, lg, tg, keys_w, do_ev = xs_w
+            (history, opt_stack, stall), floor = carry
+            m, pr, lg, tg, keys_w, do_ev, w_idx = xs_w
             prf = pr.astype(jnp.float32)
             # Anchor each contribution at the global it trained against:
             # lag l -> the ring slot l windows back (history[0] is the
@@ -416,6 +433,69 @@ class AsyncPopulationEngine:
                 ),
                 lambda: cur,
             )
+            if int(Settings.DEVOBS_NAN_INJECT_ROUND) >= 0:
+                # Seeded fault injection (same knob as the sync rounds,
+                # denominated in absolute WINDOW indices here). Python-
+                # gated: never traced with the knob at its -1 default.
+                bad = w_idx == jnp.int32(int(Settings.DEVOBS_NAN_INJECT_ROUND))
+                new_global = jax.tree.map(
+                    lambda a: jnp.where(bad, jnp.full_like(a, jnp.nan), a),
+                    new_global,
+                )
+            # Device-observatory aux stream, ys-side only (see the sync
+            # round body): the fold math and the history ring are
+            # bit-identical with devobs on or off.
+            gamma_log, lo_idx, nbins = self._devobs_spec
+            if devobs:
+                sq = jax.tree.map(
+                    lambda new, old: jnp.sum(
+                        (new.astype(jnp.float32) - old.astype(jnp.float32))
+                        ** 2,
+                        axis=tuple(range(1, new.ndim)),
+                    ),
+                    p_new,
+                    anchors,
+                )
+                # Absent slots trained a throwaway idle member — mask their
+                # deltas to the zeros bucket so only folded contributions
+                # shape the update-norm distribution.
+                norms = prf * jnp.sqrt(sum(jax.tree.leaves(sq)) + 1e-12)
+                st = device_bucket_stats(
+                    norms, gamma_log=gamma_log, lo_idx=lo_idx, nbins=nbins
+                )
+                g_finite = jnp.bool_(True)
+                for leaf in jax.tree.leaves(new_global):
+                    g_finite &= jnp.isfinite(leaf).all()
+                folded_losses = jnp.where(pr, losses, jnp.float32(0))
+                win_loss = jnp.where(
+                    fill > 0,
+                    jnp.sum(folded_losses) / jnp.maximum(prf.sum(), 1.0),
+                    jnp.float32(jnp.nan),
+                )
+                aux = {
+                    "un_counts": st["counts"],
+                    "un_zeros": st["zeros"],
+                    "un_sum": st["sum"].astype(jnp.float32),
+                    "un_min": st["min"].astype(jnp.float32),
+                    "un_max": st["max"].astype(jnp.float32),
+                    "weight_mass": wgt.sum().astype(jnp.float32),
+                    "participants": fill,
+                    "train_loss": win_loss,
+                    "nonfinite": (~g_finite)
+                    | (~jnp.isfinite(folded_losses).all()),
+                }
+            else:
+                aux = {
+                    "un_counts": jnp.zeros((nbins,), jnp.int32),
+                    "un_zeros": jnp.int32(0),
+                    "un_sum": jnp.float32(0),
+                    "un_min": jnp.float32(0),
+                    "un_max": jnp.float32(0),
+                    "weight_mass": jnp.float32(0),
+                    "participants": jnp.int32(0),
+                    "train_loss": jnp.float32(jnp.nan),
+                    "nonfinite": jnp.bool_(False),
+                }
             # The ring shifts EVERY window (empty ones too): slot l must
             # always mean "the global l windows back".
             history = jax.tree.map(
@@ -473,17 +553,29 @@ class AsyncPopulationEngine:
                 lambda _: (jnp.float32(jnp.nan), jnp.float32(jnp.nan)),
                 operand=None,
             )
+            if devobs:
+                # Loss-divergence tripwire on the folded-window loss; the
+                # chunk's best finite window loss rides the carry (empty
+                # windows emit NaN and leave the floor untouched).
+                wl = aux["train_loss"]
+                finite = jnp.isfinite(wl)
+                aux["diverged"] = (
+                    finite & jnp.isfinite(floor) & (wl > diverge_mult * floor)
+                )
+                floor = jnp.where(finite, jnp.minimum(floor, wl), floor)
+            else:
+                aux["diverged"] = jnp.bool_(False)
             return (
-                (history, opt_stack, stall),
-                (fill, close_code, dur, lag_sum, losses.mean(), loss, acc),
+                ((history, opt_stack, stall), floor),
+                (fill, close_code, dur, lag_sum, losses.mean(), loss, acc, aux),
             )
 
         carry, outs = jax.lax.scan(
             body,
-            (history, opt_stack, stall0),
-            (members, present, lag, target, keys, do_eval),
+            ((history, opt_stack, stall0), jnp.float32(jnp.inf)),
+            (members, present, lag, target, keys, do_eval, idx),
         )
-        history, opt_stack, stall = carry
+        (history, opt_stack, stall), _ = carry
         return (history, opt_stack, stall) + tuple(outs)
 
     # --- driving -------------------------------------------------------------
@@ -495,6 +587,7 @@ class AsyncPopulationEngine:
         eval_every: int = 1,
         warmup: bool = False,
         windows_per_call: Optional[int] = None,
+        profile_dir: Optional[str] = None,
     ) -> AsyncRunResult:
         """Execute ``windows`` async windows on the mesh.
 
@@ -526,6 +619,9 @@ class AsyncPopulationEngine:
             self.x, self.y, self.sample_mask, self.num_samples, self.speed,
             self.x_test, self.y_test,
         )
+        # Device observatory: static jit flag, read once per run (see
+        # MeshSimulation.run — same contract, window-denominated here).
+        devobs = bool(Settings.DEVOBS_ENABLED)
 
         if warmup:
             # Warmup cursor past the real run (a remote backend replaying a
@@ -543,6 +639,7 @@ class AsyncPopulationEngine:
                     jnp.int32(start + windows + 1),
                     jnp.int32(start + windows + chunks[0]),
                     windows=chunks[0], epochs=epochs, eval_every=eval_every,
+                    devobs=devobs,
                 )
                 jax.block_until_ready(out[0])
                 np.asarray(out[3])  # force true retirement before timing
@@ -551,13 +648,24 @@ class AsyncPopulationEngine:
                 if self._pristine:
                     self._reinit_population()
 
+        from p2pfl_tpu.management.profiler import (
+            device_memory_watermark,
+            device_trace_window,
+        )
+
+        if profile_dir is None:
+            profile_dir = Settings.PERF_TRACE_DIR
+        profile_chunks = int(Settings.DEVOBS_PROFILE_CHUNKS)
+        rec = self._devobs_recorder() if devobs else self._recorder
+
         history, opt_stack = self.history, self.opt_stack
         stall = jnp.int32(self._stall)
         fills, codes, durs, lag_sums, test_loss, test_acc = [], [], [], [], [], []
+        trip: Optional[Dict[str, Any]] = None
         t0 = time.monotonic()
         done = 0
         try:
-            for chunk in chunks:
+            for i, chunk in enumerate(chunks):
                 row = slice(done, done + chunk)
                 sub = WindowSchedule(
                     start_window=start + done,
@@ -572,15 +680,35 @@ class AsyncPopulationEngine:
                     queue_depth=sched.queue_depth[row],
                     dropped=sched.dropped[row],
                 )
-                history, opt_stack, stall, fl, cc, du, ls, _tr, tl, ta = (
-                    self._run_jit(
+                # The leading DEVOBS_PROFILE_CHUNKS timed chunks each get a
+                # windowed device trace (labels distinct from the sync
+                # engine's so both can profile in one process).
+                window = (
+                    device_trace_window(
+                        profile_dir, label=f"asyncpop_window_chunk{i}"
+                    )
+                    if i < profile_chunks
+                    else contextlib.nullcontext()
+                )
+                t_chunk = time.monotonic()
+                if rec is not None:
+                    rec.record(
+                        "chunk_start", chunk=i, windows=chunk,
+                        first_window=start + done,
+                        bytes_in_use=device_memory_watermark()["bytes_in_use"],
+                    )
+                with window:
+                    (
+                        history, opt_stack, stall, fl, cc, du, ls, _tr, tl,
+                        ta, aux,
+                    ) = self._run_jit(
                         history, opt_stack, stall, data,
                         *self._chunk_inputs(sub),
                         jnp.int32(start + done),
                         jnp.int32(start + windows - 1),
                         windows=chunk, epochs=epochs, eval_every=eval_every,
+                        devobs=devobs,
                     )
-                )
                 fills.append(fl)
                 codes.append(cc)
                 durs.append(du)
@@ -590,6 +718,29 @@ class AsyncPopulationEngine:
                 done += chunk
                 if self._ledger is not None:
                     self._ledger_emit_chunk(sub, history)
+                if devobs:
+                    # Host fold of the chunk's aux stream (the tiny fetch
+                    # also forces chunk retirement — chunk_end is honest).
+                    trip = fold_devobs_chunk(
+                        aux, aux["train_loss"],
+                        first_round=start + done - chunk,
+                        node=self._devobs_node, spec=self._devobs_spec,
+                        last=self._devobs_last,
+                    )
+                wm = device_memory_watermark()
+                self._devobs_last["mem_bytes"] = wm["peak_bytes_in_use"]
+                if rec is not None:
+                    rec.record(
+                        "chunk_end", chunk=i, windows=chunk,
+                        wall_s=round(time.monotonic() - t_chunk, 4),
+                        bytes_in_use=wm["bytes_in_use"],
+                        peak_bytes=wm["peak_bytes_in_use"],
+                    )
+                if trip is not None:
+                    # Stop launching chunks; side effects run after the
+                    # loop, outside the donation-failure except.
+                    trip["chunk"] = i
+                    break
         except BaseException as e:
             self.history = self.opt_stack = None
             self._pristine = False
@@ -600,18 +751,41 @@ class AsyncPopulationEngine:
             ) from e
         jax.block_until_ready(history)
         np.asarray(lag_sums[-1])  # force retirement — dt is honest
+        if trip is not None:
+            # Postmortem side effects, outside the timed try block — a
+            # broken observability sink must not masquerade as a donated-
+            # buffer failure (see MeshSimulation.run).
+            from p2pfl_tpu.telemetry.observatory import mesh_trip
+
+            trip["action"] = str(Settings.DEVOBS_TRIP_ACTION)
+            mesh_trip(self._devobs_node, trip["kind"])
+            self._devobs_last["tripped"] = trip["kind"]
+            if rec is not None:
+                rec.record(
+                    "devobs_trip", trip_kind=trip["kind"],
+                    round=trip["round"], chunk=trip["chunk"],
+                    action=trip["action"],
+                )
+                trip["flightrec"] = rec.dump("devobs_trip")
+            if self._ledger is not None:
+                self._ledger.emit(
+                    "membership", event="devobs_trip", peer=self._devobs_node
+                )
         dt = time.monotonic() - t0
+        # On a tripwire trip `done` < `windows`: the result (and every
+        # cursor/accounting update below) covers only the executed chunks.
+        total_windows = done
 
         self.history, self.opt_stack = history, opt_stack
         self._stall = int(np.asarray(stall))
-        self.completed_windows = start + windows
+        self.completed_windows = start + total_windows
         self._pristine = False
         fills_np = np.concatenate([np.asarray(f) for f in fills]).astype(np.int64)
         durs_np = np.concatenate([np.asarray(d) for d in durs]).astype(np.float64)
         # Cumulative per-vnode fold accounting (fed_top's WINDOW / FILL
         # columns), from the compiled schedule — the device outputs carry
         # only the aggregate counters.
-        for wi in range(windows):
+        for wi in range(total_windows):
             folded = sched.members[wi][sched.present[wi]]
             np.add.at(self._fold_counts, folded, 1.0)
             self._last_fold_window[folded] = float(start + wi)
@@ -622,10 +796,10 @@ class AsyncPopulationEngine:
         acc_all = np.concatenate([np.asarray(t) for t in test_acc])
         loss_all = np.concatenate([np.asarray(t) for t in test_loss])
         evaluated = ~np.isnan(acc_all)
-        return AsyncRunResult(
-            windows=windows,
+        result = AsyncRunResult(
+            windows=total_windows,
             seconds_total=dt,
-            seconds_per_window=dt / max(1, windows),
+            seconds_per_window=dt / max(1, total_windows),
             sim_time_ticks=float(durs_np.sum()),
             fills=fills_np,
             close_codes=np.concatenate([np.asarray(c) for c in codes]).astype(np.int64),
@@ -634,7 +808,20 @@ class AsyncPopulationEngine:
             test_acc=[float(a) for a in acc_all[evaluated]],
             test_loss=[float(l) for l in loss_all[evaluated]],
             schedule=sched,
+            tripped=trip,
         )
+        if trip is not None and trip.get("action") == "abort":
+            # State is PARKED (valid, handed off above) — the raise is the
+            # abort contract, not a donation failure.
+            raise RuntimeError(
+                f"devobs tripwire: {trip['kind']} at window {trip['round']} "
+                f"(chunk {trip['chunk']}); flight recorder dump: "
+                f"{trip.get('flightrec')}; state parked at window "
+                f"{self.completed_windows} — set "
+                "P2PFL_TPU_DEVOBS_TRIP_ACTION=park to receive partial "
+                "results instead"
+            )
+        return result
 
     def _reinit_population(self) -> None:
         self.history = self._broadcast_history(self._template)
@@ -704,6 +891,21 @@ class AsyncPopulationEngine:
         ran (the async analogue of ``PopulationEngine.cohort_fill``)."""
         return self._fold_counts / float(max(1, self.completed_windows))
 
+    def _devobs_recorder(self) -> Any:
+        """The engine's flight recorder (lazy): chunk boundary events and
+        tripwire dumps share the wire nodes' recorder machinery."""
+        if self._recorder is None:
+            from p2pfl_tpu.telemetry.flight_recorder import FlightRecorder
+
+            self._recorder = FlightRecorder(self._devobs_node)
+        return self._recorder
+
+    def devobs_summary(self) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """``(extras, extra_sketches)`` from the last run's device-
+        observatory stream (fed_top's LOSS / GNORM / HBM / TRIP columns
+        and the fleet quantile rows)."""
+        return devobs_summary_for(self._devobs_node, self._devobs_last)
+
     def snapshot(
         self,
         result: AsyncRunResult,
@@ -729,11 +931,16 @@ class AsyncPopulationEngine:
             "window": self._last_fold_window,
             "window_fill": self.window_fill(),
         }
+        extras, extra_sketches = self.devobs_summary()
+        if getattr(result, "tripped", None) is not None:
+            extras["tripped"] = result.tripped.get("kind")
         snap = population_snapshot(
             observer="asyncpop-engine",
             node_names=self.names,
             metrics=metrics,
             top_n=top_n,
+            extras=extras or None,
+            extra_sketches=extra_sketches or None,
         )
         if path is not None:
             write_snapshot_doc(path, snap)

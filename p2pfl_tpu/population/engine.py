@@ -235,7 +235,9 @@ class PopulationEngine:
         )
         comm = np.asarray(res.committees).reshape(-1)
         np.add.at(self._participation, comm, 1.0)
-        self._rounds_run += int(rounds)
+        # A tripwire-parked run executed fewer rounds than asked — count
+        # what actually ran (res.committees already covers only those).
+        self._rounds_run += int(res.rounds)
         return res
 
     # --- observability -------------------------------------------------------
@@ -261,11 +263,19 @@ class PopulationEngine:
 
         health = self.sim.fleet_health(result, epochs=epochs)
         health["cohort_fill"] = self.cohort_fill()
+        # Device-observatory graft: in-scan loss / update-norm sketches and
+        # tripwire state ride the same snapshot (fed_top's LOSS / GNORM /
+        # HBM / TRIP columns).
+        extras, extra_sketches = self.sim.devobs_summary()
+        if getattr(result, "tripped", None) is not None:
+            extras["tripped"] = result.tripped.get("kind")
         snap = population_snapshot(
             observer="population-engine",
             node_names=self.names,
             metrics=health,
             top_n=top_n,
+            extras=extras or None,
+            extra_sketches=extra_sketches or None,
         )
         if path is not None:
             write_snapshot_doc(path, snap)

@@ -249,18 +249,14 @@ def _gauge_value_opt(name: str, node: str) -> Optional[float]:
 def device_mem_bytes() -> float:
     """Accelerator memory in use, best effort: backend memory stats when the
     platform exposes them, else the sum of live jax array buffers (process-
-    wide — in-process federations share one device). 0.0 when JAX is absent
-    or the backend reports nothing."""
+    wide — in-process federations share one device). The live-array sweep is
+    O(live arrays), so it is TTL-cached (``Settings.DEVOBS_MEM_TTL_S``)
+    behind the profiler's watermark helper instead of paid on every digest
+    beat. 0.0 when JAX is absent or the backend reports nothing."""
     try:
-        import jax
+        from p2pfl_tpu.management.profiler import device_memory_watermark
 
-        try:
-            stats = jax.local_devices()[0].memory_stats()
-            if stats and stats.get("bytes_in_use"):
-                return float(stats["bytes_in_use"])
-        except Exception:  # noqa: BLE001 — CPU backend has no memory_stats
-            pass
-        return float(sum(int(a.nbytes) for a in jax.live_arrays()))
+        return float(device_memory_watermark().get("bytes_in_use", 0.0))
     except Exception:  # noqa: BLE001 — digest collection must never raise
         return 0.0
 

@@ -113,6 +113,90 @@ _OVERFLOW = REGISTRY.gauge(
     labels=("node",),
 )
 
+# --- device observatory (fused population engines) --------------------------
+# The p2pfl_mesh_* family mirrors what the in-scan aux stream reports per
+# chunk: the fused backends' headline vitals, scrapeable next to the wire's
+# p2pfl_fed_* section. "node" is the engine label (mesh-sim /
+# population-engine / asyncpop-engine).
+_MESH_ROUND = REGISTRY.gauge(
+    "p2pfl_mesh_round",
+    "Absolute round/window cursor of a fused population engine",
+    labels=("node",),
+)
+_MESH_LOSS = REGISTRY.gauge(
+    "p2pfl_mesh_train_loss",
+    "Cohort mean training loss of the last fused round/window, measured "
+    "inside the compiled scan",
+    labels=("node",),
+)
+_MESH_WEIGHT_MASS = REGISTRY.gauge(
+    "p2pfl_mesh_weight_mass",
+    "Fold-weight mass (sample-count x staleness discount) aggregated in "
+    "the last fused round/window",
+    labels=("node",),
+)
+_MESH_PARTICIPANTS = REGISTRY.counter(
+    "p2pfl_mesh_participants_total",
+    "Cumulative cohort members whose contributions folded into a fused "
+    "aggregate",
+    labels=("node",),
+)
+_MESH_TRIPS = REGISTRY.counter(
+    "p2pfl_mesh_trips_total",
+    "Health-tripwire trips inside the compiled scan, by kind "
+    "(nonfinite | loss_diverge)",
+    labels=("node", "kind"),
+)
+_MESH_PEAK_BYTES = REGISTRY.gauge(
+    "p2pfl_mesh_device_peak_bytes",
+    "Device memory watermark (peak bytes) observed around the last timed "
+    "chunk of a fused run",
+    labels=("node",),
+)
+_MESH_CHUNK_SECONDS = REGISTRY.gauge(
+    "p2pfl_mesh_chunk_seconds",
+    "Wall seconds of the last timed fused chunk (one _run_jit call)",
+    labels=("node",),
+)
+
+
+def mesh_chunk_telemetry(
+    node: str,
+    *,
+    round_cursor: Optional[int] = None,
+    train_loss: Optional[float] = None,
+    weight_mass: Optional[float] = None,
+    participants: Optional[float] = None,
+    chunk_seconds: Optional[float] = None,
+    peak_bytes: Optional[float] = None,
+) -> None:
+    """Mirror one fused chunk's aux-stream summary into the p2pfl_mesh_*
+    registry section. Never raises — a broken export must not break the
+    chunk it was observing."""
+    try:
+        if round_cursor is not None:
+            _MESH_ROUND.labels(node).set(float(round_cursor))
+        if train_loss is not None:
+            _MESH_LOSS.labels(node).set(float(train_loss))
+        if weight_mass is not None:
+            _MESH_WEIGHT_MASS.labels(node).set(float(weight_mass))
+        if participants is not None and participants > 0:
+            _MESH_PARTICIPANTS.labels(node).inc(float(participants))
+        if chunk_seconds is not None:
+            _MESH_CHUNK_SECONDS.labels(node).set(float(chunk_seconds))
+        if peak_bytes is not None:
+            _MESH_PEAK_BYTES.labels(node).set(float(peak_bytes))
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def mesh_trip(node: str, kind: str) -> None:
+    """Count one tripwire trip (kind: nonfinite | loss_diverge)."""
+    try:
+        _MESH_TRIPS.labels(node, kind).inc()
+    except Exception:  # noqa: BLE001
+        pass
+
 #: A digest older than this many seconds is stale: its peer stops counting
 #: toward fleet statistics (it is probably dead and the heartbeater will
 #: sweep it; keeping its frozen round would poison the round-lag baseline).
@@ -679,19 +763,41 @@ def population_snapshot(
     metrics: Dict[str, Any],
     top_n: int = _TOP_CANDIDATES,
     rel_err: Optional[float] = None,
+    extras: Optional[Dict[str, Any]] = None,
+    extra_sketches: Optional[Dict[str, QuantileSketch]] = None,
 ) -> Dict[str, Any]:
-    """Build a fed_top-renderable snapshot from PER-NODE metric arrays.
+    """Build a fed_top-renderable snapshot from PER-NODE metric arrays —
+    through the REAL :class:`Observatory` ingestion path.
 
     The fused-mesh simulation's observability path: the jitted round
     program computes per-virtual-node health arrays (round lag, step time,
-    participation, rejections), and this helper folds them into sketches
-    host-side (one vectorized pass per metric, not N Python calls) plus a
-    top-N straggler table — the same document shape
-    ``Observatory.snapshot`` produces, so a 10k-node mesh run renders in
-    the same ``fed_top`` view as an 8-node real-wire federation.
+    participation, rejections), and this helper routes them through a real
+    observatory exactly like the wire does — the worst ``top_n`` stragglers
+    become synthesized :class:`HealthDigest` frames fed to
+    :meth:`Observatory.ingest` (membership events, scoring, Prometheus
+    refresh and all), while the remaining population mass takes the same
+    overflow fold a beyond-``OBS_MAX_TRACKED`` wire fleet takes (merged
+    fleet sketches + the bounded worst-straggler candidate table). The
+    returned document therefore IS an ``Observatory.snapshot()`` — same
+    producer, same shape — so a 100k-vnode mesh run renders in the same
+    ``fed_top`` view as an 8-node real-wire federation, and
+    :func:`snapshot_shape_diff` can assert the parity.
 
     ``metrics`` maps metric name -> array-like of length ``len(node_names)``.
-    Straggler ordering uses ``round_lag`` (primary) then ``step_time``.
+    Straggler SELECTION (which vnodes get tracked) uses the full-population
+    ordering ``round_lag + positive step-time z``; the per-peer scores in
+    the document then come from the observatory's own scorer over the
+    tracked set. Quantile mass is folded ONCE: the full arrays go into the
+    overflow sketches via one vectorized ``add_many`` per metric, and the
+    synthesized digests deliberately carry no sketches of their own.
+
+    ``extras`` (optional) is the device-observatory side channel — cohort
+    train loss, update-norm summary, device memory watermark, tripwire
+    state — stamped onto every tracked vnode row (``loss`` / ``gnorm`` /
+    ``trip`` / ``mem_bytes``) and echoed as ``doc["devobs"]`` for the
+    bench. ``extra_sketches`` merges in-scan device sketches (e.g. the
+    ``update_norm`` buckets folded through ``SKETCHES``) into the fleet
+    quantile view.
     """
     import numpy as np
 
@@ -706,92 +812,159 @@ def population_snapshot(
             raise ValueError(
                 f"metric {k!r} has shape {a.shape}, expected ({n},)"
             )
-    quantiles: Dict[str, Any] = {}
-    for k, a in sorted(arrays.items()):
-        sk = QuantileSketch(rel_err=rel_err, max_bins=Settings.SKETCH_MAX_BINS)
-        sk.add_many(a)
-        q = sk.quantiles()
-        quantiles[k] = {
-            "p50": round(q["p50"], 6),
-            "p90": round(q["p90"], 6),
-            "p99": round(q["p99"], 6),
-            "count": sk.count,
-            "mean": round(sk.mean, 6),
-        }
     lag = arrays.get("round_lag", np.zeros(n))
     step = arrays.get("step_time", np.zeros(n))
-    # Straggler score mirrors the real observatory's shape: round lag plus
-    # the positive step-time z-score against the fleet distribution.
+    rej = arrays.get("rejections", np.zeros(n))
+    rounds_arr = arrays.get("round")
+    part = arrays.get("participation")
+    stale = arrays.get("staleness")
+    # Straggler SELECTION over the full population mirrors the real
+    # observatory's score shape: round lag plus positive step-time z.
     std = float(step.std())
     z = np.maximum(0.0, (step - float(step.mean())) / std) if std > 1e-12 else np.zeros(n)
     straggler = lag + z
-    order = np.argsort(-straggler, kind="stable")[: max(1, int(top_n))]
-    fill = arrays.get("cohort_fill")
-    peers: Dict[str, Any] = {}
-    for i in order.tolist():
-        peers[node_names[i]] = {
-            "round": int(arrays.get("round", np.zeros(n))[i]) if "round" in arrays else -1,
-            "total_rounds": -1,
-            "stage": "virtual",
-            "mode": "",
-            "staleness": 0.0,
-            "staleness_p90": None,
-            "steps_per_s": (1.0 / step[i]) if step[i] > 0 else 0.0,
-            "tx_bytes": 0.0,
-            "rx_bytes": 0.0,
-            "rejections": {},
-            "rejected_by_source": {},
-            # Realized solicitation fraction under cohort sampling (the
-            # population engine's fairness metric); None when the run
-            # carried no cohort_fill array — fed_top prints "-" then.
-            "cohort_fill": (
-                round(float(fill[i]), 4) if fill is not None else None
-            ),
-            # Async-window population runs: the last window this vnode's
-            # contribution FOLDED into (-1: never folded) and its realized
-            # fold fraction across all windows. None on sync runs —
-            # fed_top prints "-" then.
-            "window": (
-                int(arrays["window"][i]) if "window" in arrays else None
-            ),
-            "window_fill": (
-                round(float(arrays["window_fill"][i]), 4)
-                if "window_fill" in arrays
-                else None
-            ),
-            "scores": {
-                "straggler": round(float(straggler[i]), 4),
-                "suspect": round(float(arrays.get("rejections", np.zeros(n))[i]), 4),
-                "link": 0.0,
-                "round": float(arrays.get("round", np.zeros(n))[i]) if "round" in arrays else -1.0,
-                "age_s": 0.0,
+    full_order = np.argsort(-straggler, kind="stable")
+    order = full_order[: max(1, int(top_n))].tolist()
+    # Track the worst SUSPECTS too (nonzero fleet-attributed rejections): a
+    # Byzantine vnode is postmortem-worthy even when it isn't a straggler,
+    # and the wire's top_suspect question needs it in the per-peer table to
+    # have an answer.
+    for i in np.argsort(-rej, kind="stable")[: max(1, int(top_n))].tolist():
+        if rej[i] > 0 and i not in order:
+            order.append(i)
+    tracked = {node_names[i] for i in order}
+
+    obs = Observatory(observer)
+    now = time.time()
+    max_round = int(rounds_arr.max()) if rounds_arr is not None and n else -1
+    # The observer's self view rides the same path as on the wire — and
+    # carries the fleet's per-sender rejection attribution, which is how
+    # the real scorer derives suspect scores.
+    obs.ingest(
+        HealthDigest(
+            node=observer,
+            ts=now,
+            round=max_round,
+            stage="observer",
+            mode="fused",
+            rejected_by_source={
+                node_names[i]: float(rej[i]) for i in order if rej[i] > 0
             },
-        }
-    top_idx = int(order[0]) if n else None
-    return {
-        "observer": observer,
-        "written_at": time.time(),
-        "virtual": True,
-        "peers": peers,
-        "fleet": {
-            "tracked_peers": len(peers),
-            "overflow_peers": max(0, n - len(peers)),
-            "size": n,
-            "quantiles": quantiles,
-        },
-        "membership_events": [],
-        "top_straggler": (
-            node_names[top_idx]
-            if top_idx is not None and straggler[top_idx] > 0
-            else None
-        ),
-        "top_suspect": None,
-    }
+        )
+    )
+    for i in order:
+        obs.ingest(
+            HealthDigest(
+                node=node_names[i],
+                ts=now,
+                round=int(rounds_arr[i]) if rounds_arr is not None else -1,
+                stage="virtual",
+                mode="",
+                staleness=float(stale[i]) if stale is not None else 0.0,
+                steps_per_s=(1.0 / float(step[i])) if step[i] > 0 else 0.0,
+                contributors=float(part[i]) if part is not None else 0.0,
+            )
+        )
+    # Everyone else takes the population-overflow path: ALL quantile mass
+    # (tracked rows included — their digests carry no sketches, so nothing
+    # is counted twice) folds into the merged fleet sketches in one
+    # vectorized pass per metric, and the worst untracked stragglers fill
+    # the bounded candidate table the snapshot's overflow section reads.
+    with obs._lock:
+        for k, a in sorted(arrays.items()):
+            sk = QuantileSketch(
+                rel_err=rel_err, max_bins=Settings.SKETCH_MAX_BINS
+            )
+            sk.add_many(a)
+            obs._overflow_sketches[k] = sk
+        if extra_sketches:
+            for k, sk in sorted(extra_sketches.items()):
+                if sk is None or sk.count <= 0:
+                    continue
+                mine = obs._overflow_sketches.get(k)
+                if mine is None:
+                    obs._overflow_sketches[k] = sk.copy()
+                else:
+                    mine.merge_in(sk.copy())
+        obs._overflow_seen.update(
+            nm for nm in node_names if nm not in tracked
+        )
+        cap = 4 * _TOP_CANDIDATES
+        for i in full_order.tolist():
+            if len(obs._overflow_top) >= cap:
+                break
+            if node_names[i] in tracked:
+                continue
+            rnd = int(rounds_arr[i]) if rounds_arr is not None else -1
+            obs._overflow_top[node_names[i]] = (float(rnd), rnd)
+    obs._overflow_gauge.set(len(obs._overflow_seen))
+
+    doc = obs.snapshot()
+    doc["virtual"] = True
+    fill = arrays.get("cohort_fill")
+    win = arrays.get("window")
+    wfill = arrays.get("window_fill")
+    for i in order:
+        entry = doc["peers"].get(node_names[i])
+        if entry is None:
+            continue
+        # Realized solicitation fraction under cohort sampling (the
+        # population engine's fairness metric); None when the run carried
+        # no cohort_fill array — fed_top prints "-" then. window /
+        # window_fill likewise are async-population facts: the last window
+        # this vnode folded into (-1: never) and its realized fold
+        # fraction; None on sync runs.
+        entry["cohort_fill"] = (
+            round(float(fill[i]), 4) if fill is not None else None
+        )
+        entry["window"] = int(win[i]) if win is not None else None
+        entry["window_fill"] = (
+            round(float(wfill[i]), 4) if wfill is not None else None
+        )
+        if extras:
+            entry["loss"] = extras.get("train_loss")
+            entry["gnorm"] = extras.get("update_norm_p90")
+            entry["trip"] = extras.get("tripped")
+            if extras.get("mem_bytes"):
+                entry["mem_bytes"] = float(extras["mem_bytes"])
+    if extras:
+        doc["devobs"] = dict(extras)
+    return doc
+
+
+def snapshot_shape_diff(
+    fused: Dict[str, Any], wire: Dict[str, Any]
+) -> List[str]:
+    """Shape-parity check between a fused population snapshot and a wire
+    ``Observatory.snapshot()``: every key family the wire document exposes
+    must exist in the fused one (the fused doc may carry extras — cohort
+    fill, devobs columns — but never less). Returns the missing keys,
+    prefixed ``top-level:`` / ``peer:`` / ``fleet:``; empty means parity."""
+
+    def peer_keys(doc: Dict[str, Any]) -> set:
+        ks: set = set()
+        for p in (doc.get("peers") or {}).values():
+            if isinstance(p, dict):
+                ks |= set(p)
+        return ks
+
+    out = [f"top-level:{k}" for k in sorted(set(wire) - set(fused))]
+    out += [f"peer:{k}" for k in sorted(peer_keys(wire) - peer_keys(fused))]
+    out += [
+        f"fleet:{k}"
+        for k in sorted(
+            set(wire.get("fleet") or {}) - set(fused.get("fleet") or {})
+        )
+    ]
+    return out
 
 
 __all__ = [
     "Observatory",
     "STALE_AFTER_S",
+    "mesh_chunk_telemetry",
+    "mesh_trip",
     "population_snapshot",
+    "snapshot_shape_diff",
     "write_snapshot_doc",
 ]

@@ -58,6 +58,65 @@ STANDARD_SKETCHES = ("step_time", "staleness", "update_norm", "agg_wait")
 #: sketch cannot index 0; step times / lags / norms at true 0 are common).
 _MIN_TRACKED = 1e-9
 
+#: Value range the ON-DEVICE bucket window covers (device observatory):
+#: update norms / losses below LO clip into the bottom bucket, above HI
+#: into the top one. The window is a trace-time constant — the aux output
+#: of a compiled scan must be static-shape.
+DEVICE_BUCKET_LO = 1e-6
+DEVICE_BUCKET_HI = 1e3
+
+
+def device_bucket_spec(rel_err: Optional[float] = None) -> Tuple[float, int, int]:
+    """``(gamma_log, lo_idx, nbins)`` of the static on-device DDSketch
+    bucket window: the same ``index(x) = ceil(log(x)/gamma_log)`` rule the
+    host sketches use, restricted to ``[DEVICE_BUCKET_LO, DEVICE_BUCKET_HI]``
+    so a compiled scan can emit a fixed-length bucket-count vector per
+    round. Host side, :meth:`QuantileSketch.fold_device_buckets` folds the
+    counts back losslessly (same gamma) or through bucket midpoints."""
+    if rel_err is None:
+        from p2pfl_tpu.config import Settings
+
+        rel_err = Settings.SKETCH_REL_ERR
+    gamma_log = math.log((1.0 + rel_err) / (1.0 - rel_err))
+    lo = int(math.ceil(math.log(DEVICE_BUCKET_LO) / gamma_log))
+    hi = int(math.ceil(math.log(DEVICE_BUCKET_HI) / gamma_log))
+    return gamma_log, lo, hi - lo + 1
+
+
+def device_bucket_stats(
+    values: Any, *, gamma_log: float, lo_idx: int, nbins: int
+) -> Dict[str, Any]:
+    """Jit-safe bucket statistics of ``|values|`` for the device observatory.
+
+    Returns static-shape jnp arrays suitable for a ``lax.scan`` aux output:
+    ``counts`` ([nbins] int32 DDSketch bucket counts, window-clipped),
+    ``zeros`` (values below the sketch zero floor), and exact ``sum`` /
+    ``min`` / ``max`` over the finite non-zero magnitudes (inf/-inf when
+    none). Non-finite values contribute to NOTHING here — the NaN tripwire
+    flags them separately."""
+    import jax.numpy as jnp
+
+    v = jnp.abs(jnp.asarray(values, jnp.float32).ravel())
+    finite = jnp.isfinite(v)
+    zero = finite & (v < _MIN_TRACKED)
+    pos = finite & (v >= _MIN_TRACKED)
+    idx = jnp.clip(
+        jnp.ceil(
+            jnp.log(jnp.maximum(v, _MIN_TRACKED)) / jnp.float32(gamma_log)
+        ).astype(jnp.int32)
+        - lo_idx,
+        0,
+        nbins - 1,
+    )
+    counts = jnp.zeros((nbins,), jnp.int32).at[idx].add(pos.astype(jnp.int32))
+    return {
+        "counts": counts,
+        "zeros": zero.sum().astype(jnp.int32),
+        "sum": jnp.where(pos, v, 0.0).sum(),
+        "min": jnp.where(pos, v, jnp.inf).min(),
+        "max": jnp.where(pos, v, -jnp.inf).max(),
+    }
+
 
 class QuantileSketch:
     """Relative-error quantile sketch over a stream of floats.
@@ -150,6 +209,52 @@ class QuantileSketch:
             uniq, counts = np.unique(idx, return_counts=True)
             for i, c in zip(uniq.tolist(), counts.tolist()):
                 store[i] = store.get(i, 0.0) + float(c)
+        if len(self._bins) > self.max_bins or len(self._neg) > self.max_bins:
+            self._collapse()
+
+    def fold_device_buckets(
+        self,
+        gamma_log: float,
+        lo_idx: int,
+        counts: Any,
+        *,
+        zeros: float = 0.0,
+        vsum: Optional[float] = None,
+        vmin: Optional[float] = None,
+        vmax: Optional[float] = None,
+    ) -> None:
+        """Fold an on-device bucket-count vector (:func:`device_bucket_stats`)
+        into this sketch. Bucket ``j`` of ``counts`` holds the mass at
+        absolute DDSketch index ``lo_idx + j`` under ``gamma_log``; each
+        non-empty bucket re-folds through its midpoint at THIS sketch's
+        accuracy (a no-op re-index when the gammas match, i.e. before any
+        collapse). Exact ``vsum/vmin/vmax`` from the device ride along when
+        given; otherwise the midpoints approximate them."""
+        arr = np.asarray(counts, np.float64).ravel()
+        nz = np.nonzero(arr > 0)[0]
+        zeros = max(0.0, float(zeros))
+        total = float(arr[nz].sum()) + zeros
+        if total <= 0:
+            return
+        gl = float(gamma_log)
+        mids = 2.0 * np.exp(gl * (lo_idx + nz)) / (math.exp(gl) + 1.0)
+        self.count += total
+        if vsum is not None and math.isfinite(float(vsum)):
+            self.sum += float(vsum)
+        else:
+            self.sum += float((mids * arr[nz]).sum())
+        if zeros > 0:
+            self.zero_count += zeros
+            self.min = min(self.min, 0.0)
+            self.max = max(self.max, 0.0)
+        if nz.size:
+            lo_v = float(vmin) if vmin is not None and math.isfinite(float(vmin)) else float(mids.min())
+            hi_v = float(vmax) if vmax is not None and math.isfinite(float(vmax)) else float(mids.max())
+            self.min = min(self.min, lo_v)
+            self.max = max(self.max, hi_v)
+            for m, c in zip(mids.tolist(), arr[nz].tolist()):
+                i = self._index(m)
+                self._bins[i] = self._bins.get(i, 0.0) + float(c)
         if len(self._bins) > self.max_bins or len(self._neg) > self.max_bins:
             self._collapse()
 
@@ -431,6 +536,41 @@ class SketchRegistry:
         except Exception:  # noqa: BLE001
             pass
 
+    def fold_buckets(
+        self,
+        name: str,
+        node: str,
+        gamma_log: float,
+        lo_idx: int,
+        counts: Any,
+        *,
+        zeros: float = 0.0,
+        vsum: Optional[float] = None,
+        vmin: Optional[float] = None,
+        vmax: Optional[float] = None,
+    ) -> None:
+        """Fold an on-device bucket-count vector into the (name, node)
+        sketch — the device observatory's per-chunk entry point. Never
+        raises."""
+        try:
+            from p2pfl_tpu.config import Settings
+
+            key = (name, node)
+            with self._lock:
+                sk = self._quantiles.get(key)
+                if sk is None:
+                    sk = QuantileSketch(
+                        rel_err=Settings.SKETCH_REL_ERR,
+                        max_bins=Settings.SKETCH_MAX_BINS,
+                    )
+                    self._quantiles[key] = sk
+                sk.fold_device_buckets(
+                    gamma_log, lo_idx, counts,
+                    zeros=zeros, vsum=vsum, vmin=vmin, vmax=vmax,
+                )
+        except Exception:  # noqa: BLE001
+            pass
+
     def distinct_add(self, node: str, item: str) -> None:
         """Fold one contributor identity into ``node``'s distinct counter."""
         try:
@@ -489,10 +629,14 @@ SKETCHES = SketchRegistry()
 
 
 __all__ = [
+    "DEVICE_BUCKET_HI",
+    "DEVICE_BUCKET_LO",
     "DistinctEstimator",
     "QuantileSketch",
     "SKETCHES",
     "SKETCH_WIRE_VERSION",
     "STANDARD_SKETCHES",
     "SketchRegistry",
+    "device_bucket_spec",
+    "device_bucket_stats",
 ]

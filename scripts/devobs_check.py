@@ -1,0 +1,198 @@
+"""devobs-check — the device-observatory gate (fast CI shape, ~60 s).
+
+Certifies the in-scan telemetry contract on a small fused population so CI
+catches a broken aux stream before the expensive ``bench.py --devobs``
+acceptance run does:
+
+1. a 64-node :class:`~p2pfl_tpu.population.PopulationEngine` run with
+   devobs ON feeds the host ``SKETCHES`` streams (``update_norm``,
+   ``train_loss``) and the ``p2pfl_mesh_*`` Prometheus family, and the
+   sketch totals are **chunking-invariant** (rounds_per_call 2 vs 4 fold
+   the same counts — the aux stream is a property of the schedule, not of
+   how the scan is sliced);
+2. telemetry is **free where it matters**: the node-0 params hash with
+   devobs ON is bit-identical to the hash with devobs OFF (aux rides only
+   the scan ys side — the params math never sees it);
+3. the NaN tripwire fires within one chunk of a seeded injection, in BOTH
+   actions: ``park`` returns a partial result carrying ``.tripped`` and a
+   flight-recorder dump path, ``abort`` raises with the engine state still
+   parked and readable;
+4. doc-shape parity: the fused snapshot exposes every key family a real
+   wire ``Observatory.snapshot()`` does (``snapshot_shape_diff`` empty) —
+   one document shape for 8 sockets or 100k virtual nodes.
+
+Exit 0 on pass, 1 on failure. ``make devobs-check`` wires it next to the
+other plane gates.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _hash0(eng) -> str:
+    from p2pfl_tpu.telemetry.ledger import canonical_params_hash
+
+    return canonical_params_hash(eng.gather_params(0))
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from p2pfl_tpu.config import Settings
+    from p2pfl_tpu.population import PopulationEngine
+    from p2pfl_tpu.telemetry import REGISTRY
+    from p2pfl_tpu.telemetry.export import render_prometheus
+    from p2pfl_tpu.telemetry.sketches import SKETCHES
+
+    n, rounds, fraction, seed = 64, 8, 0.125, 1234
+    eng_kw = dict(
+        cohort_fraction=fraction, seed=seed, samples_per_node=8, hidden=(8,),
+    )
+    t0 = time.monotonic()
+    print(
+        f"devobs-check: n={n} rounds={rounds} cohort={fraction:g} "
+        f"seed={seed} — telemetry arm...",
+        file=sys.stderr,
+    )
+
+    # --- arm 1: aux stream feeds sketches + Prometheus, chunking-invariant ---
+    counts = {}
+    for rpc in (2, 4):
+        SKETCHES.reset()
+        with Settings.overridden(DEVOBS_ENABLED=True):
+            with PopulationEngine(n, **eng_kw) as eng:
+                eng.run(rounds, warmup=True, rounds_per_call=rpc)
+                hash_on = _hash0(eng)
+        un = SKETCHES.get("update_norm", "mesh-sim")
+        tl = SKETCHES.get("train_loss", "mesh-sim")
+        assert un is not None and un.count > 0, "update_norm sketch empty"
+        assert tl is not None and tl.count > 0, "train_loss sketch empty"
+        counts[rpc] = (un.count, tl.count)
+    assert counts[2] == counts[4], (
+        f"aux stream not chunking-invariant: rpc=2 folded {counts[2]}, "
+        f"rpc=4 folded {counts[4]}"
+    )
+    expected = rounds * max(1, int(n * fraction))
+    assert counts[2][0] == expected, (
+        f"update_norm count {counts[2][0]} != rounds*cohort {expected}"
+    )
+    prom = render_prometheus(REGISTRY)
+    for metric in ("p2pfl_mesh_round", "p2pfl_mesh_train_loss",
+                   "p2pfl_mesh_weight_mass", "p2pfl_mesh_chunk_seconds"):
+        assert metric in prom, f"{metric} missing from Prometheus exposition"
+    print(
+        f"devobs-check: sketches chunk-invariant ({counts[2][0]} norms, "
+        f"{counts[2][1]} losses), p2pfl_mesh_* exported — off arm...",
+        file=sys.stderr,
+    )
+
+    # --- arm 2: devobs OFF is bit-identical on the params path ---------------
+    SKETCHES.reset()
+    with Settings.overridden(DEVOBS_ENABLED=False):
+        with PopulationEngine(n, **eng_kw) as eng:
+            eng.run(rounds, warmup=True, rounds_per_call=4)
+            hash_off = _hash0(eng)
+    assert hash_on == hash_off, (
+        f"devobs perturbed the params math: on={hash_on} off={hash_off}"
+    )
+    un_off = SKETCHES.get("update_norm", "mesh-sim")
+    assert un_off is None or un_off.count == 0, "devobs OFF still folded sketches"
+    print(
+        f"devobs-check: on/off hash identical ({hash_on[:18]}...) — "
+        "tripwire arms...",
+        file=sys.stderr,
+    )
+
+    # --- arm 3: NaN tripwire, park then abort --------------------------------
+    inject_at, trip_rpc = 3, 2
+    SKETCHES.reset()
+    with Settings.overridden(
+        DEVOBS_ENABLED=True,
+        DEVOBS_NAN_INJECT_ROUND=inject_at,
+        DEVOBS_TRIP_ACTION="park",
+    ):
+        with PopulationEngine(n, **eng_kw) as eng:
+            res = eng.run(rounds, warmup=True, rounds_per_call=trip_rpc)
+    trip = res.tripped
+    assert trip is not None and trip["kind"] == "nonfinite", f"no trip: {trip}"
+    assert trip["round"] == inject_at, f"trip round {trip['round']} != {inject_at}"
+    stop = (inject_at // trip_rpc + 1) * trip_rpc
+    assert res.rounds == stop, (
+        f"park ran {res.rounds} rounds, expected chunk-boundary stop at {stop}"
+    )
+    assert trip.get("flightrec") and os.path.exists(trip["flightrec"]), (
+        f"flight-recorder dump missing: {trip.get('flightrec')}"
+    )
+
+    SKETCHES.reset()
+    with Settings.overridden(
+        DEVOBS_ENABLED=True,
+        DEVOBS_NAN_INJECT_ROUND=inject_at,
+        DEVOBS_TRIP_ACTION="abort",
+    ):
+        with PopulationEngine(n, **eng_kw) as eng:
+            try:
+                eng.run(rounds, warmup=True, rounds_per_call=trip_rpc)
+            except RuntimeError as exc:
+                assert "devobs tripwire" in str(exc), f"wrong abort: {exc}"
+            else:
+                raise AssertionError("abort action did not raise")
+            # The abort parks state before raising — it must stay readable.
+            assert eng.sim.params_stack is not None, "abort dropped the state"
+            _hash0(eng)
+    print(
+        "devobs-check: NaN trip in-chunk (park stopped at "
+        f"round {stop}, abort raised with state parked) — parity arm...",
+        file=sys.stderr,
+    )
+
+    # --- arm 4: fused snapshot shape == wire observatory shape ---------------
+    from p2pfl_tpu.telemetry import digest as digest_mod
+    from p2pfl_tpu.telemetry.observatory import Observatory, snapshot_shape_diff
+    from p2pfl_tpu.telemetry.sketches import DistinctEstimator, QuantileSketch
+
+    SKETCHES.reset()
+    with Settings.overridden(DEVOBS_ENABLED=True):
+        with PopulationEngine(n, **eng_kw) as eng:
+            res = eng.run(rounds, warmup=True, rounds_per_call=4)
+            fused = eng.snapshot(res, top_n=8)
+    sk = QuantileSketch(rel_err=0.02)
+    for lag in (0, 0, 1, 2):
+        sk.add(float(lag))
+    est = DistinctEstimator()
+    est.add("mem://a")
+    wire_obs = Observatory("mem://devobs-check")
+    wire_obs.ingest(
+        digest_mod.HealthDigest(
+            node="mem://peer", ts=time.time(), round=3, stage="RoundStage",
+            mode="sync", steps_per_s=25.0,
+            sketches={"staleness": sk.to_wire(), "__distinct__": est.to_wire()},
+        )
+    )
+    missing = snapshot_shape_diff(fused, wire_obs.snapshot())
+    assert not missing, f"fused snapshot missing wire key families: {missing}"
+    assert fused.get("devobs", {}).get("train_loss") is not None, (
+        "fused snapshot devobs block lost the in-scan loss"
+    )
+
+    print(
+        f"devobs-check: PASS in {time.monotonic() - t0:.1f}s — sketches "
+        "chunk-invariant, on/off hash identical, tripwires fire in-chunk "
+        "(park + abort), fused/wire doc shapes at parity",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -108,6 +108,7 @@ def render(
         f"{'PEER':<23} {'ROUND':>7} {'STAGE':<22} {'STEP/S':>8} "
         f"{'TX MiB':>8} {'RX MiB':>8} {'STALE':>6} {'EPS':>6} {'COHORT':>7} "
         f"{'WINDOW':>7} {'FILL':>6} "
+        f"{'LOSS':>7} {'GNORM':>7} {'HBM MiB':>8} {'TRIP':>6} "
         f"{'STRAG':>7} {'SUSP':>7} {'LINK':>6} {'AGE s':>6}"
     )
     lines = [
@@ -148,6 +149,19 @@ def render(
         window_s = "-" if window is None else ("-" if window < 0 else f"w{window}")
         wfill = p.get("window_fill")
         wfill_s = "-" if wfill is None else f"{wfill:.2f}"
+        # Device-observatory columns (in-scan aux stream on fused-engine
+        # snapshots; "-" for real-wire peers): last cohort/window train
+        # loss, p90 in-scan update norm, device HBM watermark, and the
+        # tripwire state (nonfinite | loss_diverge — rows with a trip
+        # paint red, the run stopped launching chunks there).
+        loss = p.get("loss")
+        loss_s = "-" if loss is None else f"{loss:.3f}"
+        gnorm = p.get("gnorm")
+        gnorm_s = "-" if gnorm is None else f"{gnorm:.3g}"
+        mem = p.get("mem_bytes")
+        mem_s = "-" if not mem else _mib(float(mem))
+        trip = p.get("trip")
+        trip_s = "-" if not trip else str(trip)[:6]
         row = (
             f"{_short(addr):<23} {round_s:>7} {p.get('stage') or '-':<22.22} "
             f"{p.get('steps_per_s', 0.0):>8.1f} {_mib(p.get('tx_bytes', 0.0)):>8} "
@@ -157,11 +171,17 @@ def render(
             f"{fill_s:>7} "
             f"{window_s:>7} "
             f"{wfill_s:>6} "
+            f"{loss_s:>7} "
+            f"{gnorm_s:>7} "
+            f"{mem_s:>8} "
+            f"{trip_s:>6} "
             f"{s.get('straggler', 0.0):>7.2f} "
             f"{s.get('suspect', 0.0):>7.1f} {s.get('link', 0.0):>6.1f} "
             f"{s.get('age_s', 0.0):>6.1f}"
         )
-        if addr == top_suspect:
+        if trip:
+            row = paint(_RED, row)
+        elif addr == top_suspect:
             row = paint(_RED, row)
         elif addr == top_straggler:
             row = paint(_YELLOW, row)
@@ -170,6 +190,23 @@ def render(
     lines.append(
         f"top straggler: {top_straggler or '-'}    top suspect: {top_suspect or '-'}"
     )
+    # Device-observatory banner (fused engines stamp the in-scan stream's
+    # headline values into snap["devobs"]): a tripped run heads the panel
+    # in red — the compiled program itself raised the flag.
+    devobs = snap.get("devobs") or {}
+    if devobs:
+        tripped = devobs.get("tripped")
+        mem = devobs.get("mem_bytes")
+        bits = [
+            f"loss {devobs['train_loss']:.4f}"
+            if devobs.get("train_loss") is not None else "loss -",
+            f"gnorm p90 {devobs['update_norm_p90']:.3g}"
+            if devobs.get("update_norm_p90") is not None else "gnorm -",
+            f"hbm {_mib(float(mem))} MiB" if mem else "hbm -",
+            f"TRIPPED: {tripped}" if tripped else "trip -",
+        ]
+        line = "device observatory: " + "    ".join(bits)
+        lines.append(paint(_RED if tripped else _BOLD, line))
     # Fleet-wide model-plane bytes per wire codec (digest tx_by_codec —
     # which encoder is actually carrying the model plane, and how much of
     # the traffic still rides dense frames).

@@ -29,7 +29,15 @@ What gets compared (dotted paths; ``*`` fans out over dict keys):
   ``perf.steady_state.step_s.*``;
 * count-like health signals — ``perf.compile.recompiles_total.*`` regresses
   only when the candidate exceeds the baseline by more than
-  ``--count-slack`` (default 0: ANY new recompiles fail).
+  ``--count-slack`` (default 0: ANY new recompiles fail);
+* device-observatory fields under ``perf.devobs.*`` (present when the run
+  had ``DEVOBS_ENABLED``) — ``device_peak_bytes``, ``compile_seconds``,
+  ``scan_flops`` / ``scan_bytes`` — gated lower-is-better with the same
+  noise-band machinery. A bench where exactly ONE side carries a
+  ``perf.devobs`` section is refused (exit 3): telemetry-on vs
+  telemetry-off timings are not comparable (the on side pays the aux
+  stream), and silently skipping the section would read as "no devobs
+  regression" when nothing was compared. Both sides absent → skipped.
 
 Noise-awareness: a timing regresses only when
 ``candidate > baseline * (1 + threshold)`` AND the absolute growth exceeds
@@ -59,6 +67,13 @@ DEFAULT_TIMING_KEYS = (
     "extra.mean_round_wall_s",
     "extra.wall_s",
     "perf.steady_state.step_s.*",
+    # Device-observatory fields (lower is better for all of them: HBM
+    # watermark growth, compile-time growth, and compiled-program FLOP /
+    # byte growth are each a real regression class).
+    "perf.devobs.device_peak_bytes",
+    "perf.devobs.compile_seconds",
+    "perf.devobs.scan_flops",
+    "perf.devobs.scan_bytes",
 )
 DEFAULT_COUNT_KEYS = ("perf.compile.recompiles_total.*",)
 
@@ -299,6 +314,26 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"{_label(c_backend, c_why)}; cross-platform timings are not "
             "comparable. Re-run both sides on one backend, or pass "
             "--allow-backend-mismatch to compare anyway (not gateable).",
+            file=sys.stderr,
+        )
+        return 3
+
+    b_devobs = (base.get("perf") or {}).get("devobs")
+    c_devobs = (cand.get("perf") or {}).get("devobs")
+    if (b_devobs is None) != (c_devobs is None):
+        have, lack = (
+            ("baseline", "candidate") if b_devobs is not None
+            else ("candidate", "baseline")
+        )
+        # Refuse rather than skip: a telemetry-on run diffed against a
+        # telemetry-off run compares different programs, and skipping the
+        # section would report "no devobs regression" without comparing
+        # anything. Re-run the lacking side with DEVOBS_ENABLED matching.
+        print(
+            f"perf_diff: DEVOBS REFUSAL — {have} carries a perf.devobs "
+            f"section but {lack} does not; one side ran with device "
+            "observability the other lacked. Re-run both sides with the "
+            "same P2PFL_TPU_DEVOBS_ENABLED setting before diffing.",
             file=sys.stderr,
         )
         return 3

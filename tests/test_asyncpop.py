@@ -261,7 +261,10 @@ def test_async_snapshot_carries_window_columns():
     ) as eng:
         res = eng.run(3, eval_every=3)
         snap = eng.snapshot(res, top_n=4)
-    assert len(snap["peers"]) == 4
-    for peer in snap["peers"].values():
+    # top_n virtual rows + the observer's own row (wire doc-shape parity).
+    assert len(snap["peers"]) == 4 + 1
+    for name, peer in snap["peers"].items():
+        if name == "asyncpop-engine":
+            continue
         assert peer["window"] is not None and peer["window"] >= 0
         assert peer["window_fill"] is not None and 0.0 <= peer["window_fill"] <= 1.0

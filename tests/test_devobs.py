@@ -1,0 +1,251 @@
+"""Device observatory (PR 17): in-scan telemetry, tripwires, profiling.
+
+Covers: the device-side bucket sketch (device_bucket_stats folds into the
+host QuantileSketch with the sketch's own error bound); the aux stream's
+contracts — chunking invariance (rounds_per_call must not change what the
+host sees) and params-path neutrality (devobs on/off node-0 hash is
+bit-identical); the ``p2pfl_mesh_*`` Prometheus family; the NaN tripwire
+in both park and abort actions on the sync engine and park on the async
+engine; and ``perf_diff``'s devobs refusal (exit 3 when exactly one side
+carries a ``perf.devobs`` section).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.telemetry import REGISTRY
+from p2pfl_tpu.telemetry.export import render_prometheus
+from p2pfl_tpu.telemetry.ledger import canonical_params_hash
+from p2pfl_tpu.telemetry.sketches import (
+    SKETCHES,
+    QuantileSketch,
+    device_bucket_spec,
+    device_bucket_stats,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ENG_KW = dict(
+    cohort_fraction=0.5, seed=7, samples_per_node=8, feature_dim=8,
+    num_classes=4, hidden=(8,), batch_size=4, lr=0.05,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_sketches():
+    SKETCHES.reset()
+    yield
+    SKETCHES.reset()
+
+
+# --- device bucket sketch -----------------------------------------------------
+
+
+def test_device_bucket_stats_fold_matches_host_sketch():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    vals = rng.lognormal(mean=-2.0, sigma=2.0, size=256).astype(np.float32)
+    vals[:13] = 0.0  # exact zeros land in the zeros counter, not a bucket
+    gamma_log, lo_idx, nbins = device_bucket_spec()
+    st = device_bucket_stats(
+        jnp.asarray(vals), gamma_log=gamma_log, lo_idx=lo_idx, nbins=nbins
+    )
+    assert int(np.asarray(st["zeros"])) == 13
+    assert int(np.asarray(st["counts"]).sum()) + 13 == 256
+
+    folded = QuantileSketch()
+    folded.fold_device_buckets(
+        gamma_log,
+        lo_idx,
+        np.asarray(st["counts"]),
+        zeros=float(np.asarray(st["zeros"])),
+        vsum=float(np.asarray(st["sum"])),
+        vmin=float(np.asarray(st["min"])),
+        vmax=float(np.asarray(st["max"])),
+    )
+    direct = QuantileSketch()
+    direct.add_many(vals.tolist())
+    assert folded.count == direct.count == 256
+    for q in (0.5, 0.9, 0.99):
+        assert folded.quantile(q) == pytest.approx(
+            direct.quantile(q), rel=3 * 0.02 + 1e-6
+        )
+
+
+# --- aux-stream contracts on the sync engine ----------------------------------
+
+
+def _run_sync(rounds=4, rpc=2, **settings):
+    from p2pfl_tpu.population import PopulationEngine
+
+    with Settings.overridden(**settings):
+        with PopulationEngine(8, **ENG_KW) as eng:
+            res = eng.run(rounds, rounds_per_call=rpc)
+            return res, canonical_params_hash(eng.gather_params(0))
+
+
+def test_devobs_on_off_params_hash_identical():
+    _, h_on = _run_sync(DEVOBS_ENABLED=True)
+    on_counts = _sketch_counts("mesh-sim")
+    SKETCHES.reset()
+    _, h_off = _run_sync(DEVOBS_ENABLED=False)
+    assert h_on == h_off
+    assert on_counts[0] > 0 and on_counts[1] > 0
+    assert _sketch_counts("mesh-sim") == (0, 0)  # off arm folds nothing
+
+
+def _sketch_counts(node):
+    un = SKETCHES.get("update_norm", node)
+    tl = SKETCHES.get("train_loss", node)
+    return (
+        0 if un is None else un.count,
+        0 if tl is None else tl.count,
+    )
+
+
+def test_aux_stream_is_chunking_invariant():
+    _run_sync(rounds=4, rpc=2, DEVOBS_ENABLED=True)
+    by_two = _sketch_counts("mesh-sim")
+    SKETCHES.reset()
+    _run_sync(rounds=4, rpc=4, DEVOBS_ENABLED=True)
+    assert _sketch_counts("mesh-sim") == by_two
+    assert by_two[0] == 4 * 4  # rounds x cohort_k (8 nodes at 50%)
+
+
+def test_mesh_prometheus_family_exported():
+    _run_sync(DEVOBS_ENABLED=True)
+    prom = render_prometheus(REGISTRY)
+    for metric in (
+        "p2pfl_mesh_round",
+        "p2pfl_mesh_train_loss",
+        "p2pfl_mesh_weight_mass",
+        "p2pfl_mesh_participants_total",
+        "p2pfl_mesh_chunk_seconds",
+    ):
+        assert metric in prom, metric
+
+
+# --- tripwires ----------------------------------------------------------------
+
+
+def test_nan_tripwire_park_stops_at_chunk_boundary(tmp_path):
+    res, _ = _run_sync(
+        rounds=6,
+        rpc=2,
+        DEVOBS_ENABLED=True,
+        DEVOBS_NAN_INJECT_ROUND=2,
+        DEVOBS_TRIP_ACTION="park",
+    )
+    trip = res.tripped
+    assert trip is not None
+    assert trip["kind"] == "nonfinite" and trip["round"] == 2
+    assert res.rounds == 4  # injected mid-chunk-1, parked at its boundary
+    assert trip.get("flightrec") and os.path.exists(trip["flightrec"])
+    trips = REGISTRY.get("p2pfl_mesh_trips_total")
+    assert any(
+        lbl.get("kind") == "nonfinite" and c.value > 0
+        for lbl, c in trips.samples()
+    )
+
+
+def test_nan_tripwire_abort_raises_with_state_parked():
+    from p2pfl_tpu.population import PopulationEngine
+
+    with Settings.overridden(
+        DEVOBS_ENABLED=True,
+        DEVOBS_NAN_INJECT_ROUND=1,
+        DEVOBS_TRIP_ACTION="abort",
+    ):
+        with PopulationEngine(8, **ENG_KW) as eng:
+            with pytest.raises(RuntimeError, match="devobs tripwire"):
+                eng.run(6, rounds_per_call=2)
+            # Abort parks the state before raising: readable, not poisoned.
+            assert eng.sim.params_stack is not None
+            canonical_params_hash(eng.gather_params(0))
+
+
+def test_async_engine_aux_stream_and_park_trip():
+    from p2pfl_tpu.population import AsyncPopulationEngine
+
+    with Settings.overridden(DEVOBS_ENABLED=True):
+        with AsyncPopulationEngine(8, **ENG_KW) as eng:
+            eng.run(4, eval_every=4, windows_per_call=2)
+            h_on = canonical_params_hash(eng.global_params())
+    on_counts = _sketch_counts("asyncpop-engine")
+    assert on_counts[0] > 0 and on_counts[1] > 0
+    SKETCHES.reset()
+    with Settings.overridden(DEVOBS_ENABLED=False):
+        with AsyncPopulationEngine(8, **ENG_KW) as eng:
+            eng.run(4, eval_every=4, windows_per_call=2)
+            h_off = canonical_params_hash(eng.global_params())
+    assert h_on == h_off
+
+    with Settings.overridden(
+        DEVOBS_ENABLED=True,
+        DEVOBS_NAN_INJECT_ROUND=2,
+        DEVOBS_TRIP_ACTION="park",
+    ):
+        with AsyncPopulationEngine(8, **ENG_KW) as eng:
+            res = eng.run(6, eval_every=6, windows_per_call=2)
+    assert res.tripped is not None and res.tripped["kind"] == "nonfinite"
+    assert res.tripped["round"] == 2 and res.windows == 4
+
+
+# --- perf_diff devobs gating --------------------------------------------------
+
+
+def _perf_diff():
+    spec = importlib.util.spec_from_file_location(
+        "perf_diff", os.path.join(REPO, "scripts", "perf_diff.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _bench_doc(wall=2.0, devobs=None):
+    doc = {
+        "metric": "unit_test_arm",
+        "value": wall,
+        "unit": "s/round",
+        "meta": {"schema_version": 1, "git_sha": "x", "backend": "cpu", "seed": 0},
+        "perf": {
+            "schema_version": 1,
+            "compile": {"recompiles_total": {"n0": 0}},
+            "steady_state": {"step_s": {"n0": 0.01}},
+        },
+        "extra": {"mean_round_wall_s": wall},
+    }
+    if devobs is not None:
+        doc["perf"]["devobs"] = devobs
+    return doc
+
+
+def test_perf_diff_refuses_one_sided_devobs(tmp_path):
+    pd = _perf_diff()
+    dev = {"device_peak_bytes": 1 << 20, "compile_seconds": 1.0,
+           "scan_flops": 1e6, "scan_bytes": 1e6}
+    with_dev = tmp_path / "with.json"
+    with_dev.write_text(json.dumps(_bench_doc(devobs=dev)))
+    without = tmp_path / "without.json"
+    without.write_text(json.dumps(_bench_doc()))
+    # Exactly one side profiled -> refusal, either direction.
+    assert pd.main([str(with_dev), str(without)]) == 3
+    assert pd.main([str(without), str(with_dev)]) == 3
+    # Both sides bare or both profiled -> normal comparison.
+    assert pd.main([str(without), str(without)]) == 0
+    assert pd.main([str(with_dev), str(with_dev)]) == 0
+    # Devobs keys gate: a blown-up device watermark regresses (exit 1).
+    worse = tmp_path / "worse.json"
+    worse.write_text(
+        json.dumps(_bench_doc(devobs={**dev, "device_peak_bytes": 1 << 24}))
+    )
+    assert pd.main([str(with_dev), str(worse)]) == 1

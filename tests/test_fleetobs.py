@@ -466,9 +466,14 @@ def test_population_snapshot_top_n_and_quantiles():
     )
     top = list(snap["peers"])
     assert {f"vnode/{i:05d}" for i in seeded} <= set(top)
+    # The observer rides the doc as its own row (wire parity: an
+    # Observatory snapshot always includes self), so size = n + 1 and the
+    # tracked set = top_n stragglers + the self row.
+    assert "mesh-sim" in top and len(top) == 5 + 1
     assert snap["top_straggler"] in {f"vnode/{i:05d}" for i in seeded}
     assert snap["virtual"] is True
-    assert snap["fleet"]["size"] == n and snap["fleet"]["overflow_peers"] == n - 5
+    assert snap["fleet"]["size"] == n + 1
+    assert snap["fleet"]["overflow_peers"] == n - 5
     q = snap["fleet"]["quantiles"]["round_lag"]
     assert q["count"] == n and q["p99"] == pytest.approx(3.0, rel=0.1)
     with pytest.raises(ValueError):

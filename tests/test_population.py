@@ -190,9 +190,12 @@ def test_engine_cohort_fill_and_snapshot():
         # 1/4 and the schedule rows are what was counted.
         assert fill.sum() * 4 == np.asarray(res.committees).size
         snap = eng.snapshot(res, top_n=4)
-        assert len(snap["peers"]) == 4
+        # top_n virtual rows + the observer's own row (wire doc-shape parity).
+        assert len(snap["peers"]) == 4 + 1
         assert all(
-            p["cohort_fill"] is not None for p in snap["peers"].values()
+            p["cohort_fill"] is not None
+            for name, p in snap["peers"].items()
+            if name != "population-engine"
         )
 
 

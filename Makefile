@@ -1,6 +1,6 @@
 # Convenience targets; everything also runs as the plain commands shown.
 
-.PHONY: test test-fast bench dryrun proto-check api-docs telemetry-check chaos-check byzantine-check observatory-check perf-check async-check fleetobs-check recovery-check parity-check wire-check privacy-check analyze race-check population-check asyncpop-check devobs-check
+.PHONY: test test-fast bench dryrun proto-check api-docs telemetry-check chaos-check byzantine-check observatory-check perf-check async-check fleetobs-check recovery-check parity-check wire-check privacy-check analyze race-check population-check asyncpop-check devobs-check campaign-check
 
 test:            ## full suite on the virtual 8-device CPU mesh (~30 min, 1 core)
 	python -m pytest tests/ -q
@@ -52,6 +52,9 @@ privacy-check:   ## 3-node gate: masked run matches plaintext accuracy, one mask
 
 population-check: ## 64-node fused gate: 10% cohort + seeded churn finishes, cohort stream replay-identical across chunked runs and fresh plans (CPU-only)
 	JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/population_check.py
+
+campaign-check:  ## campaign-universe gate: replays the committed baseline prefix (incl. the adaptive-adversary family) on both backends, parity-differed and invariant-graded, hashes bit-identical to tests/campaign_fixtures/ (CPU-only)
+	JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/campaign_check.py
 
 asyncpop-check:  ## fused async-window gate: slow-tier windows close by fill, flash-crowd trace sustains throughput, wire-vs-fused parity bit-exact at n=4 (CPU-only)
 	JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/asyncpop_check.py

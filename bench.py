@@ -4450,6 +4450,117 @@ def run_population_bench() -> None:
     _emit(out, seed=locals().get("seed"), backend="cpu")
 
 
+def run_campaign_bench() -> None:
+    """Subprocess-style mode ``--campaign``: the adversarial campaign
+    universe (CPU venue — a protocol/robustness bench).
+
+    Samples ``P2PFL_TPU_CAMPAIGN_SCENARIOS`` seeded scenarios (default 20,
+    all distinct by construction — the sampler raises otherwise) from the
+    declarative matrix in :mod:`p2pfl_tpu.campaigns.matrix`, executes each
+    on BOTH backends (real wire + fused mesh), runs every pair under the
+    ledger parity differ, and grades each against its scenario family's
+    invariant catalog (:mod:`p2pfl_tpu.campaigns.invariants`).
+
+    Acceptance, enforced here:
+
+    * zero graded violations across the whole campaign;
+    * at least one ADAPTIVE-adversary scenario whose realized decision
+      stream flipped attacks mid-campaign (the ladder escalated off real
+      admission rejections, not a prewritten script);
+    * per-round aggregate hashes bit-exact wire-vs-fused for every family
+      under the exact-parity contract (the privacy family instead proves
+      the masked-vs-plain hash negative control).
+
+    Ledgers land under ``artifacts/campaign_ledgers/<family>-<i>/``; the
+    graded report (per-family arms for ``scripts/perf_diff.py``) is
+    stamped with the bench meta block at ``artifacts/CAMPAIGN_BENCH.json``.
+    ``make campaign-check`` replays the committed baseline subset of the
+    same campaign (``tests/campaign_fixtures/campaign_baseline.json``).
+    """
+    out: dict = {}
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"  # protocol bench: CPU venue
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from p2pfl_tpu.campaigns import run_campaign
+        from p2pfl_tpu.config import Settings
+
+        seed = int(Settings.CAMPAIGN_SEED)
+        n = int(Settings.CAMPAIGN_SCENARIOS)
+        art = os.path.join(REPO, "artifacts")
+        ledger_dir = os.path.join(art, "campaign_ledgers")
+        os.makedirs(ledger_dir, exist_ok=True)
+        _phase(f"campaign: seed={seed}, {n} scenarios, both backends each")
+        t0 = time.monotonic()
+        rep = run_campaign(seed, n, ledger_dir=ledger_dir, emit=_phase)
+        total_s = time.monotonic() - t0
+
+        adaptive = [s for s in rep["scenarios"] if s["family"] == "adaptive"]
+        if not adaptive:
+            raise AssertionError("campaign sampled no adaptive-adversary scenario")
+        switched = [
+            s for s in adaptive
+            if len({
+                d["attack"] for d in s.get("adaptive", {}).get("decisions", ())
+            }) >= 2
+        ]
+        if not switched:
+            raise AssertionError(
+                "no adaptive adversary flipped attacks mid-campaign "
+                f"(decisions: {[s.get('adaptive') for s in adaptive]})"
+            )
+        if rep["violations_total"]:
+            worst = [
+                v for s in rep["scenarios"]
+                for v in s.get("violations", [s.get("error", "")])
+            ][:5]
+            raise AssertionError(
+                f"campaign graded {rep['violations_total']} violation(s): "
+                f"{worst}"
+            )
+        ok = sum(1 for s in rep["scenarios"] if s["verdict"] == "ok")
+        _phase(
+            f"campaign done: {ok}/{n} scenarios ok across "
+            f"{len(rep['families'])} families, {len(switched)} adaptive "
+            f"ladder(s) escalated, {total_s:.0f}s total"
+        )
+        out = {
+            "metric": "campaign_scenarios_ok",
+            "value": ok,
+            "unit": f"of {n} scenarios at seed {seed}",
+            "vs_baseline": None,
+            "extra": {
+                "campaign": rep["campaign"],
+                "campaign_seed": seed,
+                "n_scenarios": n,
+                "families": rep["families"],
+                "adaptive_escalations": [
+                    s["adaptive"]["decisions"] for s in switched
+                ],
+                "total_s": round(total_s, 2),
+                "scenarios": [
+                    {
+                        k: s.get(k)
+                        for k in (
+                            "family", "index", "run_id", "seed", "verdict",
+                            "parity_status", "wire_hashes", "fused_hashes",
+                            "baseline_hashes", "seconds",
+                        )
+                    }
+                    for s in rep["scenarios"]
+                ],
+            },
+        }
+        out["meta"] = _bench_meta(seed=seed, backend="cpu")
+        with open(os.path.join(art, "CAMPAIGN_BENCH.json"), "w") as f:
+            json.dump(out, f, indent=1)
+    except Exception as e:  # noqa: BLE001
+        traceback.print_exc(file=sys.stderr)
+        out["error"] = f"{type(e).__name__}: {e}"
+    _emit(out, seed=locals().get("seed"), backend="cpu")
+
+
 def run_asyncpop_bench() -> None:
     """Subprocess-style mode ``--asyncpop``: async-window population
     acceptance run, four arms, all on the CPU venue (protocol/scale bench).
@@ -5921,6 +6032,8 @@ if __name__ == "__main__":
         run_devobs_bench()
     elif "--population" in sys.argv:
         run_population_bench()
+    elif "--campaign" in sys.argv:
+        run_campaign_bench()
     elif "--critical-path" in sys.argv:
         run_critical_path_bench()
     elif "--parity" in sys.argv:

@@ -1,0 +1,52 @@
+"""Adversarial campaign universe: seeded scenario-matrix harness.
+
+A *campaign* treats :class:`~p2pfl_tpu.population.scenarios.
+PopulationScenario` as one point in a declarative space and samples a
+seeded batch of points from the full matrix — chaos drop traces x
+Byzantine fractions x churn/arrival profiles x privacy on/off x
+crash-restart x partition-heal x device-tier skew x Dirichlet non-IID —
+plus the headline ADAPTIVE adversary family (chaos/plane.py): an attacker
+that observes its own admission rejections and climbs the
+signflip -> scaled -> norm_ride ladder mid-campaign.
+
+Every sampled scenario executes on BOTH backends (real wire + fused
+mesh), runs under the ledger parity differ, and is graded against its
+family's invariant catalog (:mod:`p2pfl_tpu.campaigns.invariants`).
+``bench.py --campaign`` stamps the result as a bench artifact;
+``make campaign-check`` replays the committed baseline
+(tests/campaign_fixtures/) deterministically.
+"""
+
+from p2pfl_tpu.campaigns.engine import (
+    CAMPAIGN_SCOPED_FAMILIES,
+    run_campaign,
+)
+from p2pfl_tpu.campaigns.invariants import (
+    FAMILY_INVARIANTS,
+    Violation,
+    evaluate_final_params,
+    grade_scenario,
+)
+from p2pfl_tpu.campaigns.matrix import (
+    AXES,
+    FAMILIES,
+    CampaignScenario,
+    build_scenario,
+    campaign_id,
+    sample_campaign,
+)
+
+__all__ = [
+    "AXES",
+    "CAMPAIGN_SCOPED_FAMILIES",
+    "FAMILIES",
+    "FAMILY_INVARIANTS",
+    "CampaignScenario",
+    "Violation",
+    "build_scenario",
+    "campaign_id",
+    "evaluate_final_params",
+    "grade_scenario",
+    "run_campaign",
+    "sample_campaign",
+]

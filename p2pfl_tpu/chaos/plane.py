@@ -47,7 +47,17 @@ import random
 import threading
 from dataclasses import dataclass
 from dataclasses import replace as _dc_replace
-from typing import TYPE_CHECKING, Dict, Iterator, Optional, Sequence, Set, Tuple
+from typing import (
+    TYPE_CHECKING,
+    Any,
+    Dict,
+    Iterator,
+    List,
+    Optional,
+    Sequence,
+    Set,
+    Tuple,
+)
 
 from p2pfl_tpu.config import Settings
 from p2pfl_tpu.telemetry import REGISTRY
@@ -82,6 +92,96 @@ _CLEAN = Decision()
 
 #: Supported Byzantine peer behaviors (model-plane frame corruption).
 BYZANTINE_ATTACKS = ("signflip", "scaled", "nan", "inflate")
+
+# --- adaptive adversary (campaign robustness family) --------------------------
+#
+# A static adversary keeps sending the same poison after admission starts
+# rejecting it; a realistic one OBSERVES the rejection and adapts. The
+# adaptive family climbs this ladder: full-parameter negation (crude, lands
+# ~2x the local norm away — admission's bootstrap bound already rejects it),
+# then a x10 blow-up (still far outside the admitted-norm envelope), and
+# finally "norm riding": reflecting only the round's training delta
+# (``old - delta``), which keeps the update's distance from honest peers
+# inside the admitted-norm distribution while still pushing the aggregate
+# the wrong way. The first two stages are expected to be rejected — they
+# exist to model the probing an adversary does before finding the attack
+# that slips through.
+ADAPTIVE_LADDER = ("signflip", "scaled", "norm_ride")
+
+#: Ladder stages the admission norm gate is expected to reject; the
+#: adversary treats an attributed rejection while in one of these stages as
+#: the signal to escalate. ``norm_ride`` is absent: once riding the norm
+#: envelope there is nothing left to escalate to.
+ADAPTIVE_REJECTED_STAGES = frozenset({"signflip", "scaled"})
+
+#: Multiplier for the adaptive ``scaled`` stage (full-parameter blow-up).
+ADAPTIVE_SCALE = 10.0
+
+
+def adaptive_attack_schedule(
+    rounds: int,
+    ladder: Sequence[str] = ADAPTIVE_LADDER,
+    patience: int = 1,
+) -> Tuple[str, ...]:
+    """The adaptive adversary's attack-per-round stream as a PURE function
+    of ``(rounds, ladder, patience)`` — the replay oracle.
+
+    Recurrence: the adversary opens every campaign at ``ladder[0]`` and
+    escalates one rung after ``patience`` rounds in a rejected stage
+    (stages in :data:`ADAPTIVE_REJECTED_STAGES` are rejected by
+    construction — the admission norm gate rejects them whenever the
+    federation has >=1 honest receiver, which every campaign scenario
+    guarantees). The live :class:`AdaptiveAdversary` drives the same
+    recurrence off the OBSERVED ``p2pfl_updates_rejected_total``
+    attribution; this closed form is what tests and the campaign invariants
+    compare its decision stream against, so a desync between "what the
+    adversary saw" and "what the seed implies" is a caught failure, not a
+    silent drift."""
+    if patience < 1:
+        raise ValueError(f"patience must be >= 1, got {patience}")
+    if not ladder:
+        raise ValueError("ladder must not be empty")
+    stage, hits = 0, 0
+    out = []
+    for _ in range(max(0, int(rounds))):
+        attack = ladder[stage]
+        out.append(attack)
+        if attack in ADAPTIVE_REJECTED_STAGES:
+            hits += 1
+            if hits >= patience and stage < len(ladder) - 1:
+                stage += 1
+                hits = 0
+    return tuple(out)
+
+
+def adaptive_poison(new_params, old_params, attack: str):
+    """Apply one adaptive-ladder ``attack`` to a trained leaf pair — the
+    single corruption function BOTH backends call (wire: in the learner's
+    ``fit``; fused: via ``poison_delta``'s ``norm_ride`` alias), so a given
+    (stage, params) pair corrupts bit-identically everywhere.
+
+    * ``signflip`` — full-parameter negation ``-new`` (NOT the delta
+      reflection the frame-level chaos attack of the same name applies):
+      distance ~2*||params|| from any honest peer, far outside the
+      admission bound;
+    * ``scaled`` — full-parameter blow-up ``new * ADAPTIVE_SCALE``;
+    * ``norm_ride`` — delta reflection ``old - (new - old)``, delegated to
+      :func:`p2pfl_tpu.parallel.simulation.poison_delta` so the wire leaf
+      math is literally the fused branch.
+
+    Pure, RNG-free, float32 like ``poison_delta`` — composes with the
+    deterministic chaos decision streams without desyncing them."""
+    import jax.numpy as jnp
+
+    from p2pfl_tpu.parallel.simulation import poison_delta
+
+    if attack == "signflip":
+        return -new_params.astype(jnp.float32)
+    if attack == "scaled":
+        return new_params.astype(jnp.float32) * jnp.float32(ADAPTIVE_SCALE)
+    if attack == "norm_ride":
+        return poison_delta(new_params, old_params, "norm_ride")
+    raise ValueError(f"unknown adaptive attack {attack!r}")
 
 
 @dataclass(frozen=True)
@@ -333,6 +433,31 @@ class ChaosPlane:
         # environment noise whose counts are run-dependent — metrics only.
         LEDGERS.emit(label, "chaos_fault", fault="recovery", peer=label, step=kind)
         log.warning("chaos: recovery event %s %s", kind, label)
+
+    def adaptive_switch(
+        self, addr: str, round: int, old_attack: str, new_attack: str,
+        rejections: int,
+    ) -> None:
+        """Count one EXECUTED adaptive-adversary escalation (the attacker
+        observed its own admission rejections and climbed the ladder).
+        Scenario-shaping like :meth:`recovery`, so it enters both the fault
+        table (``fault="adaptive_switch"``) and the ledger — chaos_fault
+        events are environment facts parity_diff excludes, so the wire-only
+        escalation record never breaks cross-backend alignment."""
+        with self._lock:
+            self._count(addr, "adaptive_switch")
+        from p2pfl_tpu.telemetry.ledger import LEDGERS
+
+        LEDGERS.emit(
+            addr, "chaos_fault", fault="adaptive_switch", peer=addr,
+            round=int(round), step=f"{old_attack}->{new_attack}",
+            rejections=int(rejections),
+        )
+        log.warning(
+            "chaos: adaptive adversary %s escalated %s -> %s at round %d "
+            "(%d attributed rejections)",
+            addr, old_attack, new_attack, round, rejections,
+        )
 
     def link_blocked(self, src: str, dst: str) -> Optional[str]:
         """State-only view of whether the ``src -> dst`` link is blocked
@@ -593,6 +718,97 @@ class ChaosPlane:
                 yield self
         finally:
             self.reset()
+
+
+class AdaptiveAdversary:
+    """Live driver of the adaptive attack ladder for one wire adversary.
+
+    The adversary OBSERVES the federation's defense: honest receivers that
+    reject its frames attribute the rejection to its address in
+    ``p2pfl_updates_rejected_total{source=<addr>}`` (comm/admission.py), and
+    this observer reads exactly that attribution — the adversary learns
+    only what a real attacker gossiping into the mesh could learn from its
+    peers' behavior. :meth:`attack_for_round` is called ONCE per round at
+    fit time: if the attributed-rejection count grew since the last
+    observation, the current (rejected) stage took a hit and the ladder
+    escalates after ``patience`` hits, reported via
+    :meth:`ChaosPlane.adaptive_switch`.
+
+    Determinism: under the campaign guarantees (>=1 honest receiver, every
+    round's poisoned frame gossips before the next round's fit — the
+    aggregation barrier enforces this), every rejected-stage round produces
+    >=1 attributed rejection, making the realized decision stream equal to
+    the pure :func:`adaptive_attack_schedule` oracle. The ``stage <
+    len(ladder) - 1`` cap in the recurrence means stale re-gossiped frames
+    from an earlier round can never over-escalate past the terminal stage.
+    ``decisions`` records the realized (round, attack, rejections) stream
+    for the campaign invariant that asserts oracle equality."""
+
+    def __init__(
+        self,
+        addr: str,
+        ladder: Sequence[str] = ADAPTIVE_LADDER,
+        patience: int = 1,
+    ) -> None:
+        if patience < 1:
+            raise ValueError(f"patience must be >= 1, got {patience}")
+        if not ladder:
+            raise ValueError("ladder must not be empty")
+        self.addr = addr
+        self.ladder = tuple(ladder)
+        self.patience = int(patience)
+        self._stage = 0
+        self._hits = 0
+        #: counter baseline: the registry counter is process-wide, so start
+        #: from its CURRENT value — rejections attributed to this address by
+        #: an earlier scenario in the same process are not this campaign's.
+        self._seen = self.rejections_attributed()
+        self.decisions: List[Dict[str, Any]] = []
+
+    def rejections_attributed(self) -> int:
+        """Total admission rejections every honest node attributed to this
+        adversary's address (sum over the ``source`` label across nodes and
+        reasons — the raw per-frame count, which only needs to GROW to
+        signal a hit, so gossip re-ship multiplicity is harmless)."""
+        fam = REGISTRY.get("p2pfl_updates_rejected_total")
+        if fam is None:
+            return 0
+        return int(
+            sum(
+                child.value
+                for labels, child in fam.samples()
+                if labels.get("source") == self.addr
+            )
+        )
+
+    @property
+    def current_attack(self) -> str:
+        return self.ladder[self._stage]
+
+    def attack_for_round(self, rnd: int) -> str:
+        """The attack to apply this round; observes rejections FIRST, so an
+        escalation triggered by round ``r-1``'s rejections lands at round
+        ``r`` — the same stage stream :func:`adaptive_attack_schedule`
+        produces."""
+        total = self.rejections_attributed()
+        if (
+            self.current_attack in ADAPTIVE_REJECTED_STAGES
+            and total > self._seen
+        ):
+            self._hits += 1
+            if self._hits >= self.patience and self._stage < len(self.ladder) - 1:
+                old = self.current_attack
+                self._stage += 1
+                self._hits = 0
+                CHAOS.adaptive_switch(
+                    self.addr, int(rnd), old, self.current_attack, total
+                )
+        self._seen = total
+        attack = self.current_attack
+        self.decisions.append(
+            {"round": int(rnd), "attack": attack, "rejections": total}
+        )
+        return attack
 
 
 #: The process-wide chaos plane the transport send path consults.

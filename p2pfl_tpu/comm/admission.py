@@ -106,6 +106,13 @@ class AdmissionController:
         # Optional flight recorder (set by Node): every rejection becomes a
         # postmortem event alongside the metric.
         self.recorder: Optional[Any] = None
+        # Permissive mode: admit every structurally-decodable frame. The
+        # campaign harness sets this on the ADAPTIVE ADVERSARY's own node —
+        # an attacker does not defend itself, and if it screened inbound
+        # honest frames against its own poisoned local model it would
+        # reject the entire federation and diverge from the very state it
+        # is trying to ride (population/scenarios.py run_scenario_wire).
+        self.permissive = False
 
     # --- accounting ----------------------------------------------------------
 
@@ -173,7 +180,7 @@ class AdmissionController:
         ``check_norm``, records its update norm into the adaptive-bound
         history), else the rejection reason (already counted/logged).
         """
-        if not Settings.ADMISSION_ENABLED:
+        if not Settings.ADMISSION_ENABLED or self.permissive:
             return None
         local: List[np.ndarray] = local_model.get_parameters()
         if len(arrays) != len(local):

@@ -474,6 +474,26 @@ class Settings:
     POP_BENCH_ROUNDS: int = _env_int("POP_BENCH_ROUNDS", 10, 1, 10_000)
     POP_BENCH_COHORT: float = _env_float("POP_BENCH_COHORT", 0.01, 0.0, 1.0)
 
+    # --- campaign harness (campaigns/) --------------------------------------
+    # Seeded scenario-matrix campaigns: CAMPAIGN_SEED roots the sampler (one
+    # seed => one reproducible campaign of scenarios), CAMPAIGN_SCENARIOS is
+    # the bench.py --campaign sample size (>= 20 per the robustness
+    # acceptance; every scenario runs on BOTH backends under the parity
+    # gate), CAMPAIGN_CHECK_SCENARIOS the small `make campaign-check` replay
+    # subset diffed against the committed baseline.
+    CAMPAIGN_SEED: int = _env_int("CAMPAIGN_SEED", 20260806, 0, 2**31 - 1)
+    CAMPAIGN_SCENARIOS: int = _env_int("CAMPAIGN_SCENARIOS", 20, 1, 10_000)
+    CAMPAIGN_CHECK_SCENARIOS: int = _env_int("CAMPAIGN_CHECK_SCENARIOS", 4, 1, 10_000)
+    # Aggregation stall patience for campaign wire runs with an adaptive
+    # adversary: rejected-stage rounds NEVER deliver the adversary's
+    # contribution, so honest aggregators must break out of the
+    # all-contributions wait quickly (the normal 60 s parity patience would
+    # stretch a 20-scenario campaign by hours). Small but > the in-memory
+    # gossip propagation time at campaign scale (n <= 12).
+    CAMPAIGN_STALL_PATIENCE: float = _env_float(
+        "CAMPAIGN_STALL_PATIENCE", 2.0, 0.1, 3600.0
+    )
+
     # --- async population windows (population/async_engine.py) --------------
     # FedBuff-style windows over the fused mesh: each scanned step is one
     # WINDOW, fill target = FILL_FRACTION of the solicited cohort K (clamped

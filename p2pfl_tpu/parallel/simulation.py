@@ -67,9 +67,15 @@ def poison_delta(new: jax.Array, old: jax.Array, attack: str, scale: float = 10.
     round start (``old - (new - old)``), ``scaled`` multiplies it. Shared by
     the fused round body and the wire-side parity adversary
     (:mod:`p2pfl_tpu.parity`) so both backends corrupt with bit-identical
-    math — the parity ledger certifies the corruption itself."""
+    math — the parity ledger certifies the corruption itself.
+
+    ``norm_ride`` is the adaptive-adversary campaign family's name for the
+    delta reflection (chaos/plane.py's ``ADAPTIVE_LADDER`` terminal stage:
+    an attack that RIDES the admitted-norm envelope — the reflected update
+    sits exactly as far from honest peers as an honest update would). It is
+    the same branch, aliased so both backends share one corruption site."""
     delta = new.astype(jnp.float32) - old.astype(jnp.float32)
-    if attack == "signflip":
+    if attack in ("signflip", "norm_ride"):
         return old.astype(jnp.float32) - delta
     return old.astype(jnp.float32) + scale * delta
 
@@ -396,7 +402,9 @@ class MeshSimulation:
             raise ValueError(f"unknown task {task!r}")
         if algorithm not in ("fedavg", "scaffold"):
             raise ValueError(f"unknown algorithm {algorithm!r}")
-        if byzantine_mask is not None and byzantine_attack not in ("signflip", "scaled"):
+        if byzantine_mask is not None and byzantine_attack not in (
+            "signflip", "scaled", "norm_ride",
+        ):
             raise ValueError(f"unknown byzantine_attack {byzantine_attack!r}")
         if byzantine_mask is not None and algorithm == "scaffold":
             raise ValueError(
@@ -775,6 +783,7 @@ class MeshSimulation:
         self, carry, key: jax.Array, do_eval: jax.Array, data, epochs: int,
         committee: Optional[jax.Array] = None,
         round_idx: Optional[jax.Array] = None, devobs: bool = False,
+        fold_pos: Optional[jax.Array] = None,
     ):
         params_stack, opt_stack, c_stack, c_global = carry
         x, y, sample_mask, num_samples, xt, yt = data
@@ -889,7 +898,20 @@ class MeshSimulation:
             )
         else:
             # FedAvg over the committee, weighted by true sample counts.
-            agg = self.aggregate_fn(p_k_new, num_samples[committee])
+            # A fold row (campaign adaptive-adversary rounds) narrows the
+            # fold to a GATHERED [K_f]-sub-stack of the committee — the wire
+            # analogue is admission rejecting a member's frame, so honest
+            # aggregators fold one contribution fewer. Gathering (not
+            # zero-weighting) keeps the reduction's stack shape equal to the
+            # wire aggregator's, which is what makes the excluded-member
+            # aggregate bit-comparable across backends.
+            if fold_pos is not None:
+                p_fold = jax.tree.map(lambda a: a[fold_pos], p_k_new)
+                agg = self.aggregate_fn(
+                    p_fold, num_samples[committee][fold_pos]
+                )
+            else:
+                agg = self.aggregate_fn(p_k_new, num_samples[committee])
             if self.server_tx is not None:
                 # FedOpt server step: pseudo-gradient g = x_t - aggregate,
                 # applied through the server optimizer (sgd(1.0) reduces
@@ -1021,8 +1043,8 @@ class MeshSimulation:
     )
     def _run_jit(
         self, params_stack, opt_stack, c_stack, c_global, data, start_round,
-        final_round, committee_schedule=None, *, rounds: int, epochs: int,
-        eval_every: int = 1, devobs: bool = False,
+        final_round, committee_schedule=None, fold_schedule=None, *,
+        rounds: int, epochs: int, eval_every: int = 1, devobs: bool = False,
     ):
         # Per-round keys are position-independent (fold_in on the absolute
         # round index): chunking and checkpoint-resume replay identically.
@@ -1038,23 +1060,32 @@ class MeshSimulation:
         # finite cohort loss through the scan carry (initialized to +inf
         # here, dropped at return — the public state signature is
         # unchanged and stays donation-compatible).
+        # xs slots beyond (keys, do_eval, idx) are assigned positions here
+        # and unpacked by the same map inside the body — a None-vs-array
+        # choice is a trace-time (pytree-structure) distinction, so voted,
+        # scheduled and fold-scheduled programs are separate compiled
+        # executables.
+        xs_extra: list = []
+        comm_slot = fold_slot = None
+        if committee_schedule is not None:
+            # Cohort sampling: one precomputed [rounds, K] committee row
+            # per scanned round (population/cohort.py).
+            comm_slot = 3 + len(xs_extra)
+            xs_extra.append(committee_schedule)
+        if fold_schedule is not None:
+            # Admission-narrowed folds: one [rounds, K_f] row of POSITIONS
+            # into the committee row (campaign adaptive-adversary rounds).
+            fold_slot = 3 + len(xs_extra)
+            xs_extra.append(fold_schedule)
+
         def body(c, ke):
             inner, floor = c
-            if committee_schedule is None:
-                inner, (committee, tr, tl, ta, aux) = self._round_body(
-                    inner, ke[0], ke[1], data, epochs,
-                    round_idx=ke[2], devobs=devobs,
-                )
-            else:
-                # Cohort sampling: one precomputed [rounds, K] committee
-                # row per scanned round (population/cohort.py). None-vs-
-                # array is a trace-time (pytree-structure) distinction, so
-                # the voted and scheduled programs are separate compiled
-                # executables.
-                inner, (committee, tr, tl, ta, aux) = self._round_body(
-                    inner, ke[0], ke[1], data, epochs, committee=ke[3],
-                    round_idx=ke[2], devobs=devobs,
-                )
+            inner, (committee, tr, tl, ta, aux) = self._round_body(
+                inner, ke[0], ke[1], data, epochs,
+                committee=None if comm_slot is None else ke[comm_slot],
+                round_idx=ke[2], devobs=devobs,
+                fold_pos=None if fold_slot is None else ke[fold_slot],
+            )
             if devobs:
                 finite = jnp.isfinite(tr)
                 aux["diverged"] = (
@@ -1065,10 +1096,7 @@ class MeshSimulation:
                 aux["diverged"] = jnp.bool_(False)
             return (inner, floor), (committee, tr, tl, ta, aux)
 
-        if committee_schedule is None:
-            xs: Any = (keys, do_eval, idx)
-        else:
-            xs = (keys, do_eval, idx, committee_schedule)
+        xs: Any = (keys, do_eval, idx, *xs_extra)
         carry = (
             (params_stack, opt_stack, c_stack, c_global),
             jnp.float32(jnp.inf),
@@ -1095,6 +1123,7 @@ class MeshSimulation:
         eval_every: int = 1,
         profile_dir: Optional[str] = None,
         committee_schedule: Optional[np.ndarray] = None,
+        fold_schedule: Optional[np.ndarray] = None,
     ) -> SimulationResult:
         """Execute ``rounds`` federated rounds on the mesh.
 
@@ -1137,6 +1166,17 @@ class MeshSimulation:
         must lie in the LOGICAL population (fillers excluded); row ``i``
         drives absolute round ``completed_rounds + i``, and chunking slices
         the schedule to match.
+
+        ``fold_schedule`` (``[rounds, K_f]`` int32 POSITIONS into the same
+        round's committee row; requires ``committee_schedule``) narrows
+        each round's FedAvg fold to a sub-committee — the fused replica of
+        wire admission rejecting a member's frames (campaign
+        adaptive-adversary rounds): the excluded member still trains and
+        still appears in ``round_open.members``, but its update is not
+        folded and the diffusion broadcast overwrites it. ``K_f`` is static
+        per call; rounds with different fold widths run as separate
+        ``run()`` calls (two compiled programs total for an
+        admitted/rejected campaign).
         """
         if self._closed:
             raise RuntimeError(
@@ -1175,6 +1215,34 @@ class MeshSimulation:
                     f"[0, {self.logical_num_nodes}) — the logical population "
                     "(mesh-axis fillers are not electable)"
                 )
+        fsched: Optional[np.ndarray] = None
+        if fold_schedule is not None:
+            if sched is None:
+                raise ValueError(
+                    "fold_schedule positions index a committee row — pass "
+                    "committee_schedule alongside it"
+                )
+            if self.algorithm == "scaffold":
+                raise ValueError(
+                    "fold_schedule narrows the FedAvg fold; scaffold's "
+                    "server update has no narrowed variant here"
+                )
+            fsched = np.asarray(fold_schedule, np.int32)
+            if (
+                fsched.ndim != 2
+                or fsched.shape[0] != rounds
+                or not 1 <= fsched.shape[1] <= sched.shape[1]
+            ):
+                raise ValueError(
+                    f"fold_schedule has shape {fsched.shape}, expected "
+                    f"({rounds}, 1<=K_f<={sched.shape[1]}) — one row of "
+                    "committee positions per round"
+                )
+            if fsched.min() < 0 or fsched.max() >= sched.shape[1]:
+                raise ValueError(
+                    f"fold_schedule entries are POSITIONS into the round's "
+                    f"committee row and must be in [0, {sched.shape[1]})"
+                )
 
         # Device observatory: `devobs` is a STATIC jit argument — read once
         # per run so every chunk (warmup included) compiles one program.
@@ -1206,6 +1274,7 @@ class MeshSimulation:
                     wp, wo, wc, wcg, data, jnp.int32(start + rounds + 1),
                     jnp.int32(start + rounds + chunks[0]),
                     None if sched is None else jnp.asarray(sched[: chunks[0]]),
+                    None if fsched is None else jnp.asarray(fsched[: chunks[0]]),
                     rounds=chunks[0], epochs=epochs, eval_every=eval_every,
                     devobs=devobs,
                 )
@@ -1266,6 +1335,9 @@ class MeshSimulation:
                         None
                         if sched is None
                         else jnp.asarray(sched[done: done + chunk]),
+                        None
+                        if fsched is None
+                        else jnp.asarray(fsched[done: done + chunk]),
                         rounds=chunk, epochs=epochs, eval_every=eval_every,
                         devobs=devobs,
                     )
@@ -1277,7 +1349,10 @@ class MeshSimulation:
                 # from the chunk's already-materialized committee array and
                 # the post-chunk population state, never from inside jit).
                 if self._ledger is not None:
-                    self._ledger_emit_chunk(comm, start + done - chunk, params_stack)
+                    self._ledger_emit_chunk(
+                        comm, start + done - chunk, params_stack,
+                        None if fsched is None else fsched[done - chunk: done],
+                    )
                 # Per chunk, not per run: a later chunk failing must not
                 # erase the noise already injected by completed chunks.
                 # (Replayed rounds after a checkpoint resume re-count,
@@ -1504,8 +1579,16 @@ class MeshSimulation:
                 )
         return self._ledger
 
-    def _ledger_emit_chunk(self, committees, first_round: int, params_stack) -> None:
-        """Emit round events for one completed chunk (see attach_ledger)."""
+    def _ledger_emit_chunk(
+        self, committees, first_round: int, params_stack, fold_schedule=None
+    ) -> None:
+        """Emit round events for one completed chunk (see attach_ledger).
+
+        With a ``fold_schedule`` slice, ``round_open.members`` still lists
+        the FULL committee (election is a membership fact) while
+        ``contribution_folded`` / ``aggregate_committed.contributors``
+        cover only the folded sub-committee — exactly the event shape a
+        wire observer produces when admission rejects a member's frames."""
         led, names = self._ledger, self._ledger_names
         if led is None or names is None:
             return
@@ -1515,16 +1598,20 @@ class MeshSimulation:
             r = first_round + ri
             members = [names[int(i)] for i in comm[ri]]
             led.emit("round_open", round=r, members=sorted(members))
+            if fold_schedule is None:
+                folded = [int(i) for i in comm[ri]]
+            else:
+                folded = [int(comm[ri][int(p)]) for p in fold_schedule[ri]]
             total = 0
-            for i in comm[ri]:
-                n_i = int(samples[int(i)])
+            for i in folded:
+                n_i = int(samples[i])
                 total += n_i
                 led.emit(
-                    "contribution_folded", round=r, sender=names[int(i)],
+                    "contribution_folded", round=r, sender=names[i],
                     lag=0, num_samples=n_i,
                 )
             commit: Dict[str, Any] = {
-                "contributors": sorted(members),
+                "contributors": sorted(names[i] for i in folded),
                 "num_samples": total,
                 "origin": "mesh",
             }

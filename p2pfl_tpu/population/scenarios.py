@@ -98,6 +98,20 @@ class PopulationScenario(ParityScenario):
     #: mapped to the fused backend's ``node_speed`` tiers (fused-only;
     #: trajectory-invariant by construction).
     speed_tiers: Tuple[float, ...] = ()
+    #: run the wire federation under masked secure aggregation
+    #: (``Settings.PRIVACY_SECAGG``): gossip ships ring-lattice frames and
+    #: nodes aggregate via ``MaskedFedAvg``. Fused execution stays
+    #: plaintext — masked quantization changes the arithmetic by design, so
+    #: the campaign grades this family STRUCTURALLY plus the
+    #: masked-vs-plain hash negative control instead of bit parity
+    #: (tests/test_privacy.py::test_parity_negative_control...).
+    privacy: bool = False
+    #: node index of one ADAPTIVE adversary (chaos/plane.py's
+    #: AdaptiveAdversary family): climbs the signflip -> scaled -> norm_ride
+    #: ladder as its admission rejections accumulate. None = no adaptive
+    #: adversary (the static ``byzantine_fraction`` axis is independent).
+    adaptive_adversary: Optional[int] = None
+    adaptive_patience: int = 1
 
     def __post_init__(self) -> None:
         if self.byzantine_fraction and not self.byzantine:
@@ -110,12 +124,82 @@ class PopulationScenario(ParityScenario):
             raise ValueError(
                 f"cohort_fraction must be in (0, 1], got {self.cohort_fraction}"
             )
+        if self.privacy and (
+            self.adaptive_adversary is not None
+            or self.byzantine
+            or self.byzantine_fraction
+        ):
+            # Masked frames hide individual updates from admission — the
+            # rejection signal every adversary axis is graded on cannot
+            # exist under secagg (the admission-vs-secrecy tension,
+            # node.py's linear-rule check).
+            raise ValueError(
+                "privacy does not compose with the byzantine/adaptive axes"
+            )
+        if self.adaptive_adversary is not None:
+            # The adaptive family's cross-backend replica (fold_schedule on
+            # the fused mesh) and its decision-stream oracle both assume a
+            # full, stable committee with a working admission signal:
+            #  * full cohorts, no churn — every round folds either n or n-1
+            #    contributions, so the two fused programs cover the run;
+            #  * no frame drops — a dropped poisoned frame would starve the
+            #    rejection signal the ladder escalates on;
+            #  * n >= 6 — each honest receiver admits >= 4 honest norms in
+            #    round 0, arming the adaptive bound (MIN_NORM_HISTORY) that
+            #    must ADMIT the terminal norm_ride stage;
+            #  * index != 0 — names[0] is the rotating observer whose ledger
+            #    certifies the trajectory, and must stay honest;
+            #  * no static byzantine axis on top — one attributed source.
+            if not 0 < int(self.adaptive_adversary) < self.n_nodes:
+                raise ValueError(
+                    f"adaptive_adversary must be in [1, {self.n_nodes}) — "
+                    "index 0 is the trajectory observer"
+                )
+            if self.cohort_fraction != 1.0 or self.churn_rate != 0.0:
+                raise ValueError(
+                    "adaptive_adversary needs full stable committees "
+                    "(cohort_fraction=1.0, churn_rate=0.0)"
+                )
+            if self.drop_rate != 0.0:
+                raise ValueError(
+                    "adaptive_adversary needs a lossless wire (drop_rate=0)"
+                )
+            if self.n_nodes < 6:
+                raise ValueError(
+                    "adaptive_adversary needs n_nodes >= 6 so admission's "
+                    "norm history arms during round 0"
+                )
+            if self.byzantine or self.byzantine_fraction:
+                raise ValueError(
+                    "adaptive_adversary does not compose with the static "
+                    "byzantine axis (rejection attribution must be unique)"
+                )
+            if self.adaptive_patience < 1:
+                raise ValueError(
+                    f"adaptive_patience must be >= 1, got {self.adaptive_patience}"
+                )
 
     @property
     def run_id(self) -> str:
-        return (
+        base = (
             f"population-s{self.seed}-n{self.n_nodes}-r{self.rounds}"
             f"-c{self.cohort_fraction:g}"
+        )
+        if self.adaptive_adversary is not None:
+            base += f"-adv{self.adaptive_adversary}p{self.adaptive_patience}"
+        if self.privacy:
+            base += "-priv"
+        return base
+
+    def adaptive_schedule(self) -> Tuple[str, ...]:
+        """The adaptive adversary's attack-per-round oracle (pure seeded
+        recurrence — what the realized wire decision stream must equal)."""
+        from p2pfl_tpu.chaos.plane import adaptive_attack_schedule
+
+        if self.adaptive_adversary is None:
+            return ()
+        return adaptive_attack_schedule(
+            self.rounds, patience=self.adaptive_patience
         )
 
     @property
@@ -181,6 +265,9 @@ class PopulationLearner(ParityLearner):
     (exactly the key the fused schedule row assigns that member)."""
 
     def __init__(self, *args: Any, **kwargs: Any) -> None:
+        # The adaptive-adversary node carries its live ladder driver
+        # (chaos.plane.AdaptiveAdversary); honest nodes carry None.
+        self._adaptive = kwargs.pop("adaptive", None)
         super().__init__(*args, **kwargs)
         scn = self.scenario
         if not isinstance(scn, PopulationScenario):
@@ -217,7 +304,21 @@ class PopulationLearner(ParityLearner):
         new_params, _loss = self._train_fn(
             start, self._x, self._y, self._w, keys[rank]
         )
-        if self._attack:
+        if self._adaptive is not None:
+            # One ladder decision per round, BEFORE corruption: the driver
+            # observes the rejections its previous rounds earned and may
+            # escalate, then this round's attack corrupts the whole tree.
+            from p2pfl_tpu.chaos.plane import adaptive_poison
+
+            attack = self._adaptive.attack_for_round(r)
+            new_params = jax.tree.map(
+                lambda new, old: adaptive_poison(new, old, attack).astype(
+                    new.dtype
+                ),
+                new_params,
+                start,
+            )
+        elif self._attack:
             new_params = jax.tree.map(
                 lambda new, old: poison_delta(new, old, self._attack).astype(
                     new.dtype
@@ -256,6 +357,38 @@ def stitch_observer_stream(
 
 
 # --- backend runners ----------------------------------------------------------
+
+
+def build_adaptive_aggregator(adv: Any) -> Any:
+    """The adaptive adversary's OWN aggregator: a :class:`CanonicalFedAvg`
+    that, in rejected ladder stages, drops its own poisoned contribution
+    from the final fold.
+
+    The poisoned model must stay STORED (gossip distributes from the
+    aggregator's model table — un-stored poison would never reach peers and
+    the rejection signal the ladder climbs on would never exist), so the
+    exclusion happens at :meth:`aggregate` time instead: honest nodes never
+    admitted the poisoned frame and stall-patience-aggregate the n-1 honest
+    set; the adversary aggregates the SAME n-1 set, so every node — and the
+    fused backend's fold_schedule replica — commits a bit-identical
+    aggregate. In admitted stages (norm_ride) nothing is filtered and all n
+    contributions fold everywhere."""
+    from p2pfl_tpu.chaos.plane import ADAPTIVE_REJECTED_STAGES
+    from p2pfl_tpu.learning.aggregators import CanonicalFedAvg
+
+    class AdaptiveAdversaryAggregator(CanonicalFedAvg):
+        def aggregate(self, models):
+            if adv.current_attack in ADAPTIVE_REJECTED_STAGES:
+                honest = [
+                    m
+                    for m in models
+                    if set(m.contributors) != {self.node_addr}
+                ]
+                if honest:
+                    models = honest
+            return super().aggregate(models)
+
+    return AdaptiveAdversaryAggregator()
 
 
 def run_scenario_wire(
@@ -306,26 +439,86 @@ def run_scenario_wire(
             Settings.CHAOS_ENABLED = True
             Settings.CHAOS_SEED = scn.seed
             Settings.CHAOS_DROP_RATE = float(scn.drop_rate)
+            # Two failure-detector interactions break bit parity under
+            # lossy links if left at test defaults:
+            #
+            # * Heartbeats ride the same chaos'd links (send() consults
+            #   CHAOS.intercept for EVERY envelope). At the 1.5s test
+            #   timeout (6 x 0.25s beats) a 0.15 drop rate falsely
+            #   declares a live peer dead about once every few runs
+            #   (0.15^6 per window, thousands of windows per run). The
+            #   death callbacks then fold the aggregation WITHOUT that
+            #   contributor; the fused backend folds everyone, so the
+            #   trajectory hashes diverge. Widen the miss budget rather
+            #   than exempting heartbeats from chaos — under frame loss
+            #   a failure detector needs more missed beats before
+            #   declaring death, not a cleaner link (40 beats at 0.15
+            #   is ~1e-33 per window).
+            # * A node that gives up waiting — the AGGREGATION_TIMEOUT
+            #   deadline or the JIT stall patience — folds a PARTIAL
+            #   set, same divergence. Dropped vote/coverage frames can
+            #   stall repair for several VOTE_TIMEOUT cycles, so both
+            #   escape hatches need headroom well past repair time; the
+            #   campaign's agg_wait invariant (AGG_WAIT_BOUNDS, 120s for
+            #   the lossy family) still flags pathological stalls.
+            Settings.HEARTBEAT_TIMEOUT = 10.0
+            Settings.AGGREGATION_TIMEOUT = 600.0
+            Settings.AGGREGATION_STALL_PATIENCE = 180.0
+        if scn.privacy:
+            Settings.PRIVACY_SECAGG = True
+        adv = None
+        if scn.adaptive_adversary is not None:
+            from p2pfl_tpu.chaos.plane import AdaptiveAdversary
+
+            # Rejected-stage rounds never deliver the adversary's frame, so
+            # honest aggregators must stall-patience out of the full-set
+            # wait quickly; the campaign patience is sized for the
+            # in-memory wire at campaign scale.
+            Settings.AGGREGATION_STALL_PATIENCE = float(
+                Settings.CAMPAIGN_STALL_PATIENCE
+            )
+            adv = AdaptiveAdversary(
+                names[scn.adaptive_adversary], patience=scn.adaptive_patience
+            )
         LEDGERS.reset()
         LEDGERS.configure(scn.run_id)
         install_plan(scn.plan())
 
         for i, name in enumerate(names):
             data = FederatedDataset.from_arrays(x[i], y[i])
+            is_adv = adv is not None and i == scn.adaptive_adversary
             nodes.append(
                 Node(
                     template.build_copy(),
                     data,
                     addr=name,
                     learner=PopulationLearner,
-                    aggregator=CanonicalFedAvg(),
+                    aggregator=(
+                        build_adaptive_aggregator(adv)
+                        if is_adv
+                        # Masked rounds need a linear partial-aggregation
+                        # rule: Node picks MaskedFedAvg when given None.
+                        else (None if scn.privacy else CanonicalFedAvg())
+                    ),
                     executor=False,
                     node_idx=i,
                     scenario=scn,
                     arrays=(x[i], y[i], w[i]),
                     train_fn=train_fn,
+                    adaptive=adv if is_adv else None,
                 )
             )
+            if is_adv:
+                # The adversary does not defend itself: if it norm-screened
+                # inbound honest frames against its own poisoned local
+                # model it would reject the whole federation and its state
+                # would diverge from the aggregate it is attacking. With a
+                # permissive gate its own-contribution-filtering aggregator
+                # (build_adaptive_aggregator) folds exactly the honest set,
+                # keeping its round-start params bit-identical to honest
+                # nodes' — the invariant the fused fold_schedule replica
+                # relies on.
+                nodes[-1].state.admission.permissive = True
         for nd in nodes:
             nd.start()
         for i in range(1, len(nodes)):
@@ -361,6 +554,11 @@ def run_scenario_wire(
                 )
             out["ledgers"][name] = path
         out["stitched"] = stitch_observer_stream(scn, out["events"])
+        if adv is not None:
+            out["adaptive"] = {
+                "decisions": list(adv.decisions),
+                "schedule": list(scn.adaptive_schedule()),
+            }
         return out
     finally:
         clear_plan()
@@ -380,7 +578,21 @@ def run_scenario_fused(
     """Run the scenario on the fused mesh: the plan compiles to a
     committee schedule (``sim.run(committee_schedule=…)``), speed tiers map
     to ``node_speed``, adversaries to the byzantine mask. Same return shape
-    as :func:`p2pfl_tpu.parity.run_fused`."""
+    as :func:`p2pfl_tpu.parity.run_fused`, plus ``"final_params"`` — the
+    end-of-run global model as a host pytree (every backend's params are
+    hash-certified equal, so campaign invariant grading evaluates this one).
+
+    An ``adaptive_adversary`` scenario replays the wire's adaptive ladder
+    exactly: the adversary is a static ``norm_ride`` byzantine (the
+    TERMINAL, admitted stage — the only one whose corruption ever reaches
+    an aggregate), and each rejected-stage round narrows the fold with a
+    ``fold_schedule`` row excluding the adversary's committee position (the
+    fused replica of every honest receiver rejecting its frame). Rejected-
+    stage corruption never matters on either backend — excluded from the
+    fold and overwritten by the diffusion broadcast — so the static attack
+    plus the fold rows reproduce the wire trajectory bit-exactly. Rounds
+    run one ``run()`` call each (fold width K vs K-1 is call-static): two
+    compiled programs total."""
     import optax
 
     from p2pfl_tpu.parallel.simulation import MeshSimulation
@@ -396,6 +608,10 @@ def run_scenario_fused(
         for idx, att in scn.byzantine.items():
             byz_mask[int(idx)] = 1.0
             attack = att
+    if scn.adaptive_adversary is not None:
+        byz_mask = np.zeros(scn.n_nodes, np.float32)
+        byz_mask[int(scn.adaptive_adversary)] = 1.0
+        attack = "norm_ride"
     sim = None
     try:
         Settings.LEDGER_ENABLED = True
@@ -416,9 +632,34 @@ def run_scenario_fused(
             mesh=mesh,
         )
         led = sim.attach_ledger(node="mesh-sim", node_names=names)
-        sim.run(
-            scn.rounds, epochs=scn.epochs, warmup=False, rounds_per_call=1,
-            committee_schedule=scn.schedule(),
+        if scn.adaptive_adversary is None:
+            sim.run(
+                scn.rounds, epochs=scn.epochs, warmup=False,
+                rounds_per_call=1, committee_schedule=scn.schedule(),
+            )
+        else:
+            from p2pfl_tpu.chaos.plane import ADAPTIVE_REJECTED_STAGES
+
+            sched = scn.schedule()
+            k = sched.shape[1]
+            for r, att in enumerate(scn.adaptive_schedule()):
+                row = sched[r]
+                if att in ADAPTIVE_REJECTED_STAGES:
+                    fold = [
+                        p for p in range(k)
+                        if int(row[p]) != int(scn.adaptive_adversary)
+                    ]
+                else:
+                    fold = list(range(k))
+                sim.run(
+                    1, epochs=scn.epochs, warmup=False, rounds_per_call=1,
+                    committee_schedule=sched[r: r + 1],
+                    fold_schedule=np.asarray([fold], np.int32),
+                )
+        import jax
+
+        final_params = jax.tree.map(
+            lambda a: np.asarray(a[0]), sim.params_stack
         )
         events = led.canonical_events()
         path = None
@@ -432,6 +673,7 @@ def run_scenario_fused(
                 for ev in events
                 if ev["kind"] == "aggregate_committed" and "hash" in ev
             },
+            "final_params": final_params,
         }
     finally:
         if sim is not None:
@@ -442,6 +684,7 @@ def run_scenario_fused(
 __all__ = [
     "PopulationLearner",
     "PopulationScenario",
+    "build_adaptive_aggregator",
     "dirichlet_label_counts",
     "run_scenario_fused",
     "run_scenario_wire",

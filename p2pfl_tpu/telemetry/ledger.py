@@ -168,9 +168,12 @@ def _canonical_sort_key(ev: Dict[str, Any]):
 class TrajectoryLedger:
     """One node's append-only event ring (bounded by LEDGER_CAPACITY)."""
 
-    def __init__(self, node: str, run_id: str = "") -> None:
+    def __init__(self, node: str, run_id: str = "", campaign: str = "") -> None:
         self.node = node
         self.run_id = run_id
+        #: campaign id (campaigns/engine.py) — scopes this ledger's dumps to
+        #: one sampled campaign scenario; empty outside campaign runs.
+        self.campaign = campaign
         self._lock = threading.Lock()
         self._events: deque = deque(maxlen=max(16, int(Settings.LEDGER_CAPACITY)))
         self._seq = 0
@@ -258,6 +261,10 @@ class TrajectoryLedger:
             "canonical": bool(canonical),
             "dropped": self._dropped,
         }
+        if self.campaign:
+            # Present ONLY for campaign runs: pre-campaign dumps (and their
+            # committed baselines) stay byte-identical.
+            header["campaign"] = self.campaign
         evs = self.canonical_events() if canonical else self.events()
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
@@ -282,25 +289,40 @@ class LedgerHub:
         self._lock = threading.Lock()
         self._ledgers: Dict[str, TrajectoryLedger] = {}
         self._run_id = ""
+        self._campaign = ""
 
     @staticmethod
     def enabled() -> bool:
         return bool(Settings.LEDGER_ENABLED)
 
-    def configure(self, run_id: str) -> None:
+    @property
+    def campaign(self) -> str:
+        """The active campaign scope (empty outside campaign runs)."""
+        with self._lock:
+            return self._campaign
+
+    def configure(self, run_id: str, campaign: Optional[str] = None) -> None:
         """Set the experiment-wide run id stamped into every ledger created
         (or already live) in this process — the parity benches derive it
-        from the scenario seed so both backends' dumps carry the same id."""
+        from the scenario seed so both backends' dumps carry the same id.
+        ``campaign`` (campaigns/engine.py) additionally stamps the sampled
+        campaign's id into dump headers; passing ``None`` leaves the current
+        campaign scope untouched, ``""`` clears it."""
         with self._lock:
             self._run_id = str(run_id)
+            if campaign is not None:
+                self._campaign = str(campaign)
             for led in self._ledgers.values():
                 led.run_id = self._run_id
+                led.campaign = self._campaign
 
     def get(self, node: str) -> TrajectoryLedger:
         with self._lock:
             led = self._ledgers.get(node)
             if led is None:
-                led = TrajectoryLedger(node, run_id=self._run_id)
+                led = TrajectoryLedger(
+                    node, run_id=self._run_id, campaign=self._campaign
+                )
                 self._ledgers[node] = led
             return led
 
@@ -333,6 +355,10 @@ class LedgerHub:
         return paths
 
     def reset(self) -> None:
+        # The campaign scope deliberately SURVIVES reset: one campaign spans
+        # many scenario runs, each of which resets the hub between backends
+        # (run_scenario_wire/fused). The engine clears it explicitly with
+        # configure(run_id, campaign="") when the campaign ends.
         with self._lock:
             self._ledgers.clear()
             self._run_id = ""

@@ -314,6 +314,20 @@ class MetricsRegistry:
         for fam in self.collect():
             fam.clear()
 
+    def clear_families(self, names: Sequence[str]) -> None:
+        """Zero ONLY the named families (unknown names are fine — the
+        family may simply not have instrumented yet this process). The
+        campaign engine's scenario scoping: back-to-back scenarios in one
+        process must each start their chaos-fault / admission-rejection /
+        agg-wait counters from zero or replay-count assertions (and the
+        adaptive adversary's rejection observations) would see the previous
+        scenario's tail, while unrelated process-lifetime series (ledger
+        event totals, resource gauges) keep accumulating."""
+        for name in names:
+            fam = self.get(name)
+            if fam is not None:
+                fam.clear()
+
 
 #: The process-wide registry every subsystem instruments into.
 REGISTRY = MetricsRegistry()

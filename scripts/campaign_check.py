@@ -1,0 +1,170 @@
+"""campaign-check — the campaign-universe replay gate (~1-2 min CI shape).
+
+Replays the COMMITTED campaign baseline deterministically: the first
+``P2PFL_TPU_CAMPAIGN_CHECK_SCENARIOS`` scenarios of the default campaign
+(one per family in rotation order, so the ADAPTIVE-adversary headline
+family is always in the gate) run on BOTH backends, under the ledger
+parity differ, graded against their family invariants — and the result
+must match ``tests/campaign_fixtures/campaign_baseline.json`` byte for
+byte on the deterministic surface:
+
+1. the sampler re-derives the exact committed scenario keys (the campaign
+   space itself didn't drift);
+2. zero graded invariant violations;
+3. every replay-stable family's per-round aggregate hashes equal the
+   committed ones (wire AND fused — both backends, bit-for-bit);
+4. the adaptive adversary's realized decision stream equals the committed
+   one (the ladder escalated at the same rounds, driven by real
+   admission rejections).
+
+``--write-baseline`` regenerates the fixture after an INTENDED trajectory
+change (a new optimizer, a kernel change…) — the diff then shows exactly
+which hashes moved, which is the point of committing them.
+
+Exit 0 on pass, 1 on failure. ``make campaign-check`` wires it next to
+the other plane gates.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BASELINE_PATH = os.path.join(
+    REPO, "tests", "campaign_fixtures", "campaign_baseline.json"
+)
+
+
+def _fail(msg: str) -> int:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    write_baseline = "--write-baseline" in argv
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from p2pfl_tpu.campaigns import run_campaign, sample_campaign
+    from p2pfl_tpu.config import Settings
+
+    seed = int(Settings.CAMPAIGN_SEED)
+    k = int(Settings.CAMPAIGN_CHECK_SCENARIOS)
+    t0 = time.monotonic()
+    print(
+        f"campaign-check: seed={seed}, replaying {k} scenario(s) on both "
+        "backends...",
+        file=sys.stderr,
+    )
+
+    # Round-robin sampling makes the first k scenarios of ANY campaign
+    # size identical (family = FAMILIES[i % len], per-family ordinals) —
+    # the gate replays a true prefix of the full `bench.py --campaign` run.
+    sampled = sample_campaign(seed, k)
+    rep = run_campaign(
+        seed, k, emit=lambda m: print(f"  {m}", file=sys.stderr)
+    )
+
+    if rep["violations_total"]:
+        bad = [
+            v for s in rep["scenarios"]
+            for v in s.get("violations", [s.get("error", "")])
+        ]
+        return _fail(f"{rep['violations_total']} graded violation(s): {bad}")
+    print(f"PASS: {k} scenario(s), zero invariant violations", file=sys.stderr)
+
+    entries = []
+    for cs, s in zip(sampled, rep["scenarios"]):
+        entries.append(
+            {
+                "family": s["family"],
+                "index": s["index"],
+                "run_id": s["run_id"],
+                "seed": s["seed"],
+                "key": cs.key,
+                "wire_hashes": s["wire_hashes"] if s["baseline_hashes"] else None,
+                "fused_hashes": s["fused_hashes"] if s["baseline_hashes"] else None,
+                "adaptive_decisions": (
+                    s["adaptive"]["decisions"] if "adaptive" in s else None
+                ),
+            }
+        )
+
+    if write_baseline:
+        os.makedirs(os.path.dirname(BASELINE_PATH), exist_ok=True)
+        with open(BASELINE_PATH, "w") as f:
+            json.dump(
+                {
+                    "campaign_seed": seed,
+                    "check_scenarios": k,
+                    "scenarios": entries,
+                },
+                f,
+                indent=1,
+            )
+            f.write("\n")
+        print(f"baseline written to {BASELINE_PATH}", file=sys.stderr)
+        return 0
+
+    try:
+        with open(BASELINE_PATH) as f:
+            baseline = json.load(f)
+    except OSError as e:
+        return _fail(
+            f"no committed baseline at {BASELINE_PATH} ({e}); run with "
+            "--write-baseline to create one"
+        )
+    if baseline.get("campaign_seed") != seed or baseline.get("check_scenarios") != k:
+        return _fail(
+            f"baseline shape (seed={baseline.get('campaign_seed')}, "
+            f"k={baseline.get('check_scenarios')}) != configured "
+            f"(seed={seed}, k={k}) — regenerate with --write-baseline"
+        )
+    committed = baseline.get("scenarios", [])
+    if len(committed) != len(entries):
+        return _fail(
+            f"baseline holds {len(committed)} scenario(s), replay produced "
+            f"{len(entries)}"
+        )
+    for want, got in zip(committed, entries):
+        where = f"{got['family']}[{got['index']}]"
+        if want["key"] != got["key"]:
+            return _fail(
+                f"{where}: sampler drift — key\n  committed {want['key']}\n"
+                f"  replayed  {got['key']}"
+            )
+        for side in ("wire_hashes", "fused_hashes"):
+            if want.get(side) != got.get(side):
+                return _fail(
+                    f"{where}: {side} diverged from committed baseline\n"
+                    f"  committed {want.get(side)}\n  replayed  {got.get(side)}"
+                )
+        if want.get("adaptive_decisions") != got.get("adaptive_decisions"):
+            return _fail(
+                f"{where}: adaptive decision stream diverged\n"
+                f"  committed {want.get('adaptive_decisions')}\n"
+                f"  replayed  {got.get('adaptive_decisions')}"
+            )
+    print(
+        "PASS: committed baseline replayed bit-identically "
+        f"({sum(1 for e in entries if e['wire_hashes'])} hash sets, "
+        f"{sum(1 for e in entries if e['adaptive_decisions'])} adaptive "
+        "stream(s))",
+        file=sys.stderr,
+    )
+    print(
+        f"campaign-check PASSED in {time.monotonic() - t0:.1f}s",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

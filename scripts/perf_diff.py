@@ -30,6 +30,13 @@ What gets compared (dotted paths; ``*`` fans out over dict keys):
 * count-like health signals — ``perf.compile.recompiles_total.*`` regresses
   only when the candidate exceeds the baseline by more than
   ``--count-slack`` (default 0: ANY new recompiles fail);
+* campaign artifacts (``bench.py --campaign``) — the per-family arms under
+  ``extra.families.*`` diff per scenario FAMILY: ``seconds`` as a
+  lower-is-better timing per family, ``violations`` as a per-family count
+  (any newly-violated family fails — aggregate summing would let one
+  family's fix mask another's break), and the top-level
+  ``campaign_scenarios_ok`` value gated HIGHER-is-better (fewer passing
+  scenarios than the baseline is a regression even if nothing got slower);
 * device-observatory fields under ``perf.devobs.*`` (present when the run
   had ``DEVOBS_ENABLED``) — ``device_peak_bytes``, ``compile_seconds``,
   ``scan_flops`` / ``scan_bytes`` — gated lower-is-better with the same
@@ -74,8 +81,14 @@ DEFAULT_TIMING_KEYS = (
     "perf.devobs.compile_seconds",
     "perf.devobs.scan_flops",
     "perf.devobs.scan_bytes",
+    # Campaign artifacts: per-scenario-family wall time (absent on
+    # non-campaign benches — the fan-out just resolves to nothing).
+    "extra.families.*.seconds",
 )
 DEFAULT_COUNT_KEYS = ("perf.compile.recompiles_total.*",)
+#: Campaign per-family violation counts, compared PER LABEL (a newly
+#: violated family must fail even when another family's count dropped).
+FAMILY_COUNT_KEYS = ("extra.families.*.violations",)
 
 #: ``value`` is compared only when the arm's unit says lower-is-better time.
 _TIMEY_UNITS = ("s/round", "seconds", "s", "ms", "us/counter_increment")
@@ -220,6 +233,49 @@ def compare(
             )
             if regressed:
                 regressions.append(flat)
+
+    for key in FAMILY_COUNT_KEYS:
+        parts = key.split(".")
+        base_vals = dict(_get_path(base, parts))
+        cand_vals = dict(_get_path(cand, parts))
+        for flat, cv in sorted(cand_vals.items()):
+            cs = _stats(cv)
+            if cs is None:
+                continue
+            bs = _stats(base_vals.get(flat, 0))
+            bcount = bs[0] if bs else 0.0
+            regressed = cs[0] > bcount + count_slack
+            rows.append(
+                {
+                    "key": flat,
+                    "kind": "family-count",
+                    "baseline": bcount,
+                    "candidate": cs[0],
+                    "allowed_slack": count_slack,
+                    "regressed": regressed,
+                }
+            )
+            if regressed:
+                regressions.append(flat)
+
+    if base.get("metric") == cand.get("metric") == "campaign_scenarios_ok":
+        bs = _stats(base.get("value"))
+        cs = _stats(cand.get("value"))
+        if bs is not None and cs is not None:
+            # Higher is better: the campaign passing FEWER scenarios than
+            # its baseline is a robustness regression regardless of speed.
+            regressed = cs[0] < bs[0]
+            rows.append(
+                {
+                    "key": "value",
+                    "kind": "campaign-ok",
+                    "baseline": bs[0],
+                    "candidate": cs[0],
+                    "regressed": regressed,
+                }
+            )
+            if regressed:
+                regressions.append("value(campaign_scenarios_ok)")
 
     return {
         "compared": len(rows),

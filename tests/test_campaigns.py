@@ -1,0 +1,669 @@
+"""Campaign universe: seeded scenario matrix, adaptive-adversary ladder,
+invariant grading, campaign-scoped telemetry, perf_diff campaign arms.
+
+Everything seeded here is a PURE function of its integers — the assertions
+are exact regression pins (same discipline as tests/test_population.py),
+not tolerance tests. The slow end-to-end replays live at the bottom; the
+fast subset exercises the sampler/oracle/grader layers on synthetic data.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.campaigns import (
+    AXES,
+    CAMPAIGN_SCOPED_FAMILIES,
+    FAMILIES,
+    FAMILY_INVARIANTS,
+    build_scenario,
+    campaign_id,
+    grade_scenario,
+    sample_campaign,
+)
+from p2pfl_tpu.campaigns.invariants import ACCURACY_FLOORS, AGG_WAIT_BOUNDS
+from p2pfl_tpu.chaos.plane import (
+    ADAPTIVE_LADDER,
+    ADAPTIVE_REJECTED_STAGES,
+    AdaptiveAdversary,
+    ChaosPlane,
+    adaptive_attack_schedule,
+)
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.population.scenarios import PopulationScenario
+from p2pfl_tpu.telemetry import REGISTRY
+
+# Register the campaign-scoped metric families these tests read/write
+# (counters live in the modules that instrument them).
+import p2pfl_tpu.comm.admission  # noqa: F401,E402 — p2pfl_updates_rejected_total
+import p2pfl_tpu.learning.aggregators.base  # noqa: F401,E402 — agg wait histogram
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "campaign_fixtures")
+
+SEED = 20260806
+
+
+def _clear_scoped():
+    REGISTRY.clear_families(CAMPAIGN_SCOPED_FAMILIES)
+
+
+# --- sampler ------------------------------------------------------------------
+
+
+def test_sampler_deterministic_distinct_and_prefix_stable():
+    full = sample_campaign(SEED, 20)
+    again = sample_campaign(SEED, 20)
+    assert [c.key for c in full] == [c.key for c in again]
+    # Distinctness is an acceptance property (sample_campaign raises on a
+    # collision; pin it positively too).
+    assert len({c.key for c in full}) == 20
+    # Round-robin prefix property: the first k of ANY campaign size are
+    # identical — campaign-check replays a true prefix of the full bench.
+    for k in (1, 4, 9):
+        assert [c.key for c in sample_campaign(SEED, k)] == [
+            c.key for c in full[:k]
+        ]
+    # A different campaign seed is a different campaign.
+    assert [c.key for c in sample_campaign(SEED + 1, 20)] != [
+        c.key for c in full
+    ]
+
+
+def test_sampler_covers_every_family_and_leads_with_adaptive():
+    full = sample_campaign(SEED, 20)
+    counts = {}
+    for c in full:
+        counts[c.family] = counts.get(c.family, 0) + 1
+    assert set(counts) == set(FAMILIES)
+    assert all(v >= 2 for v in counts.values())
+    # The headline family is always in the gate prefix.
+    assert full[0].family == "adaptive"
+    assert full[0].scenario.adaptive_adversary is not None
+
+
+def test_build_scenario_is_pure_and_family_axes_hold():
+    for family in FAMILIES:
+        a, b = build_scenario(SEED, family, 0), build_scenario(SEED, family, 0)
+        assert a.key == b.key
+        assert a.scenario == b.scenario
+        assert FAMILY_INVARIANTS[family]  # every family has a catalog
+        assert family in ACCURACY_FLOORS
+    adaptive = build_scenario(SEED, "adaptive", 0).scenario
+    assert adaptive.adaptive_patience in AXES["adaptive_patience"]
+    assert adaptive.rounds == 2 * adaptive.adaptive_patience + 1
+    recovery = build_scenario(SEED, "recovery", 0)
+    assert recovery.trace is not None and recovery.trace["rounds"] >= 6
+    privacy = build_scenario(SEED, "privacy", 0).scenario
+    assert privacy.privacy
+    byz = build_scenario(SEED, "byzantine", 0).scenario
+    assert byz.byzantine  # seeded draw materialized adversaries
+    assert all(a == "signflip" for a in byz.byzantine.values())
+
+
+def test_churn_family_rerolls_to_feasible_committees():
+    """The churn builder rejection-samples deterministically: every sampled
+    churn scenario's committee schedule derives without starving a round
+    (the fused scan's static-shape requirement — the 20-scenario campaign
+    originally surfaced an infeasible draw at churn[1])."""
+    for index in range(4):
+        cs = build_scenario(SEED, "churn", index)
+        sched = cs.scenario.schedule(0)  # raises if any round starves
+        assert sched.shape[0] == cs.scenario.rounds
+        assert cs.scenario.churn_rate in AXES["churn_rate"]
+        # Purity: the reroll chain replays identically.
+        assert build_scenario(SEED, "churn", index).key == cs.key
+
+
+def test_campaign_id_shape():
+    assert campaign_id(7, 20) == "campaign-s7-n20"
+
+
+# --- adaptive ladder oracle ---------------------------------------------------
+
+
+def test_adaptive_attack_schedule_closed_form():
+    assert adaptive_attack_schedule(3, patience=1) == (
+        "signflip", "scaled", "norm_ride",
+    )
+    assert adaptive_attack_schedule(5, patience=2) == (
+        "signflip", "signflip", "scaled", "scaled", "norm_ride",
+    )
+    # The terminal stage is absorbing: nothing past norm_ride.
+    assert adaptive_attack_schedule(9, patience=1)[2:] == ("norm_ride",) * 7
+    assert adaptive_attack_schedule(0) == ()
+    with pytest.raises(ValueError):
+        adaptive_attack_schedule(3, patience=0)
+    with pytest.raises(ValueError):
+        adaptive_attack_schedule(3, ladder=())
+    assert set(ADAPTIVE_REJECTED_STAGES) < set(ADAPTIVE_LADDER)
+    assert ADAPTIVE_LADDER[-1] not in ADAPTIVE_REJECTED_STAGES
+
+
+def test_adaptive_adversary_live_ladder_matches_oracle():
+    """The live observer, fed one attributed rejection per rejected-stage
+    round (the campaign guarantee), realizes exactly the pure schedule —
+    and reports each escalation as an adaptive_switch chaos fault."""
+    _clear_scoped()
+    adv_addr = "unit-adv"
+    rejected = REGISTRY.get("p2pfl_updates_rejected_total")
+    faults = REGISTRY.get("p2pfl_chaos_faults_total")
+    assert rejected is not None and faults is not None
+    try:
+        adv = AdaptiveAdversary(adv_addr, patience=2)
+        realized = []
+        for rnd in range(7):
+            attack = adv.attack_for_round(rnd)
+            realized.append(attack)
+            if attack in ADAPTIVE_REJECTED_STAGES:
+                # An honest receiver rejects and attributes the frame.
+                rejected.labels("honest-0", "norm", adv_addr).inc()
+        oracle = adaptive_attack_schedule(7, patience=2)
+        assert tuple(realized) == oracle
+        assert [d["attack"] for d in adv.decisions] == list(oracle)
+        switches = sum(
+            int(child.value)
+            for labels, child in faults.samples()
+            if labels.get("node") == adv_addr
+            and labels.get("fault") == "adaptive_switch"
+        )
+        assert switches == sum(1 for a, b in zip(oracle, oracle[1:]) if a != b)
+    finally:
+        _clear_scoped()
+
+
+def test_adaptive_adversary_without_rejections_never_escalates():
+    """No attributed rejections -> no hits -> the ladder stays on stage 0
+    (the adversary only learns from what its peers actually did)."""
+    _clear_scoped()
+    try:
+        adv = AdaptiveAdversary("unit-adv-quiet", patience=1)
+        assert [adv.attack_for_round(r) for r in range(4)] == ["signflip"] * 4
+    finally:
+        _clear_scoped()
+
+
+def test_adaptive_scenario_validation():
+    base = dict(seed=1, n_nodes=6, rounds=3, samples_per_node=32, batch_size=16)
+    PopulationScenario(**base, adaptive_adversary=3)  # valid
+    with pytest.raises(ValueError, match="observer"):
+        PopulationScenario(**base, adaptive_adversary=0)
+    with pytest.raises(ValueError, match="n_nodes >= 6"):
+        PopulationScenario(
+            seed=1, n_nodes=4, rounds=3, samples_per_node=32,
+            batch_size=16, adaptive_adversary=1,
+        )
+    with pytest.raises(ValueError, match="full stable committees"):
+        PopulationScenario(**base, adaptive_adversary=3, cohort_fraction=0.5)
+    with pytest.raises(ValueError, match="lossless"):
+        PopulationScenario(**base, adaptive_adversary=3, drop_rate=0.1)
+    with pytest.raises(ValueError, match="byzantine"):
+        PopulationScenario(
+            **base, adaptive_adversary=3, byzantine_fraction=0.25
+        )
+    with pytest.raises(ValueError, match="privacy"):
+        PopulationScenario(**base, adaptive_adversary=3, privacy=True)
+
+
+# --- invariant grading (synthetic runs) ---------------------------------------
+
+
+def _synthetic_run(cs, *, diverge_fused=False, drop_fused_round=False,
+                   privacy_events=True):
+    """Minimal wire/fused dicts shaped like the scenario runners' output."""
+    scn = cs.scenario
+    stitched = []
+    wire_hashes, fused_hashes = {}, {}
+    for r in range(scn.rounds):
+        stitched.append({"kind": "round_open", "round": r})
+        h = f"hash-{cs.family}-{r}"
+        stitched.append({"kind": "aggregate_committed", "round": r, "hash": h})
+        if scn.privacy and privacy_events:
+            stitched.append({"kind": "privacy_masked", "round": r})
+        wire_hashes[r] = h
+        fused_hashes[r] = (h + "-fused") if diverge_fused else h
+    if drop_fused_round:
+        fused_hashes.pop(scn.rounds - 1)
+    wire = {"stitched": stitched}
+    fused = {"hashes": fused_hashes}
+    report = {"status": "DIVERGED" if diverge_fused else "OK"}
+    return wire, fused, report
+
+
+def test_grade_clean_baseline_scenario_passes():
+    _clear_scoped()
+    cs = build_scenario(SEED, "baseline", 0)
+    wire, fused, report = _synthetic_run(cs)
+    assert grade_scenario(cs, wire, fused, report) == []
+
+
+def test_grade_flags_parity_and_missing_rounds():
+    _clear_scoped()
+    cs = build_scenario(SEED, "baseline", 0)
+    wire, fused, report = _synthetic_run(cs, diverge_fused=True)
+    names = {v.invariant for v in grade_scenario(cs, wire, fused, report)}
+    assert "parity_exact" in names
+
+    wire, fused, report = _synthetic_run(cs, drop_fused_round=True)
+    vs = grade_scenario(cs, wire, fused, report)
+    names = {v.invariant for v in vs}
+    assert "rounds_complete" in names and "parity_exact" in names
+    assert all(v.family == "baseline" and v.render() for v in vs)
+
+
+def test_grade_privacy_family_is_structural():
+    """Privacy grades on masked DIVERGENCE (the negative control), not bit
+    parity: equal hashes mean masking never engaged."""
+    _clear_scoped()
+    cs = build_scenario(SEED, "privacy", 0)
+    assert "parity_exact" not in FAMILY_INVARIANTS["privacy"]
+    wire, fused, report = _synthetic_run(cs, diverge_fused=True)
+    assert grade_scenario(cs, wire, fused, report) == []
+    # Hashes equal -> masking did not engage -> violation.
+    wire, fused, report = _synthetic_run(cs)
+    names = {v.invariant for v in grade_scenario(cs, wire, fused, report)}
+    assert "masked_divergence" in names
+    # No privacy_masked events -> violation.
+    wire, fused, report = _synthetic_run(
+        cs, diverge_fused=True, privacy_events=False
+    )
+    names = {v.invariant for v in grade_scenario(cs, wire, fused, report)}
+    assert names == {"privacy_engaged"}
+
+
+def test_grade_adaptive_oracle_and_attribution():
+    _clear_scoped()
+    cs = build_scenario(SEED, "adaptive", 0)
+    scn = cs.scenario
+    adv_addr = scn.node_names[scn.adaptive_adversary]
+    oracle = list(scn.adaptive_schedule())
+    rejected = REGISTRY.get("p2pfl_updates_rejected_total")
+    faults = REGISTRY.get("p2pfl_chaos_faults_total")
+    try:
+        wire, fused, report = _synthetic_run(cs)
+        wire["adaptive"] = {
+            "decisions": [
+                {"round": r, "attack": a, "rejections": r}
+                for r, a in enumerate(oracle)
+            ]
+        }
+        # Campaign-true telemetry: honest rejections attribute to the
+        # adversary, one adaptive_switch per oracle transition.
+        rejected.labels(scn.node_names[0], "norm", adv_addr).inc(3)
+        for _ in range(sum(1 for a, b in zip(oracle, oracle[1:]) if a != b)):
+            faults.labels(adv_addr, "adaptive_switch").inc()
+        assert grade_scenario(cs, wire, fused, report) == []
+
+        # A realized stream that disagrees with the oracle is caught.
+        wire["adaptive"]["decisions"][-1]["attack"] = "signflip"
+        names = {v.invariant for v in grade_scenario(cs, wire, fused, report)}
+        assert "adaptive_oracle" in names
+        wire["adaptive"]["decisions"][-1]["attack"] = oracle[-1]
+
+        # Rejections attributed to a bystander are a stray-attribution
+        # violation (the observatory must point at the REAL adversary).
+        bystander = next(
+            n for n in scn.node_names[1:] if n != adv_addr
+        )
+        rejected.labels(scn.node_names[0], "norm", bystander).inc()
+        names = {v.invariant for v in grade_scenario(cs, wire, fused, report)}
+        assert "rejection_attribution" in names
+    finally:
+        _clear_scoped()
+
+
+def test_grade_recovery_trace_determinism():
+    _clear_scoped()
+    cs = build_scenario(SEED, "recovery", 0)
+    wire, fused, report = _synthetic_run(cs)
+    assert grade_scenario(cs, wire, fused, report) == []
+    # A recovery scenario stripped of its trace is degenerate.
+    broken = type(cs)(
+        family=cs.family, index=cs.index, scenario=cs.scenario, trace=None
+    )
+    names = {v.invariant for v in grade_scenario(broken, wire, fused, report)}
+    assert "trace_deterministic" in names
+
+
+def test_agg_wait_bound_per_family():
+    """The lossy-wire family gets the loose bound; the clean ones don't."""
+    _clear_scoped()
+    assert AGG_WAIT_BOUNDS["chaos_drop"] > 30.0
+    hist = REGISTRY.get("p2pfl_aggregation_wait_seconds")
+    assert hist is not None
+    try:
+        hist.labels("wait-unit").observe(45.0)  # gossip re-ship territory
+        cs_drop = build_scenario(SEED, "chaos_drop", 0)
+        wire, fused, report = _synthetic_run(cs_drop)
+        assert grade_scenario(cs_drop, wire, fused, report) == []
+        cs_base = build_scenario(SEED, "baseline", 0)
+        wire, fused, report = _synthetic_run(cs_base)
+        names = {
+            v.invariant for v in grade_scenario(cs_base, wire, fused, report)
+        }
+        assert "agg_wait_bounded" in names
+    finally:
+        _clear_scoped()
+
+
+# --- campaign-scoped telemetry reset (satellite) ------------------------------
+
+
+def test_campaign_scoped_registry_reset_is_selective():
+    """clear_families zeroes exactly the campaign-scoped families and
+    leaves process-lifetime series (and the family registrations
+    themselves) untouched."""
+    rejected = REGISTRY.get("p2pfl_updates_rejected_total")
+    scenarios_total = REGISTRY.counter(
+        "p2pfl_campaign_scenarios_total",
+        "Campaign scenarios executed, by family and grading verdict",
+        labels=("family", "verdict"),
+    )
+    rejected.labels("scope-unit", "norm", "scope-adv").inc(5)
+    scenarios_total.labels("scope-family", "ok").inc()
+    before = sum(
+        int(c.value)
+        for labels, c in scenarios_total.samples()
+        if labels.get("family") == "scope-family"
+    )
+    REGISTRY.clear_families(CAMPAIGN_SCOPED_FAMILIES)
+    assert all(
+        int(c.value) == 0
+        for labels, c in rejected.samples()
+        if labels.get("node") == "scope-unit"
+    )
+    # Process-lifetime family survived the scoped reset.
+    after = sum(
+        int(c.value)
+        for labels, c in scenarios_total.samples()
+        if labels.get("family") == "scope-family"
+    )
+    assert after == before == 1
+    # Unknown names are tolerated (family may not have instrumented yet).
+    REGISTRY.clear_families(("p2pfl_not_a_family_total",))
+
+
+def test_run_campaign_captures_backend_errors_and_restores_scope(monkeypatch):
+    """A scenario whose backend run raises becomes a verdict=error entry —
+    the campaign completes the rest and the ledger campaign scope is
+    restored on the way out."""
+    from p2pfl_tpu.campaigns.engine import run_campaign
+    from p2pfl_tpu.population import scenarios as pop_scenarios
+    from p2pfl_tpu.telemetry.ledger import LEDGERS
+
+    def boom(scn, **kw):
+        raise RuntimeError("backend exploded")
+
+    monkeypatch.setattr(pop_scenarios, "run_scenario_wire", boom)
+    monkeypatch.setattr(pop_scenarios, "run_scenario_fused", boom)
+    rep = run_campaign(SEED, 2, differ=object())
+    assert rep["ok"] is False
+    assert rep["violations_total"] == 2
+    assert [s["verdict"] for s in rep["scenarios"]] == ["error", "error"]
+    assert all("backend exploded" in s["error"] for s in rep["scenarios"])
+    assert rep["families"]["adaptive"]["violations"] == 1
+    assert LEDGERS.campaign == ""  # scope restored after the run
+
+
+# --- composed chaos trace (satellite) -----------------------------------------
+
+
+def _compose_trace(seed: int, order: str = "cri"):
+    """One seeded lifecycle trace composing all three planners. ``order``
+    permutes the CALL order — each planner derives from its own dedicated
+    stream, so interleaving must not desync any of them."""
+    plane = ChaosPlane()
+    names = [f"trace/{i}" for i in range(6)]
+    joiners = [f"joiner/{i}" for i in range(2)]
+    parts = {}
+    calls = {
+        "c": lambda: parts.setdefault(
+            "churn",
+            plane.plan_churn(6, names[1:], joiners, seed=seed, start=1),
+        ),
+        "r": lambda: parts.setdefault(
+            "recovery",
+            plane.plan_recovery(
+                6, names, seed=seed, crash_round=1, restart_after=1,
+                partition_round=2, heal_after=2,
+            ),
+        ),
+        "i": lambda: parts.setdefault(
+            "masker",
+            plane.plan_masker_dropout(6, names, seed=seed, drop_round=1),
+        ),
+    }
+    for key in order:
+        calls[key]()
+    return parts["churn"], parts["recovery"], parts["masker"]
+
+
+def test_composed_trace_deterministic_counts_and_no_desync():
+    churn, recovery, masker = _compose_trace(41)
+    # Deterministic counts: 5 leavers + 2 joiners, crash/restart +
+    # partition/heal, one masker crash.
+    assert len(churn) == 7
+    assert sorted(e.kind for e in churn) == ["join"] * 2 + ["leave"] * 5
+    assert sorted(e.kind for e in recovery) == [
+        "crash", "heal", "partition", "restart",
+    ]
+    assert len(masker) == 1 and masker[0].kind == "crash"
+    # No desync: every call order yields the SAME three traces (dedicated
+    # per-planner streams — composing them can't perturb any one of them).
+    for order in ("cri", "cir", "rci", "ric", "icr", "irc"):
+        assert _compose_trace(41, order) == (churn, recovery, masker)
+    # And the whole composition replays; a different seed moves it.
+    assert _compose_trace(41) == (churn, recovery, masker)
+    assert _compose_trace(42) != (churn, recovery, masker)
+
+
+def test_composed_trace_replay_identical_across_thread_interleavings():
+    """Eight threads derive the same composed trace concurrently (each with
+    a different planner call order); every thread must observe the identical
+    trace — the planners are pure seeded functions with no shared state to
+    race on."""
+    reference = _compose_trace(1234)
+    orders = ("cri", "cir", "rci", "ric", "icr", "irc", "cri", "ric")
+    results = [None] * len(orders)
+    barrier = threading.Barrier(len(orders))
+
+    def worker(i: int, order: str) -> None:
+        barrier.wait()
+        results[i] = _compose_trace(1234, order)
+
+    threads = [
+        threading.Thread(target=worker, args=(i, o))
+        for i, o in enumerate(orders)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(r == reference for r in results)
+
+
+# --- perf_diff campaign arms (satellite) --------------------------------------
+
+
+def _perf_diff():
+    spec = importlib.util.spec_from_file_location(
+        "perf_diff_campaign", os.path.join(REPO, "scripts", "perf_diff.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _campaign_doc(ok=20, byz_violations=0, byz_seconds=12.0):
+    return {
+        "metric": "campaign_scenarios_ok",
+        "value": ok,
+        "unit": "scenarios",
+        "meta": {"schema_version": 1, "git_sha": "x", "backend": "cpu", "seed": 0},
+        "extra": {
+            "families": {
+                "byzantine": {
+                    "scenarios": 3, "ok": 3 - byz_violations,
+                    "violations": byz_violations, "seconds": byz_seconds,
+                },
+                "adaptive": {
+                    "scenarios": 3, "ok": 3, "violations": 0, "seconds": 40.0,
+                },
+            },
+        },
+    }
+
+
+def test_perf_diff_campaign_family_violations_regress(tmp_path):
+    pd = _perf_diff()
+    summary = pd.compare(_campaign_doc(), _campaign_doc(byz_violations=2))
+    assert "extra.families.byzantine.violations" in summary["regressions"]
+    kinds = {r["key"]: r["kind"] for r in summary["rows"]}
+    assert kinds["extra.families.byzantine.violations"] == "family-count"
+    # Exit code 1 end to end.
+    base, cand = tmp_path / "b.json", tmp_path / "c.json"
+    base.write_text(json.dumps(_campaign_doc()))
+    cand.write_text(json.dumps(_campaign_doc(byz_violations=2)))
+    assert pd.main([str(base), str(cand)]) == 1
+    # Identical docs pass.
+    cand.write_text(json.dumps(_campaign_doc()))
+    assert pd.main([str(base), str(cand)]) == 0
+
+
+def test_perf_diff_campaign_ok_is_higher_is_better():
+    pd = _perf_diff()
+    # FEWER passing scenarios: robustness regression regardless of speed.
+    summary = pd.compare(_campaign_doc(ok=20), _campaign_doc(ok=18))
+    assert "value(campaign_scenarios_ok)" in summary["regressions"]
+    # MORE passing scenarios is never a regression.
+    summary = pd.compare(_campaign_doc(ok=18), _campaign_doc(ok=20))
+    assert not summary["regressions"]
+
+
+def test_perf_diff_campaign_family_seconds_diffed_per_family():
+    pd = _perf_diff()
+    summary = pd.compare(_campaign_doc(), _campaign_doc(byz_seconds=60.0))
+    row = next(
+        r
+        for r in summary["rows"]
+        if r["key"] == "extra.families.byzantine.seconds"
+    )
+    assert row["regressed"]
+    # The other family's timing arm is diffed independently and is quiet.
+    adaptive = [
+        r
+        for r in summary["rows"]
+        if r["key"] == "extra.families.adaptive.seconds"
+    ]
+    assert adaptive and not adaptive[0]["regressed"]
+
+
+# --- committed baseline fixture -----------------------------------------------
+
+
+def test_campaign_baseline_fixture_matches_sampler_and_oracle():
+    """The committed campaign-check baseline must stay derivable from the
+    configured campaign integers: keys re-derive via the sampler, the
+    adaptive entry's decision stream equals the pure oracle."""
+    path = os.path.join(FIXTURES, "campaign_baseline.json")
+    with open(path) as f:
+        baseline = json.load(f)
+    assert baseline["campaign_seed"] == Settings.CAMPAIGN_SEED
+    assert baseline["check_scenarios"] == Settings.CAMPAIGN_CHECK_SCENARIOS
+    sampled = sample_campaign(
+        baseline["campaign_seed"], baseline["check_scenarios"]
+    )
+    entries = baseline["scenarios"]
+    assert [e["key"] for e in entries] == [c.key for c in sampled]
+    assert [e["family"] for e in entries] == [c.family for c in sampled]
+    adaptive = [e for e in entries if e["family"] == "adaptive"]
+    assert adaptive, "the gate prefix must include the headline family"
+    for entry, cs in zip(entries, sampled):
+        if entry["family"] != "adaptive":
+            continue
+        oracle = list(cs.scenario.adaptive_schedule())
+        assert [d["attack"] for d in entry["adaptive_decisions"]] == oracle
+        # Rejections grow monotonically — the ladder's observed signal.
+        rej = [d["rejections"] for d in entry["adaptive_decisions"]]
+        assert rej == sorted(rej)
+        # Committed hashes cover every round on both backends.
+        rounds = [str(r) for r in range(cs.scenario.rounds)]
+        assert sorted(entry["wire_hashes"]) == sorted(rounds)
+        assert entry["wire_hashes"] == entry["fused_hashes"]
+
+
+def test_regression_fixture_shape():
+    path = os.path.join(FIXTURES, "regression_adaptive_self_screen.json")
+    with open(path) as f:
+        fix = json.load(f)
+    scn = PopulationScenario(**fix["scenario"])
+    assert list(scn.adaptive_schedule()) == fix["expected_decisions"]
+    assert scn.adaptive_adversary != 0  # index 0 is the observer
+
+
+# --- permissive admission (the regression's unit surface) ---------------------
+
+
+def test_permissive_admission_admits_what_the_norm_screen_rejects():
+    """The adaptive adversary's own admission is permissive: a frame the
+    bootstrap norm bound would reject sails through (an attacker does not
+    defend itself — without this the adversary rejected the entire
+    federation against its own poisoned model and diverged)."""
+    from p2pfl_tpu.comm.admission import AdmissionController
+
+    class _Local:
+        def get_parameters(self):
+            return [np.ones((4, 4), np.float32)]
+
+    huge = [np.full((4, 4), 1e6, np.float32)]
+    _clear_scoped()
+    try:
+        ctl = AdmissionController("perm-unit")
+        assert ctl.screen(huge, _Local(), source="adv", cmd="unit") == "norm"
+        ctl.permissive = True
+        assert ctl.screen(huge, _Local(), source="adv", cmd="unit") is None
+    finally:
+        _clear_scoped()
+
+
+# --- end-to-end regression replay (slow) --------------------------------------
+
+
+@pytest.mark.slow
+def test_regression_adaptive_self_screen_replay():
+    """Full both-backend replay of the scenario that surfaced the
+    adversary-self-screening divergence: parity must be OK with
+    bit-identical hashes and the realized ladder must equal the oracle."""
+    from p2pfl_tpu.campaigns.engine import load_parity_differ
+    from p2pfl_tpu.campaigns.matrix import CampaignScenario
+    from p2pfl_tpu.population.scenarios import (
+        run_scenario_fused,
+        run_scenario_wire,
+    )
+
+    path = os.path.join(FIXTURES, "regression_adaptive_self_screen.json")
+    with open(path) as f:
+        fix = json.load(f)
+    scn = PopulationScenario(**fix["scenario"])
+    cs = CampaignScenario(family="adaptive", index=0, scenario=scn)
+    _clear_scoped()
+    try:
+        wire = run_scenario_wire(scn)
+        fused = run_scenario_fused(scn)
+        report = load_parity_differ().compare_ledgers(
+            wire["stitched"], fused["events"]
+        )
+        assert report["status"] == "OK", report.get("first_divergence")
+        assert [d["attack"] for d in wire["adaptive"]["decisions"]] == (
+            fix["expected_decisions"]
+        )
+        violations = grade_scenario(cs, wire, fused, report)
+        assert violations == [], [v.render() for v in violations]
+    finally:
+        _clear_scoped()

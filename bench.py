@@ -4561,6 +4561,183 @@ def run_campaign_bench() -> None:
     _emit(out, seed=locals().get("seed"), backend="cpu")
 
 
+def run_soak_bench() -> None:
+    """Subprocess-style mode ``--soak``: supervisor overhead + healing
+    acceptance (CPU venue — a robustness bench).
+
+    **Overhead arm** (the ``--population`` 100k north-star shape): the same
+    seeded engine schedule runs unsupervised (control) and under the
+    :class:`~p2pfl_tpu.population.supervisor.EngineSupervisor` with
+    per-cadence journaling, both timed AFTER a warmup chunk paid compile —
+    the supervised/unsupervised wall ratio must stay ≤ 1.05× (journaling
+    is write-ahead + async orbax; the scan loop must not feel it).
+
+    **Healing arm** (64 vnodes): a seeded ``plan_host_faults`` trace
+    (kill + OOM + SIGTERM) injected mid-schedule; the supervisor must heal
+    every fault and land on a final canonical params hash bit-identical to
+    a fault-free control.
+
+    Stamps ``perf.supervisor`` (journal seconds/chunk, restarts, degrade
+    steps, overhead ratio) — ``scripts/perf_diff.py`` gates those keys and
+    REFUSES (exit 3) when exactly one side of a diff ran supervised.
+    """
+    out: dict = {}
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"  # robustness/protocol bench: CPU venue
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from p2pfl_tpu.chaos.plane import ChaosPlane
+        from p2pfl_tpu.config import Settings
+        from p2pfl_tpu.management.checkpoint import FLCheckpointer
+        from p2pfl_tpu.management.profiler import perf_section
+        from p2pfl_tpu.population import EngineSupervisor, PopulationEngine
+        from p2pfl_tpu.telemetry import REGISTRY
+        from p2pfl_tpu.telemetry.ledger import canonical_params_hash
+
+        seed = 42
+        n = int(Settings.POP_BENCH_NODES)
+        fraction = float(Settings.POP_BENCH_COHORT)
+        warm_rounds, timed_rounds, chunk = 2, 12, 2
+        art = os.path.join(REPO, "artifacts")
+        os.makedirs(art, exist_ok=True)
+
+        def factory(**kw):
+            args = dict(num_nodes=n, cohort_fraction=fraction, seed=seed)
+            args.update(kw)
+            return PopulationEngine(**args)
+
+        # --- arm A: supervision overhead at the 100k shape --------------------
+        _phase(
+            f"soak overhead arm: n={n}, cohort {fraction:g}, "
+            f"{timed_rounds} timed rounds (chunk={chunk})"
+        )
+        with factory() as ctrl:
+            ctrl.run(warm_rounds)  # compile paid outside the timed window
+            t0 = time.monotonic()
+            for _ in range(timed_rounds // chunk):
+                ctrl.run(chunk)
+            control_s = time.monotonic() - t0
+        with tempfile.TemporaryDirectory(prefix="soak_bench_") as ckdir:
+            with FLCheckpointer(ckdir, max_to_keep=2) as ck:
+                with EngineSupervisor(
+                    factory, ck, node="soak-bench", journal_every=2,
+                ) as sup:
+                    sup.run(warm_rounds, chunk=chunk)  # compile + orbax setup
+                    t0 = time.monotonic()
+                    rep = sup.run(timed_rounds, chunk=chunk)
+                    supervised_s = time.monotonic() - t0
+        overhead_ratio = supervised_s / control_s
+        journal_s_per_chunk = rep.journal_s / max(1, rep.chunks)
+        _phase(
+            f"  control {control_s:.2f}s, supervised {supervised_s:.2f}s "
+            f"({rep.journals} journal(s), {rep.journal_s:.2f}s) -> "
+            f"ratio {overhead_ratio:.3f}x"
+        )
+        if rep.parked or rep.total_restarts:
+            raise AssertionError(
+                f"overhead arm was not clean: parked={rep.parked} "
+                f"restarts={rep.restarts}"
+            )
+        if overhead_ratio > 1.05:
+            raise AssertionError(
+                f"supervisor overhead {overhead_ratio:.3f}x > 1.05x budget "
+                f"(journals cost {rep.journal_s:.2f}s of {supervised_s:.2f}s)"
+            )
+
+        # --- arm B: heal kill/OOM/SIGTERM to bit-identity ---------------------
+        n_soak, chunks_soak = 64, 5
+        faults = ChaosPlane().plan_host_faults(
+            chunks_soak, seed=seed, kinds=("kill", "oom", "sigterm")
+        )
+        _phase(
+            f"soak healing arm: n={n_soak}, faults "
+            f"{[(ev.when, ev.kind) for ev in faults]}"
+        )
+
+        def soak_factory(**kw):
+            args = dict(
+                num_nodes=n_soak, cohort_fraction=0.25, cohort_min=4,
+                samples_per_node=8, feature_dim=8, hidden=(8,), batch_size=4,
+                seed=seed,
+            )
+            args.update(kw)
+            return PopulationEngine(**args)
+
+        with soak_factory() as clean:
+            clean.run(chunks_soak)
+            clean_hash = canonical_params_hash(clean.gather_params(0))
+        with tempfile.TemporaryDirectory(prefix="soak_bench_heal_") as ckdir:
+            with FLCheckpointer(ckdir, max_to_keep=2) as ck:
+                with EngineSupervisor(
+                    soak_factory, ck, node="soak-bench-heal", faults=faults,
+                    backoff_s=0.0,
+                ) as healer:
+                    heal_rep = healer.run(chunks_soak, chunk=1)
+                    healed_hash = (
+                        None if heal_rep.parked
+                        else canonical_params_hash(healer.engine.gather_params(0))
+                    )
+        if heal_rep.parked or heal_rep.completed != chunks_soak:
+            raise AssertionError(
+                f"healing arm parked={heal_rep.parked} completed="
+                f"{heal_rep.completed}/{chunks_soak}"
+            )
+        if healed_hash != clean_hash:
+            raise AssertionError(
+                f"healed hash {healed_hash} != fault-free control {clean_hash}"
+            )
+        _phase(
+            f"  healed {len(heal_rep.faults_executed)} fault(s), "
+            f"{heal_rep.total_restarts} restart(s), hash bit-identical"
+        )
+
+        out = {
+            "metric": "soak_overhead_ratio",
+            "value": round(overhead_ratio, 4),
+            "unit": f"x vs unsupervised at n={n}",
+            "vs_baseline": None,
+            "extra": {
+                "nodes": n,
+                "timed_rounds": timed_rounds,
+                "chunk": chunk,
+                "control_wall_s": round(control_s, 3),
+                "supervised_wall_s": round(supervised_s, 3),
+                "healing": {
+                    "nodes": n_soak,
+                    "chunks": chunks_soak,
+                    "faults": [[ev.when, ev.kind] for ev in faults],
+                    "restarts": dict(heal_rep.restarts),
+                    "events": list(heal_rep.events),
+                    "params_hash_match": True,
+                },
+            },
+        }
+        out["perf"] = perf_section(
+            REGISTRY,
+            extra={
+                "supervisor": {
+                    "journal_s_per_chunk": round(journal_s_per_chunk, 4),
+                    "journals": int(rep.journals),
+                    "overhead_ratio": round(overhead_ratio, 4),
+                    "restarts": int(heal_rep.total_restarts),
+                    "degrade_steps": len(heal_rep.degrade_steps),
+                }
+            },
+        )
+        out["meta"] = _bench_meta(seed=seed, backend="cpu")
+        with open(os.path.join(art, "SOAK_BENCH.json"), "w") as f:
+            json.dump(out, f, indent=1)
+        _phase(
+            f"soak bench done: overhead {overhead_ratio:.3f}x <= 1.05x, "
+            f"{heal_rep.total_restarts} fault(s) healed to bit-identity"
+        )
+    except Exception as e:  # noqa: BLE001
+        traceback.print_exc(file=sys.stderr)
+        out["error"] = f"{type(e).__name__}: {e}"
+    _emit(out, seed=locals().get("seed"), backend="cpu")
+
+
 def run_asyncpop_bench() -> None:
     """Subprocess-style mode ``--asyncpop``: async-window population
     acceptance run, four arms, all on the CPU venue (protocol/scale bench).
@@ -6034,6 +6211,8 @@ if __name__ == "__main__":
         run_population_bench()
     elif "--campaign" in sys.argv:
         run_campaign_bench()
+    elif "--soak" in sys.argv:
+        run_soak_bench()
     elif "--critical-path" in sys.argv:
         run_critical_path_bench()
     elif "--parity" in sys.argv:

@@ -44,6 +44,13 @@ CAMPAIGN_SCOPED_FAMILIES = (
     "p2pfl_updates_rejected_total",
     "p2pfl_claimed_samples_clamped_total",
     "p2pfl_aggregation_wait_seconds",
+    # host_fault grading drives a supervised engine through injected
+    # faults — zero its series too so each scenario grades only itself.
+    "p2pfl_supervisor_journals_total",
+    "p2pfl_supervisor_restarts_total",
+    "p2pfl_supervisor_retries_total",
+    "p2pfl_supervisor_degrade_steps_total",
+    "p2pfl_supervisor_parks_total",
 )
 
 _SCENARIOS = REGISTRY.counter(
@@ -58,8 +65,8 @@ _SCENARIOS = REGISTRY.counter(
 #: the deterministic contract (its invariants are structural instead).
 BASELINE_HASH_FAMILIES = frozenset(
     {
-        "adaptive", "baseline", "chaos_drop", "byzantine", "churn",
-        "tier_skew", "noniid", "recovery",
+        "adaptive", "baseline", "chaos_drop", "host_fault", "byzantine",
+        "churn", "tier_skew", "noniid", "recovery",
     }
 )
 

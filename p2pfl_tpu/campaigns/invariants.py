@@ -37,6 +37,12 @@ The invariants, and what each one catches:
   crash-restart + partition-heal + masker-dropout chaos trace re-derives
   identically and is non-trivial (the lifecycle axes stay seeded pure
   functions).
+* ``supervisor_recovered`` (host_fault family) — the seeded host-fault
+  trace re-derives identically, and an
+  :class:`~p2pfl_tpu.population.supervisor.EngineSupervisor` driving a
+  small fused engine THROUGH every planned kill/oom/sigterm completes
+  all rounds with a final model bit-identical to a fault-free control —
+  the journal + replay loop really is transparent to training.
 """
 
 from __future__ import annotations
@@ -71,6 +77,7 @@ AGG_WAIT_BOUNDS: Dict[str, float] = {
 ACCURACY_FLOORS: Dict[str, float] = {
     "baseline": 0.15,
     "chaos_drop": 0.15,
+    "host_fault": 0.15,
     "byzantine": 0.12,
     "churn": 0.15,
     "tier_skew": 0.15,
@@ -88,6 +95,10 @@ FAMILY_INVARIANTS: Dict[str, Tuple[str, ...]] = {
     "chaos_drop": (
         "rounds_complete", "agg_wait_bounded", "parity_exact",
         "accuracy_floor",
+    ),
+    "host_fault": (
+        "rounds_complete", "agg_wait_bounded", "parity_exact",
+        "accuracy_floor", "supervisor_recovered",
     ),
     "byzantine": (
         "rounds_complete", "agg_wait_bounded", "parity_exact",
@@ -238,6 +249,95 @@ def _grade_recovery_trace(cs: Any, add: Any) -> None:
         )
 
 
+def _grade_supervisor_recovered(cs: Any, add: Any) -> None:
+    """The seeded host-fault trace is replay-stable AND a supervised fused
+    run heals through every planned fault to a final model bit-identical
+    with a fault-free control (one restart per planned event)."""
+    import tempfile
+
+    from p2pfl_tpu.chaos.plane import ChaosPlane
+    from p2pfl_tpu.management.checkpoint import FLCheckpointer
+    from p2pfl_tpu.population.engine import PopulationEngine
+    from p2pfl_tpu.population.supervisor import EngineSupervisor
+    from p2pfl_tpu.telemetry.ledger import canonical_params_hash
+
+    scn, t = cs.scenario, cs.trace
+    if t is None:
+        add("supervisor_recovered", "host_fault scenario sampled without a trace")
+        return
+    rounds, kinds = int(t["rounds"]), tuple(t["kinds"])
+
+    def derive():
+        return ChaosPlane().plan_host_faults(rounds, seed=scn.seed, kinds=kinds)
+
+    faults, second = derive(), derive()
+    if faults != second:
+        add("supervisor_recovered", "host-fault trace is not replay-stable")
+        return
+    if len(faults) != len(kinds):
+        add(
+            "supervisor_recovered",
+            f"degenerate trace: {len(faults)} event(s) for kinds {kinds} "
+            f"over {rounds} rounds",
+        )
+        return
+
+    # The supervised arm runs a deliberately tiny fused engine: the graded
+    # property is heal-to-bit-identity, not model quality.
+    def factory(**kw: Any) -> PopulationEngine:
+        args: Dict[str, Any] = dict(
+            num_nodes=4, cohort_fraction=0.75, cohort_min=2, seed=scn.seed,
+            samples_per_node=8, feature_dim=8, hidden=(8,), batch_size=4,
+        )
+        args.update(kw)
+        return PopulationEngine(**args)
+
+    control = factory()
+    try:
+        control.run(rounds)
+        control_hash = canonical_params_hash(control.gather_params(0))
+    finally:
+        control.close()
+
+    with tempfile.TemporaryDirectory(prefix="campaign-hostfault-") as tmp:
+        with FLCheckpointer(tmp, max_to_keep=2) as ck:
+            with EngineSupervisor(
+                factory, ck, node=f"supervisor-{scn.run_id}",
+                faults=faults, backoff_s=0.0,
+            ) as sup:
+                report = sup.run(rounds, chunk=1)
+                supervised_hash = (
+                    canonical_params_hash(sup.engine.gather_params(0))
+                    if not report.parked else None
+                )
+
+    if report.parked:
+        add(
+            "supervisor_recovered",
+            f"supervisor parked ({report.park_reason}) instead of healing",
+        )
+        return
+    if report.completed != rounds:
+        add(
+            "supervisor_recovered",
+            f"supervised run completed {report.completed}/{rounds} rounds",
+        )
+    executed = {ev.kind for ev in report.faults_executed}
+    planned = {ev.kind for ev in faults}
+    missing = sorted(planned - executed)
+    if missing:
+        add(
+            "supervisor_recovered",
+            f"planned fault kind(s) never injected: {missing}",
+        )
+    if supervised_hash != control_hash:
+        add(
+            "supervisor_recovered",
+            "supervised final model diverged from fault-free control "
+            f"({supervised_hash} != {control_hash})",
+        )
+
+
 def grade_scenario(
     cs: Any,
     wire: Dict[str, Any],
@@ -346,5 +446,8 @@ def grade_scenario(
 
     if "trace_deterministic" in catalog:
         _grade_recovery_trace(cs, add)
+
+    if "supervisor_recovered" in catalog:
+        _grade_supervisor_recovered(cs, add)
 
     return violations

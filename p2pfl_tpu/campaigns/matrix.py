@@ -55,6 +55,11 @@ AXES: Dict[str, Tuple[Any, ...]] = {
     "speed_tiers": ((1.0, 2.0), (1.0, 1.5, 3.0)),
     "dirichlet_alpha": (0.1, 0.3, 1.0),
     "adaptive_patience": (1, 2),
+    "host_fault_kinds": (
+        ("kill", "oom", "sigterm"),
+        ("oom", "kill"),
+        ("sigterm", "oom"),
+    ),
 }
 
 #: Scenario families, in round-robin sampling order. A campaign of
@@ -64,6 +69,7 @@ FAMILIES: Tuple[str, ...] = (
     "adaptive",
     "baseline",
     "chaos_drop",
+    "host_fault",
     "byzantine",
     "churn",
     "tier_skew",
@@ -82,7 +88,7 @@ class CampaignScenario:
     family: str
     index: int
     scenario: PopulationScenario
-    trace: Optional[Dict[str, int]] = field(default=None)
+    trace: Optional[Dict[str, Any]] = field(default=None)
 
     @property
     def key(self) -> str:
@@ -128,7 +134,7 @@ def build_scenario(seed: int, family: str, index: int) -> CampaignScenario:
     base: Dict[str, Any] = dict(
         seed=sseed, n_nodes=4, rounds=2, samples_per_node=32, batch_size=16
     )
-    trace: Optional[Dict[str, int]] = None
+    trace: Optional[Dict[str, Any]] = None
     if family == "baseline":
         base["n_nodes"] = rng.choice((4, 5))
     elif family == "chaos_drop":
@@ -168,6 +174,16 @@ def build_scenario(seed: int, family: str, index: int) -> CampaignScenario:
         base["n_nodes"] = rng.choice((4, 6))
     elif family == "privacy":
         base["privacy"] = True
+    elif family == "host_fault":
+        # Clean both-backend run + a seeded host-fault trace (kill / oom /
+        # sigterm) graded by actually SUPERVISING a small fused run through
+        # every planned fault and asserting bit-identity with a fault-free
+        # control (invariants.py::_grade_supervisor_recovered). rounds stays
+        # >= len(kinds) + 1 so plan_host_faults has a slot per kind.
+        trace = {
+            "rounds": rng.choice((4, 5)),
+            "kinds": rng.choice(AXES["host_fault_kinds"]),
+        }
     elif family == "recovery":
         # Clean both-backend run + the composed crash-restart /
         # partition-heal / masker-dropout trace graded for deterministic

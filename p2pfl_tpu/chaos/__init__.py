@@ -5,17 +5,21 @@ send path (see :mod:`p2pfl_tpu.chaos.plane`)."""
 from p2pfl_tpu.chaos.plane import (  # noqa: F401
     BYZANTINE_ATTACKS,
     CHAOS,
+    HOST_FAULT_KINDS,
     ChaosPlane,
     ChurnEvent,
     Decision,
+    HostFaultEvent,
     RecoveryEvent,
 )
 
 __all__ = [
     "BYZANTINE_ATTACKS",
     "CHAOS",
+    "HOST_FAULT_KINDS",
     "ChaosPlane",
     "ChurnEvent",
     "Decision",
+    "HostFaultEvent",
     "RecoveryEvent",
 ]

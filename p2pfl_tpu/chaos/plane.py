@@ -205,6 +205,32 @@ class RecoveryEvent:
     groups: Tuple[Tuple[str, ...], ...] = ()
 
 
+#: Host-fault kinds the engine supervisor's injector can execute.
+HOST_FAULT_KINDS = ("kill", "oom", "sigterm", "slow")
+
+
+@dataclass(frozen=True)
+class HostFaultEvent:
+    """One scheduled host fault against a fused engine's chunk loop: at
+    chunk boundary ``when``,
+
+    * ``kill`` — the engine process "dies" (the supervisor closes and
+      rebuilds the engine, then resumes from the last journal),
+    * ``oom`` — the chunk launch raises an OOM ``RuntimeError`` AFTER the
+      donated carry buffers are gone (the donation-failure shape),
+    * ``sigterm`` — the preemption signal arrives (journal-now + restart),
+    * ``slow`` — the host straggles; the supervisor takes a defensive
+      extra journal but the chunk completes.
+
+    Executing an event is the supervisor's job; each executed event is
+    reported via :meth:`ChaosPlane.host_fault` so it lands in the
+    deterministic fault table (``fault="host_fault"``) like every other
+    injected fault."""
+
+    when: int
+    kind: str  # one of HOST_FAULT_KINDS
+
+
 @dataclass(frozen=True)
 class ChurnEvent:
     """One scheduled membership change: at round/window ``when``, ``node``
@@ -419,6 +445,54 @@ class ChaosPlane:
             return ()
         victim = pool[rng.randrange(len(pool))]
         return (RecoveryEvent(drop_round, "crash", victim),)
+
+    def plan_host_faults(
+        self,
+        chunks: int,
+        *,
+        seed: Optional[int] = None,
+        kinds: Sequence[str] = ("kill", "oom", "sigterm"),
+        start: int = 1,
+    ) -> Tuple["HostFaultEvent", ...]:
+        """Seeded host-fault trace against a fused engine's chunk loop (the
+        preemption-drill acceptance shape, à la :meth:`plan_recovery`).
+
+        Deterministic: a pure function of ``(seed, chunks, kinds, start)``
+        — fault chunk indices are drawn WITHOUT replacement from
+        ``[start, chunks)`` with a dedicated
+        ``random.Random(f"{seed}|hostfault")`` stream, one per requested
+        kind in the order given, so replays derive the identical trace and
+        soak gates can assert event-count identity. The supervisor executes
+        each event at the chunk boundary and reports it via
+        :meth:`host_fault`.
+        """
+        for k in kinds:
+            if k not in HOST_FAULT_KINDS:
+                raise ValueError(
+                    f"host-fault kind must be one of {HOST_FAULT_KINDS}, got {k!r}"
+                )
+        rng = random.Random(
+            f"{seed if seed is not None else Settings.CHAOS_SEED}|hostfault"
+        )
+        slots = list(range(max(0, start), max(0, int(chunks))))
+        events = []
+        for kind in kinds:
+            if not slots:
+                break
+            when = slots.pop(rng.randrange(len(slots)))
+            events.append(HostFaultEvent(when, kind))
+        return tuple(sorted(events, key=lambda e: (e.when, e.kind)))
+
+    def host_fault(self, label: str, kind: str) -> None:
+        """Count one EXECUTED host-fault event (``kind`` is one of
+        :data:`HOST_FAULT_KINDS` — recorded for the log line; the fault
+        counter buckets them all under ``fault="host_fault"``)."""
+        with self._lock:
+            self._count(label, "host_fault")
+        from p2pfl_tpu.telemetry.ledger import LEDGERS
+
+        LEDGERS.emit(label, "chaos_fault", fault="host_fault", peer=label, step=kind)
+        log.warning("chaos: host fault %s on %s", kind, label)
 
     def recovery(self, label: str, kind: str) -> None:
         """Count one EXECUTED recovery-scenario event (``kind`` is "crash" |

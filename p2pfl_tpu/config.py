@@ -325,6 +325,30 @@ class Settings:
     RECOVERY_PROBE_ENABLED: bool = _env_override("RECOVERY_PROBE_ENABLED", True)
     RECOVERY_PROBE_MAX: int = _env_int("RECOVERY_PROBE_MAX", 8, 1, 1024)
 
+    # --- engine supervisor (population/supervisor.py) -----------------------
+    # Preemption-proof wrapper around the fused engines' chunk-launch loops:
+    # write-ahead journaling on the crash-safe FLCheckpointer, bounded
+    # retry/backoff resume from the last journal, graceful degradation, and
+    # deterministic host-fault drills. The fused half of the wire path's
+    # durable-recovery plane above.
+    #
+    # Journal cadence in CHUNKS (scan launches), not rounds — the unit a
+    # host fault can lose. 1 = journal after every chunk.
+    SUPERVISOR_JOURNAL_EVERY: int = _env_int("SUPERVISOR_JOURNAL_EVERY", 1, 1, 1000)
+    # Retries per failed chunk before the degrade ladder engages. Each retry
+    # rolls back to the last journal and replays the seeded cohort/window
+    # stream from its absolute cursor, so a successful retry is bit-exact.
+    SUPERVISOR_MAX_RETRIES: int = _env_int("SUPERVISOR_MAX_RETRIES", 3, 0, 100)
+    # Exponential backoff base between retries (sleep = base * 2**attempt).
+    SUPERVISOR_BACKOFF_S: float = _env_float("SUPERVISOR_BACKOFF_S", 0.1, 0.0, 300.0)
+    # Degradation ladder when retries at the current shape are exhausted:
+    # "off" parks immediately; "chunks" shrinks rounds/windows-per-call
+    # toward 1; "cohort" additionally halves cohort K within the plan's
+    # min_size floor before parking with state readable.
+    SUPERVISOR_DEGRADE: str = _env_choice(
+        "SUPERVISOR_DEGRADE", "cohort", ("off", "chunks", "cohort")
+    )
+
     # --- learning round -----------------------------------------------------
     TRAIN_SET_SIZE: int = _env_override("TRAIN_SET_SIZE", 4)
     VOTE_TIMEOUT: float = _env_override("VOTE_TIMEOUT", 60.0)

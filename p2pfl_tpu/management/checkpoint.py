@@ -237,6 +237,67 @@ class FLCheckpointer:
         )
         return dict(restored["meta"] or {})
 
+    def restore_coherent(
+        self,
+        template: Pytree,
+        step: Optional[int] = None,
+        check_meta=None,
+    ):
+        """Restore ``(state, meta)`` with BOTH drawn from the SAME step.
+
+        :meth:`restore` and :meth:`restore_meta` each walk complete steps
+        newest-first INDEPENDENTLY — a step whose small JSON meta record
+        survives while its state files are torn (a kill mid-``save_to`` can
+        leave exactly that) would hand a caller meta from step A and state
+        from step B: a poisoned resume whose cursor and weights disagree.
+        This walk tries meta THEN state for one step and falls back to the
+        next-older step on ANY read failure, so engines resume coherently
+        or not at all.
+
+        ``check_meta(meta)``, when given, runs between the meta and state
+        reads of each candidate step; exceptions it raises PROPAGATE —
+        configuration-pin mismatches are a caller error, never a torn
+        snapshot to skip.
+        """
+        if step is not None:
+            meta = self.restore_meta(step)
+            if check_meta is not None:
+                check_meta(meta)
+            state, _ = self.restore(template, step)
+            return state, meta
+        candidates = sorted(self.all_steps(), reverse=True)
+        if not candidates:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        last_exc: Optional[Exception] = None
+        for s in candidates:
+            try:
+                meta = self.restore_meta(s)
+            except Exception as exc:  # noqa: BLE001 — torn meta: try older
+                last_exc = exc
+                log.warning(
+                    "checkpoint meta at step %s under %s unreadable (%s) — "
+                    "falling back to the previous snapshot",
+                    s, self.directory, exc,
+                )
+                continue
+            if check_meta is not None:
+                check_meta(meta)
+            try:
+                state, _ = self.restore(template, s)
+            except Exception as exc:  # noqa: BLE001 — torn state: try older
+                last_exc = exc
+                log.warning(
+                    "checkpoint state at step %s under %s unreadable (%s) — "
+                    "falling back to the previous snapshot",
+                    s, self.directory, exc,
+                )
+                continue
+            return state, meta
+        raise FileNotFoundError(
+            f"no coherently restorable checkpoint under {self.directory} "
+            f"(last error: {last_exc})"
+        )
+
     # --- ModelHandle convenience --------------------------------------------
 
     def save_model(self, step: int, model) -> bool:

@@ -1886,12 +1886,15 @@ class MeshSimulation:
         # Validate configuration pins against the META record FIRST: a rule
         # or DP mismatch must fail with its explanatory ValueError, not with
         # whatever pytree-structure error a mismatched template produces
-        # inside the structural restore.
-        self._check_restore_pins(checkpointer.restore_meta(step))
+        # inside the structural restore. The coherent walk guarantees the
+        # meta we validated and the state we restore come from the SAME
+        # step — a torn step whose meta still reads falls back wholesale.
         template = (
             self.state_dict() if self.params_stack is not None else self._abstract_state
         )
-        state, meta = checkpointer.restore(template, step)
+        state, meta = checkpointer.restore_coherent(
+            template, step, check_meta=self._check_restore_pins
+        )
         self.params_stack = state["params_stack"]
         self.opt_stack = state["opt_stack"]
         if self.algorithm == "scaffold":

@@ -52,6 +52,7 @@ from p2pfl_tpu.population.cohort import (
 )
 from p2pfl_tpu.population.engine import PopulationEngine
 from p2pfl_tpu.population.scenarios import PopulationScenario
+from p2pfl_tpu.population.supervisor import EngineSupervisor, SupervisorReport
 from p2pfl_tpu.population.sharding import (
     make_shard_and_gather_fns,
     match_partition_rules,
@@ -63,7 +64,9 @@ __all__ = [
     "AsyncRunResult",
     "AsyncWindowPlan",
     "CohortPlan",
+    "EngineSupervisor",
     "PopulationEngine",
+    "SupervisorReport",
     "WindowSchedule",
     "PopulationScenario",
     "active_plan",

@@ -971,14 +971,13 @@ class AsyncPopulationEngine:
         run would have produced (tests/test_asyncpop.py asserts this)."""
         if self._closed:
             raise RuntimeError("engine is closed — construct a new one")
-        meta = checkpointer.restore_meta(step)
-        if not meta:
-            return 0
-        if int(meta.get("seed", self.seed)) != self.seed:
-            raise ValueError(
-                f"checkpoint seed {meta.get('seed')} != engine seed "
-                f"{self.seed} — the window stream would diverge"
-            )
+        def _check_seed(meta: dict) -> None:
+            if meta and int(meta.get("seed", self.seed)) != self.seed:
+                raise ValueError(
+                    f"checkpoint seed {meta.get('seed')} != engine seed "
+                    f"{self.seed} — the window stream would diverge"
+                )
+
         template = {
             "history": self.history
             if self.history is not None
@@ -987,7 +986,14 @@ class AsyncPopulationEngine:
             if self.opt_stack is not None
             else self._init_opt(self._template),
         }
-        state, _ = checkpointer.restore(template, step)
+        # Coherent per-step walk: meta and state must come from the SAME
+        # step dir, and a torn step (kill mid-save_to) whose meta record
+        # still reads falls back wholesale to the previous snapshot.
+        state, meta = checkpointer.restore_coherent(
+            template, step, check_meta=_check_seed
+        )
+        if not meta:
+            return 0
         self.history = state["history"]
         self.opt_stack = state["opt_stack"]
         restored = int(meta.get("completed_windows", 0))

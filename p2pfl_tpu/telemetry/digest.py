@@ -109,6 +109,12 @@ class HealthDigest:
     # inf). None and 0 are distinct on purpose: absent telemetry must not
     # render as an active zero-spend guarantee.
     dp_epsilon: Optional[float] = None
+    # Engine supervisor (fused engines): cumulative restarts and degrade-
+    # ladder steps this node's supervisor performed. None = never
+    # supervised (wire nodes, pre-supervisor peers — omitted on the wire,
+    # always tolerated), distinct from a genuine 0 like dp_epsilon above.
+    restarts: Optional[int] = None
+    degrade: Optional[int] = None
     # Device.
     mem_bytes: float = 0.0
     # Distribution sketches (v2+): name -> QuantileSketch wire dict, plus
@@ -147,6 +153,9 @@ class HealthDigest:
             d.pop("tx_by_codec", None)  # keep pre-codec-label beats byte-identical
         if d.get("dp_epsilon") is None:
             d.pop("dp_epsilon", None)  # no budget reported: omit, don't claim 0
+        for opt in ("restarts", "degrade"):
+            if d.get(opt) is None:
+                d.pop(opt, None)  # unsupervised node: omit, keep old wire shape
         return json.dumps(d, separators=(",", ":"), sort_keys=True)
 
 
@@ -174,6 +183,7 @@ def decode(payload: str) -> Optional["HealthDigest"]:
         ("tx_bytes", float), ("rx_bytes", float), ("queue_depth", float),
         ("agg_waits", int), ("agg_wait_s", float), ("contributors", float),
         ("faults_seen", float), ("mem_bytes", float), ("dp_epsilon", float),
+        ("restarts", int), ("degrade", int),
     ):
         v = raw.get(name)
         if v is None:
@@ -307,6 +317,20 @@ def collect(addr: str, state: Any = None) -> HealthDigest:
         dig.staleness = _gauge_value("p2pfl_async_staleness", addr)
         dig.faults_seen = float(_series_sum("p2pfl_chaos_faults_total", addr))
         dig.dp_epsilon = _gauge_value_opt("p2pfl_privacy_epsilon", addr)
+        # Supervisor vitals: only nodes that ever ran supervised have the
+        # series — everyone else keeps None (omitted on the wire).
+        for fam_name, attr in (
+            ("p2pfl_supervisor_restarts_total", "restarts"),
+            ("p2pfl_supervisor_degrade_steps_total", "degrade"),
+        ):
+            fam = REGISTRY.get(fam_name)
+            if fam is not None:
+                vals = [
+                    c.value for lbl, c in fam.samples()
+                    if lbl.get("node") == addr
+                ]
+                if vals:
+                    setattr(dig, attr, int(sum(vals)))
         dig.mem_bytes = device_mem_bytes()
         # v2: the node's distribution sketches (step-time, staleness,
         # update-norm, agg-wait) + distinct-contributor estimator, wire

@@ -678,6 +678,11 @@ class Observatory:
                 "rejected_by_source": dict(d.rejected_by_source),
                 "faults_seen": d.faults_seen,
                 "dp_epsilon": d.dp_epsilon,
+                # Supervisor vitals: None for unsupervised/older peers —
+                # fed_top renders "-" (cross-version tolerance is the
+                # digest decoder's absent-field default).
+                "restarts": getattr(d, "restarts", None),
+                "degrade": getattr(d, "degrade", None),
                 "mem_bytes": d.mem_bytes,
                 "scores": scores.get(d.node, {}),
             }

@@ -109,6 +109,7 @@ def render(
         f"{'TX MiB':>8} {'RX MiB':>8} {'STALE':>6} {'EPS':>6} {'COHORT':>7} "
         f"{'WINDOW':>7} {'FILL':>6} "
         f"{'LOSS':>7} {'GNORM':>7} {'HBM MiB':>8} {'TRIP':>6} "
+        f"{'RSTRT':>5} {'DEGR':>4} "
         f"{'STRAG':>7} {'SUSP':>7} {'LINK':>6} {'AGE s':>6}"
     )
     lines = [
@@ -162,6 +163,13 @@ def render(
         mem_s = "-" if not mem else _mib(float(mem))
         trip = p.get("trip")
         trip_s = "-" if not trip else str(trip)[:6]
+        # Supervisor columns: engine restarts and degrade-ladder steps the
+        # peer's supervisor performed; "-" for unsupervised runs and
+        # pre-supervisor snapshots/digests (field absent or null).
+        restarts = p.get("restarts")
+        restarts_s = "-" if restarts is None else str(int(restarts))
+        degrade = p.get("degrade")
+        degrade_s = "-" if degrade is None else str(int(degrade))
         row = (
             f"{_short(addr):<23} {round_s:>7} {p.get('stage') or '-':<22.22} "
             f"{p.get('steps_per_s', 0.0):>8.1f} {_mib(p.get('tx_bytes', 0.0)):>8} "
@@ -175,6 +183,8 @@ def render(
             f"{gnorm_s:>7} "
             f"{mem_s:>8} "
             f"{trip_s:>6} "
+            f"{restarts_s:>5} "
+            f"{degrade_s:>4} "
             f"{s.get('straggler', 0.0):>7.2f} "
             f"{s.get('suspect', 0.0):>7.1f} {s.get('link', 0.0):>6.1f} "
             f"{s.get('age_s', 0.0):>6.1f}"
@@ -207,6 +217,19 @@ def render(
         ]
         line = "device observatory: " + "    ".join(bits)
         lines.append(paint(_RED if tripped else _BOLD, line))
+    # Supervisor banner (EngineSupervisor.snapshot stamps its run totals
+    # into snap["supervisor"]): a parked run heads the panel in red — the
+    # degrade ladder ran out and the state is waiting in the journal.
+    sup = snap.get("supervisor") or {}
+    if sup:
+        line = (
+            f"supervisor: restarts {sup.get('restarts', 0)}    "
+            f"retries {sup.get('retries', 0)}    "
+            f"degrade {sup.get('degrade_steps', 0)}    "
+            f"journals {sup.get('journals', 0)}"
+            + ("    PARKED" if sup.get("parked") else "")
+        )
+        lines.append(paint(_RED if sup.get("parked") else _BOLD, line))
     # Fleet-wide model-plane bytes per wire codec (digest tx_by_codec —
     # which encoder is actually carrying the model plane, and how much of
     # the traffic still rides dense frames).

@@ -45,6 +45,13 @@ What gets compared (dotted paths; ``*`` fans out over dict keys):
   telemetry-off timings are not comparable (the on side pays the aux
   stream), and silently skipping the section would read as "no devobs
   regression" when nothing was compared. Both sides absent → skipped.
+* supervisor fields under ``perf.supervisor.*`` (present when the run went
+  through ``bench.py --soak``) — ``journal_s_per_chunk`` and
+  ``overhead_ratio`` gated lower-is-better, ``restarts`` /
+  ``degrade_steps`` as counts (the soak arms are seeded, so any new
+  restart is a healing regression). Exactly ONE side carrying a
+  ``perf.supervisor`` section is refused (exit 3) for the same reason as
+  devobs: supervised vs unsupervised timings are not comparable.
 
 Noise-awareness: a timing regresses only when
 ``candidate > baseline * (1 + threshold)`` AND the absolute growth exceeds
@@ -84,8 +91,18 @@ DEFAULT_TIMING_KEYS = (
     # Campaign artifacts: per-scenario-family wall time (absent on
     # non-campaign benches — the fan-out just resolves to nothing).
     "extra.families.*.seconds",
+    # Supervisor fields (bench.py --soak): per-chunk journal cost and the
+    # supervised/unsupervised wall ratio are both lower-is-better.
+    "perf.supervisor.journal_s_per_chunk",
+    "perf.supervisor.overhead_ratio",
 )
-DEFAULT_COUNT_KEYS = ("perf.compile.recompiles_total.*",)
+DEFAULT_COUNT_KEYS = (
+    "perf.compile.recompiles_total.*",
+    # Seeded soak arms are deterministic: any new restart or degrade step
+    # is a healing regression, not noise.
+    "perf.supervisor.restarts",
+    "perf.supervisor.degrade_steps",
+)
 #: Campaign per-family violation counts, compared PER LABEL (a newly
 #: violated family must fail even when another family's count dropped).
 FAMILY_COUNT_KEYS = ("extra.families.*.violations",)
@@ -390,6 +407,26 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"section but {lack} does not; one side ran with device "
             "observability the other lacked. Re-run both sides with the "
             "same P2PFL_TPU_DEVOBS_ENABLED setting before diffing.",
+            file=sys.stderr,
+        )
+        return 3
+
+    b_sup = (base.get("perf") or {}).get("supervisor")
+    c_sup = (cand.get("perf") or {}).get("supervisor")
+    if (b_sup is None) != (c_sup is None):
+        have, lack = (
+            ("baseline", "candidate") if b_sup is not None
+            else ("candidate", "baseline")
+        )
+        # Same shape as the devobs refusal: a supervised run pays journal
+        # writes the unsupervised run does not — diffing them compares
+        # different programs, and skipping the section would report "no
+        # supervisor regression" without comparing anything.
+        print(
+            f"perf_diff: SUPERVISOR REFUSAL — {have} carries a "
+            f"perf.supervisor section but {lack} does not; one side ran "
+            "under the engine supervisor the other lacked. Re-run both "
+            "sides through bench.py --soak (or neither) before diffing.",
             file=sys.stderr,
         )
         return 3

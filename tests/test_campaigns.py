@@ -121,6 +121,20 @@ def test_churn_family_rerolls_to_feasible_committees():
         assert build_scenario(SEED, "churn", index).key == cs.key
 
 
+def test_host_fault_family_trace_shape():
+    """host_fault scenarios carry a seeded (rounds, kinds) trace the
+    supervisor_recovered invariant re-derives into a plan_host_faults drill
+    — rounds must leave room for one fault slot per kind plus a clean
+    chunk, and the draw must replay identically."""
+    for index in range(3):
+        cs = build_scenario(SEED, "host_fault", index)
+        assert cs.trace is not None
+        assert tuple(cs.trace["kinds"]) in AXES["host_fault_kinds"]
+        assert cs.trace["rounds"] >= len(cs.trace["kinds"]) + 1
+        assert build_scenario(SEED, "host_fault", index).key == cs.key
+    assert "supervisor_recovered" in FAMILY_INVARIANTS["host_fault"]
+
+
 def test_campaign_id_shape():
     assert campaign_id(7, 20) == "campaign-s7-n20"
 

@@ -1,0 +1,246 @@
+"""Engine supervisor: write-ahead journaling, self-healing bit-exact
+resume, the degrade ladder, torn-checkpoint tolerance, and the digest's
+optional supervisor fields.
+
+Every assertion here is deterministic: host-fault traces are seeded pure
+functions, the supervisor's ``events`` tuple is timestamp-free by
+construction, and healed params hashes are compared bit-for-bit against
+fault-free controls (`make soak-check` runs the same contract at the
+64-node shape on both engines).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+import pytest
+
+from p2pfl_tpu.chaos.plane import ChaosPlane, HostFaultEvent
+from p2pfl_tpu.management.checkpoint import FLCheckpointer
+from p2pfl_tpu.population import EngineSupervisor, PopulationEngine
+from p2pfl_tpu.telemetry.ledger import canonical_params_hash
+
+_SHAPE = dict(
+    cohort_fraction=0.5, cohort_min=2, seed=11,
+    samples_per_node=8, feature_dim=8, hidden=(4,), batch_size=4,
+)
+
+
+def _factory(**kw):
+    args = dict(num_nodes=6, **_SHAPE)
+    args.update(kw)
+    return PopulationEngine(**args)
+
+
+# --- seeded fault traces ------------------------------------------------------
+
+
+def test_plan_host_faults_seeded_one_slot_per_kind():
+    plane = ChaosPlane()
+    trace = plane.plan_host_faults(10, seed=7)
+    assert trace == plane.plan_host_faults(10, seed=7)  # pure in the seed
+    assert trace != plane.plan_host_faults(10, seed=8)
+    assert len(trace) == 3  # one slot per default kind
+    assert {ev.kind for ev in trace} == {"kill", "oom", "sigterm"}
+    whens = [ev.when for ev in trace]
+    assert len(set(whens)) == len(whens)  # drawn without replacement
+    assert all(1 <= w < 10 for w in whens)  # start=1: never before chunk 1
+    assert list(trace) == sorted(trace, key=lambda ev: (ev.when, ev.kind))
+
+
+def test_supervisor_rejects_bad_config():
+    with pytest.raises(ValueError, match="degrade"):
+        EngineSupervisor(_factory, None, degrade="bogus")
+    with pytest.raises(ValueError, match="fault kind"):
+        EngineSupervisor(_factory, None, faults=(HostFaultEvent(1, "meteor"),))
+    with pytest.raises(ValueError, match="two host faults"):
+        EngineSupervisor(
+            _factory, None,
+            faults=(HostFaultEvent(1, "kill"), HostFaultEvent(1, "oom")),
+        )
+
+
+# --- healing to bit identity --------------------------------------------------
+
+
+def test_supervised_run_heals_every_fault_kind_bit_exact(tmp_path):
+    """kill / OOM / SIGTERM / slow injected across one supervised run: the
+    final params hash must equal a fault-free control's, every planned kind
+    must actually fire, and the snapshot grafts the RESTARTS / DEGRADE
+    columns onto every peer."""
+    with _factory() as ctrl:
+        ctrl.run(5)
+        control_hash = canonical_params_hash(ctrl.gather_params(0))
+
+    faults = (
+        HostFaultEvent(1, "kill"),
+        HostFaultEvent(2, "oom"),
+        HostFaultEvent(3, "sigterm"),
+        HostFaultEvent(4, "slow"),
+    )
+    ck = FLCheckpointer(str(tmp_path))
+    with EngineSupervisor(
+        _factory, ck, node="sup-test", faults=faults, backoff_s=0.0
+    ) as sup:
+        report = sup.run(5, chunk=1)
+        healed_hash = canonical_params_hash(sup.engine.gather_params(0))
+        snap = sup.snapshot(report.results[-1], top_n=4)
+
+    assert not report.parked
+    assert report.completed == 5
+    assert healed_hash == control_hash  # bit-exact seeded-stream replay
+    assert report.faults_executed == faults  # trace fully consumed, in order
+    # kill and oom roll back + replay; sigterm journals first (zero rollback
+    # window) then restarts; slow only journals defensively.
+    assert report.restarts == {"kill": 1, "oom": 1, "sigterm": 1}
+    assert report.retries == 2  # kill + oom (sigterm restarts inline)
+    assert report.degrade_steps == ()
+    assert "fault:kill@1" in report.events
+    assert "journal:defensive@4" in report.events
+    # the events log is timestamp-free: only action tags with cursor anchors
+    assert all("@" in ev and ":" in ev for ev in report.events)
+    # fed_top surface: every peer row carries the supervisor columns
+    assert snap["supervisor"]["restarts"] == 3
+    assert snap["supervisor"]["parked"] is False
+    assert all(
+        p["restarts"] == 3 and p["degrade"] == 0
+        for p in snap["peers"].values()
+    )
+
+
+# --- degrade ladder -----------------------------------------------------------
+
+
+class _FailingEngine(PopulationEngine):
+    """An engine whose chunk launch always dies — drives the full ladder."""
+
+    def run(self, *a, **kw):  # noqa: D102 - synthetic failure
+        raise RuntimeError("synthetic chunk failure")
+
+
+def _failing_factory(**kw):
+    args = dict(num_nodes=8, **_SHAPE)
+    args.update(kw)
+    return _FailingEngine(**args)
+
+
+def test_degrade_ladder_deterministic_then_park(tmp_path):
+    """Retry exhaustion climbs chunk-halving then cohort-halving to the
+    plan's min_size floor, then parks — and the whole action sequence is
+    replay-identical across supervisors."""
+    def run_once(sub):
+        ck = FLCheckpointer(str(tmp_path / sub))
+        with EngineSupervisor(
+            _failing_factory, ck, node=f"sup-degrade-{sub}",
+            max_retries=0, backoff_s=0.0, degrade="cohort",
+        ) as sup:
+            return sup.run(5, chunk=4)
+
+    first = run_once("a")
+    assert first.parked and first.park_reason == "runtime"
+    assert first.completed == 0
+    actions = [a for a, _ in first.degrade_steps]
+    assert actions == ["chunks", "chunks", "cohort"]  # 4 -> 2 -> 1, K 4 -> 2
+    assert first.chunk_final == 1
+    assert first.cohort_final == 2  # halted at the plan's min_size floor
+    assert first.events[-1].startswith("park:runtime@")
+    assert first.events == run_once("b").events  # deterministic ladder
+
+
+def test_degrade_off_parks_after_retry_budget(tmp_path):
+    ck = FLCheckpointer(str(tmp_path))
+    with EngineSupervisor(
+        _failing_factory, ck, node="sup-off",
+        max_retries=1, backoff_s=0.0, degrade="off",
+    ) as sup:
+        report = sup.run(2, chunk=1)
+    assert report.parked
+    assert report.degrade_steps == ()
+    assert report.retries == 1  # the budgeted retry, then straight to park
+
+
+# --- torn-checkpoint tolerance ------------------------------------------------
+
+
+def _tear_state(ck_dir: str, step: int) -> None:
+    """Simulate a kill mid-save: the step's small meta record and commit
+    marker survive, but the state files are gone — exactly the incoherent
+    shape restore_coherent must skip wholesale."""
+    state_dir = os.path.join(ck_dir, str(step), "state")
+    assert os.path.isdir(state_dir)
+    shutil.rmtree(state_dir)
+
+
+def test_sync_engine_load_from_skips_torn_newest_step(tmp_path):
+    with _factory() as ctrl:
+        ctrl.run(3)
+        control_hash = canonical_params_hash(ctrl.gather_params(0))
+
+    ck = FLCheckpointer(str(tmp_path))
+    with _factory() as victim:
+        victim.run(1)
+        assert victim.save_to(ck)
+        victim.run(1)
+        assert victim.save_to(ck)
+        ck.wait()
+    _tear_state(ck.directory, 2)
+
+    healed_ck = FLCheckpointer(str(tmp_path))  # fresh manager: reads disk
+    with _factory() as healed:
+        # meta@2 still reads — a per-record walk would hand back cursor 2
+        # with state from step 1. The coherent walk falls back wholesale.
+        assert healed.load_from(healed_ck) == 1
+        healed.run(2)
+        assert canonical_params_hash(healed.gather_params(0)) == control_hash
+
+
+def test_async_engine_load_from_skips_torn_newest_step(tmp_path):
+    from p2pfl_tpu.population import AsyncPopulationEngine
+
+    kw = dict(
+        num_nodes=6, cohort_fraction=0.5, cohort_min=2, seed=13,
+        samples_per_node=8, feature_dim=8, hidden=(4,), batch_size=4,
+    )
+    with AsyncPopulationEngine(**kw) as ctrl:
+        ctrl.run(3)
+        control_hash = canonical_params_hash(ctrl.global_params())
+
+    ck = FLCheckpointer(str(tmp_path))
+    with AsyncPopulationEngine(**kw) as victim:
+        victim.run(1)
+        assert victim.save_to(ck)
+        victim.run(1)
+        assert victim.save_to(ck)
+        ck.wait()
+    _tear_state(ck.directory, 2)
+
+    healed_ck = FLCheckpointer(str(tmp_path))
+    with AsyncPopulationEngine(**kw) as healed:
+        assert healed.load_from(healed_ck) == 1
+        healed.run(2)
+        assert canonical_params_hash(healed.global_params()) == control_hash
+
+
+# --- digest optional fields (cross-version wire) ------------------------------
+
+
+def test_digest_supervisor_fields_cross_version_round_trip():
+    from p2pfl_tpu.telemetry import digest as digest_mod
+
+    sup = digest_mod.HealthDigest(node="mem://sup", ts=1.0, restarts=3, degrade=1)
+    payload = sup.encode()
+    assert '"restarts":3' in payload and '"degrade":1' in payload
+    back = digest_mod.decode(payload)
+    assert back.restarts == 3 and back.degrade == 1
+    # A genuine zero survives the wire — distinct from "never supervised".
+    zero = digest_mod.decode(
+        digest_mod.HealthDigest(node="mem://z", restarts=0, degrade=0).encode()
+    )
+    assert zero.restarts == 0 and zero.degrade == 0
+    # Unsupervised node: fields omitted entirely, old wire shape preserved.
+    plain = digest_mod.HealthDigest(node="mem://old", ts=1.0)
+    wire = plain.encode()
+    assert "restarts" not in wire and "degrade" not in wire
+    old = digest_mod.decode(wire)
+    assert old.restarts is None and old.degrade is None

@@ -1,6 +1,6 @@
 # Convenience targets; everything also runs as the plain commands shown.
 
-.PHONY: test test-fast bench dryrun proto-check api-docs telemetry-check chaos-check byzantine-check observatory-check perf-check async-check fleetobs-check recovery-check parity-check wire-check privacy-check analyze race-check population-check asyncpop-check devobs-check campaign-check soak-check
+.PHONY: test test-fast bench dryrun proto-check api-docs telemetry-check chaos-check byzantine-check observatory-check perf-check async-check fleetobs-check recovery-check parity-check wire-check privacy-check analyze race-check population-check asyncpop-check devobs-check campaign-check soak-check doctor-check
 
 test:            ## full suite on the virtual 8-device CPU mesh (~30 min, 1 core)
 	python -m pytest tests/ -q
@@ -64,6 +64,9 @@ devobs-check:    ## device-observatory gate: in-scan sketches chunking-invariant
 
 soak-check:      ## supervisor gate: seeded 64-vnode run healed through kill/OOM/SIGTERM on both engines, final hash bit-identical to fault-free control, event-log replay identical, degrade ladder deterministic (CPU-only, ~60 s)
 	JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/soak_check.py
+
+doctor-check:    ## diagnosis gate: 3 seeded fault scenarios (straggler/signflip/kill) each diagnose to their injected cause, clean control yields NO diagnosis, bundle manifests replay-identical (CPU-only, ~30 s)
+	JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/doctor_check.py
 
 analyze:         ## static correctness pass (C1-C5: lock order, blocking-under-lock, unguarded writes, jit purity, drift); exit 0 clean / 1 new finding / 2 stale suppression
 	PYTHONPATH=. python scripts/analyze.py --baseline analysis_baseline.json

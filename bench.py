@@ -246,8 +246,21 @@ def _bench_meta(seed=None, backend=None, fallback_reason=None) -> dict:
         # "tpu_probe_error" — the probe itself crashed; "forced_env" — the
         # environment pinned JAX_PLATFORMS=cpu before the bench started).
         "fallback_reason": fallback_reason,
+        # The federation-wide run id (telemetry/bundle.py) — joins this
+        # arm's JSON line to the ledger/flightrec/snapshot artifacts it
+        # produced. Empty when the arm never touched p2pfl_tpu.
+        "run_id": _run_id_or_empty(),
         "created_at": round(time.time(), 3),
     }
+
+
+def _run_id_or_empty() -> str:
+    try:
+        from p2pfl_tpu.telemetry.bundle import current_run_id
+
+        return current_run_id()
+    except Exception:  # noqa: BLE001 — meta must never kill a bench
+        return ""
 
 
 def _emit(out: dict, seed=None, backend=None, fallback_reason=None) -> None:
@@ -258,6 +271,19 @@ def _emit(out: dict, seed=None, backend=None, fallback_reason=None) -> None:
         "meta",
         _bench_meta(seed=seed, backend=backend, fallback_reason=fallback_reason),
     )
+    if "error" in out:
+        # A failed arm assertion is an incident: capture the evidence
+        # bundle before the hard exit (never raises, skipped when the
+        # doctor plane is disabled or p2pfl_tpu never loaded).
+        try:
+            from p2pfl_tpu.telemetry.bundle import write_bundle
+
+            out["bundle"] = write_bundle(
+                "bench_assertion",
+                context={"error": str(out.get("error")), "meta": out.get("meta")},
+            )
+        except Exception:  # noqa: BLE001 — the JSON line must still print
+            pass
     print(json.dumps(out), flush=True)
     os._exit(1 if "error" in out else 0)
 

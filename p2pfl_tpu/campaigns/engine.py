@@ -33,6 +33,7 @@ from p2pfl_tpu.campaigns.invariants import grade_scenario
 from p2pfl_tpu.campaigns.matrix import campaign_id, sample_campaign
 from p2pfl_tpu.config import Settings
 from p2pfl_tpu.telemetry import REGISTRY
+from p2pfl_tpu.telemetry.bundle import write_bundle
 
 log = logging.getLogger("p2pfl_tpu")
 
@@ -150,6 +151,12 @@ def run_campaign(
                     seconds=round(time.monotonic() - t0, 3),
                 )
                 _SCENARIOS.labels(cs.family, "error").inc()
+                entry["bundle"] = write_bundle(
+                    "campaign_violation",
+                    run_id=scn.run_id,
+                    context=dict(entry),
+                    error=exc,
+                )
                 results.append(entry)
                 violations_total += 1
                 say(f"  {cs.family}[{cs.index}] ERROR: {entry['error']}")
@@ -177,6 +184,14 @@ def run_campaign(
             if "adaptive" in wire:
                 entry["adaptive"] = wire["adaptive"]
             _SCENARIOS.labels(cs.family, entry["verdict"]).inc()
+            if vs:
+                # An invariant violation is an incident: capture the
+                # scenario's full evidence story under its pinned run id.
+                entry["bundle"] = write_bundle(
+                    "campaign_violation",
+                    run_id=scn.run_id,
+                    context=dict(entry),
+                )
             results.append(entry)
             say(
                 f"  {cs.family}[{cs.index}] {entry['verdict']} "

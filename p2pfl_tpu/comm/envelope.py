@@ -16,6 +16,7 @@ from typing import List, Optional
 
 from p2pfl_tpu.config import Settings
 from p2pfl_tpu.telemetry import tracing
+from p2pfl_tpu.telemetry.bundle import current_run_id
 
 
 @dataclass
@@ -41,6 +42,13 @@ class Envelope:
     # absent, and absent digests MUST be tolerated by every receiver —
     # digest-free (older or opted-out) nodes share the wire.
     digest: str = ""
+    # Federation-wide run id (telemetry/bundle.py) correlating every
+    # artifact of one experiment. Same wire story as ``trace``: native on
+    # the in-memory transport, a reserved trailing control arg on gRPC;
+    # weights frames skip it (the control plane converges the id before
+    # any model traffic flows). Empty = sender predates run contexts or
+    # none established — receivers MUST tolerate that.
+    run_id: str = ""
     # SENDER-LOCAL codec attribution for weights payloads ("topk" /
     # "topk-int8" / "topk-int4" / "dense"; comm/delta.py CODEC_LABELS).
     # Never serialized onto the wire — the frame itself is self-describing;
@@ -64,6 +72,7 @@ class Envelope:
             ttl=Settings.TTL,
             msg_id=secrets.randbits(63),
             trace=tracing.current_wire(),
+            run_id=current_run_id(),
         )
 
     @staticmethod

@@ -29,6 +29,7 @@ from p2pfl_tpu.comm.neighbors import Neighbors
 from p2pfl_tpu.comm.protocol import CommunicationProtocol
 from p2pfl_tpu.config import Settings
 from p2pfl_tpu.exceptions import CommunicationError
+from p2pfl_tpu.telemetry import bundle as bundle_mod
 from p2pfl_tpu.telemetry import digest as digest_mod
 from p2pfl_tpu.telemetry import tracing
 
@@ -57,6 +58,8 @@ def _env_to_pb(env: Envelope) -> node_pb2.Envelope:
             pb.control.args.append(digest_mod.WIRE_ARG_PREFIX + env.digest)
         if env.trace:
             pb.control.args.append(tracing.WIRE_ARG_PREFIX + env.trace)
+        if env.run_id:
+            pb.control.args.append(bundle_mod.WIRE_ARG_PREFIX + env.run_id)
         pb.control.ttl = env.ttl
         pb.control.msg_id = env.msg_id
     return pb
@@ -73,6 +76,9 @@ def _pb_to_env(pb: node_pb2.Envelope) -> Envelope:
             num_samples=int(pb.weights.num_samples),
         )
     args = list(pb.control.args)
+    run_id = ""
+    if args and args[-1].startswith(bundle_mod.WIRE_ARG_PREFIX):
+        run_id = args.pop()[len(bundle_mod.WIRE_ARG_PREFIX):]
     trace = ""
     if args and args[-1].startswith(tracing.WIRE_ARG_PREFIX):
         trace = args.pop()[len(tracing.WIRE_ARG_PREFIX):]
@@ -88,6 +94,7 @@ def _pb_to_env(pb: node_pb2.Envelope) -> Envelope:
         msg_id=int(pb.control.msg_id),
         trace=trace,
         digest=digest,
+        run_id=run_id,
     )
 
 

@@ -32,6 +32,7 @@ from p2pfl_tpu.exceptions import (
     ProtocolNotStartedError,
 )
 from p2pfl_tpu.telemetry import REGISTRY, TRACER
+from p2pfl_tpu.telemetry import bundle as bundle_mod
 from p2pfl_tpu.telemetry import digest as digest_mod
 from p2pfl_tpu.telemetry.flight_recorder import FlightRecorder
 from p2pfl_tpu.telemetry.observatory import Observatory
@@ -278,7 +279,8 @@ class CommunicationProtocol:
         directory = os.path.dirname(path)
         if directory:
             os.makedirs(directory, exist_ok=True)
-        tmp = f"{path}.tmp.{os.getpid()}"
+        # pid alone collides when two node threads write the same doc path
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
         with open(tmp, "w") as f:
             json.dump(doc, f)
         os.replace(tmp, path)
@@ -563,6 +565,13 @@ class CommunicationProtocol:
             return
         if not self.gossiper.check_and_set_processed(env.msg_id):
             return
+        # Run-id adoption (AFTER dedup, like digests): first-wins for
+        # ordinary frames — a stale peer's heartbeat must not flip an
+        # established context — but a start_learning kickoff forces it, so
+        # every node converges on the initiator's experiment id before any
+        # model traffic flows.
+        if env.run_id:
+            bundle_mod.adopt_run_id(env.run_id, force=env.cmd == "start_learning")
         # Piggybacked health digest (normally on beats): feed the fleet view
         # AFTER dedup so re-gossiped copies don't re-ingest. Absent digests
         # (older / opted-out peers) skip this entirely — wire compatibility.
@@ -583,6 +592,7 @@ class CommunicationProtocol:
                 msg_id=env.msg_id,
                 trace=env.trace,  # re-gossip stays in the sender's trace
                 digest=env.digest,  # digests reach non-direct peers this way
+                run_id=env.run_id,  # run id diffuses past direct neighbors
             )
             self.gossiper.add_message(fwd)
 

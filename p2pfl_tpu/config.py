@@ -474,6 +474,24 @@ class Settings:
     DEVOBS_BENCH_MAX_OVERHEAD: float = _env_float(
         "DEVOBS_BENCH_MAX_OVERHEAD", 1.05, 1.0, 10.0
     )
+    # Diagnosis plane (telemetry/bundle.py + telemetry/diagnosis.py): RUN_ID
+    # pins the federation-wide run id instead of minting one per launch —
+    # CI replay harnesses (make doctor-check) use it to make evidence-bundle
+    # manifests byte-comparable across reruns. Empty (default) mints a
+    # seeded-deterministic body with a host-unique suffix at engine launch
+    # or set_start_learning.
+    RUN_ID: str = _env_override("RUN_ID", "")
+    # Master switch for evidence-bundle capture: when off, the failure hooks
+    # (workflow crash, supervisor park, devobs trip, campaign violation,
+    # bench assertion) skip bundle writes entirely — zero happy-path cost.
+    DOCTOR_BUNDLE_ENABLED: bool = _env_override("DOCTOR_BUNDLE_ENABLED", True)
+    # Where bundle_<run_id>/ directories land (and where the fed_top
+    # DIAGNOSIS banner's incident.json is refreshed).
+    DOCTOR_BUNDLE_DIR: str = _env_override("DOCTOR_BUNDLE_DIR", "artifacts")
+    # Findings below this confidence are dropped from incident reports —
+    # the rule catalog's corroboration bonuses live above it, lone weak
+    # signals below.
+    DOCTOR_MIN_CONFIDENCE: float = _env_float("DOCTOR_MIN_CONFIDENCE", 0.5, 0.0, 1.0)
 
     # --- population-scale engine (population/) ------------------------------
     # Cohort sampling (Papaya, arxiv 2111.04877): each round/window solicits

@@ -52,6 +52,7 @@ from p2pfl_tpu.models.model_handle import ModelHandle
 from p2pfl_tpu.node_state import NodeState
 from p2pfl_tpu.stages.workflow import LearningWorkflow, scheduler_start_stage
 from p2pfl_tpu.telemetry import TRACER, tracing
+from p2pfl_tpu.telemetry.bundle import establish_run
 
 
 class Node:
@@ -290,6 +291,11 @@ class Node:
             raise ValueError(f"mode must be 'sync' or 'async', got {mode!r}")
         if self.learning_in_progress():
             raise LearningRunningException("learning already in progress")
+        # Establish the federation-wide run id (fresh: each kickoff is a
+        # new experiment). The start_learning broadcast below carries it as
+        # a reserved control arg, and every receiver force-adopts it — so
+        # all artifacts of this session share one correlation key.
+        establish_run(name=self.addr, fresh=True)
         # Mint the federation-wide trace id: the kickoff broadcasts run
         # inside this span, so the start_learning frames carry its context
         # and every peer's experiment adopts the same trace

@@ -53,6 +53,7 @@ from p2pfl_tpu.learning.privacy import resolve_seed
 from p2pfl_tpu.models.model_handle import ModelHandle
 from p2pfl_tpu.ops import aggregation as agg_ops
 from p2pfl_tpu.parallel.mesh import make_mesh
+from p2pfl_tpu.telemetry.bundle import establish_run
 from p2pfl_tpu.telemetry.sketches import (
     device_bucket_spec,
     device_bucket_stats,
@@ -521,6 +522,12 @@ class MeshSimulation:
         else:
             self.optimizer = optax.adam(lr)
         self.seed = resolve_seed(seed, self.dp_noise_multiplier)
+        # Join the federation-wide run context (telemetry/bundle.py):
+        # first-established wins, so a scenario/parity pin in LEDGERS is
+        # adopted; otherwise a seed-deterministic id is minted — the
+        # common "engine" name keeps same-seed cross-backend runs on one
+        # id. Every artifact this engine emits carries it.
+        establish_run(seed=self.seed, name="engine")
         # Model-poisoning attack (BASELINE config #4's gradient-attack side;
         # complements data poisoning via dataset.poison_partitions): nodes
         # flagged in `byzantine_mask` [N] transform their trained update
@@ -1440,6 +1447,15 @@ class MeshSimulation:
                 self._ledger.emit(
                     "membership", event="devobs_trip", peer=self._devobs_node
                 )
+            from p2pfl_tpu.telemetry.bundle import write_bundle
+
+            trip["bundle"] = write_bundle(
+                "devobs_trip",
+                context={
+                    k: trip.get(k)
+                    for k in ("kind", "round", "chunk", "action")
+                },
+            )
         dt = time.monotonic() - t0
         # On a tripwire trip `done` < `rounds`: the result covers only the
         # chunks that actually executed.

@@ -74,6 +74,7 @@ from p2pfl_tpu.population.arrivals import (
 )
 from p2pfl_tpu.population.cohort import cohort_size
 from p2pfl_tpu.population.engine import population_data, vnode_names
+from p2pfl_tpu.telemetry.bundle import establish_run
 from p2pfl_tpu.telemetry.sketches import device_bucket_spec, device_bucket_stats
 
 Pytree = Any
@@ -161,6 +162,10 @@ class AsyncPopulationEngine:
             raise ValueError(f"num_nodes must be >= 1, got {num_nodes}")
         self.num_nodes = int(num_nodes)
         self.seed = int(seed)
+        # Join the federation-wide run context (see MeshSimulation): a
+        # scenario pin in LEDGERS is adopted, else a seed-deterministic id
+        # is minted under the shared "engine" name.
+        establish_run(seed=self.seed, name="engine")
         self.names = vnode_names(self.num_nodes)
         self.plan = AsyncWindowPlan(
             seed=self.seed,
@@ -771,6 +776,15 @@ class AsyncPopulationEngine:
                 self._ledger.emit(
                     "membership", event="devobs_trip", peer=self._devobs_node
                 )
+            from p2pfl_tpu.telemetry.bundle import write_bundle
+
+            trip["bundle"] = write_bundle(
+                "devobs_trip",
+                context={
+                    k: trip.get(k)
+                    for k in ("kind", "round", "chunk", "action")
+                },
+            )
         dt = time.monotonic() - t0
         # On a tripwire trip `done` < `windows`: the result (and every
         # cursor/accounting update below) covers only the executed chunks.

@@ -47,6 +47,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from p2pfl_tpu.chaos.plane import CHAOS, HOST_FAULT_KINDS, HostFaultEvent
 from p2pfl_tpu.config import Settings
 from p2pfl_tpu.telemetry import REGISTRY
+from p2pfl_tpu.telemetry import bundle as bundle_mod
 from p2pfl_tpu.telemetry.flight_recorder import FlightRecorder
 from p2pfl_tpu.telemetry.ledger import LEDGERS
 
@@ -110,6 +111,9 @@ class SupervisorReport:
     events: Tuple[str, ...] = ()
     #: per-chunk engine results, in execution order.
     results: List[Any] = field(default_factory=list)
+    #: the federation-wide run id this supervised run executed under —
+    #: joins the report to every other artifact in its evidence bundle.
+    run_id: str = ""
 
     @property
     def total_restarts(self) -> int:
@@ -382,6 +386,12 @@ class EngineSupervisor:
         self._emit("supervisor_park", reason=reason, step=self.cursor)
         self._log_event(f"park:{reason}@{self.cursor}")
         self._rec.dump("supervisor_park")
+        # A park IS an incident: capture the whole evidence story (a
+        # trip-kind park is the supervised flavor of a devobs abort).
+        bundle_mod.write_bundle(
+            "supervisor_park",
+            context={"node": self._node, "reason": reason, "step": self.cursor},
+        )
 
     # --- the loop -------------------------------------------------------------
 
@@ -406,6 +416,10 @@ class EngineSupervisor:
         results: List[Any] = []
         parked, park_reason = False, None
         t0 = time.monotonic()
+        # Join this supervised run to the ambient run context (explicit
+        # ctor run_id wins; otherwise first-established/LEDGERS id, else
+        # mint) — the report and every park bundle carry it.
+        bundle_mod.establish_run(run_id=self._run_id, name=self._node)
 
         if self.engine is None:
             self._build()
@@ -510,6 +524,7 @@ class EngineSupervisor:
             faults_executed=tuple(self._fired),
             events=tuple(self._events),
             results=results,
+            run_id=bundle_mod.current_run_id(),
         )
         self.last_report = report
         return report
@@ -537,6 +552,7 @@ class EngineSupervisor:
             entry["degrade"] = degrade
         snap["supervisor"] = {
             "node": self._node,
+            "run_id": report.run_id if report is not None else "",
             "restarts": restarts,
             "degrade_steps": degrade,
             "retries": report.retries if report is not None else 0,

@@ -18,6 +18,7 @@ from typing import TYPE_CHECKING, List, Optional, Type
 
 from p2pfl_tpu.stages.stage import Stage
 from p2pfl_tpu.telemetry import REGISTRY, TRACER
+from p2pfl_tpu.telemetry.bundle import write_bundle
 
 if TYPE_CHECKING:  # pragma: no cover
     from p2pfl_tpu.node import Node
@@ -92,12 +93,24 @@ class LearningWorkflow:
             # Node was stopped under our feet; treat as an early stop rather
             # than letting the exception escape the daemon thread.
             log.info("%s: protocol stopped mid-workflow — aborting learning", node.addr)
-        except Exception:
+        except Exception as exc:
             log.exception("%s: workflow crashed", node.addr)
             # The failure the flight recorder exists for: dump the ring
             # before the daemon thread dies with the evidence.
             node.protocol.flight_recorder.record("workflow_crash")
             node.protocol.flight_recorder.dump("workflow_crash")
+            # ...and the rest of the causal story with it: one evidence
+            # bundle joining every run-matching stream (both schedulers
+            # crash through this path).
+            write_bundle(
+                "workflow_crash",
+                context={
+                    "node": node.addr,
+                    "stage": node.state.current_stage,
+                    "round": node.state.round,
+                },
+                error=exc,
+            )
             raise
         finally:
             node.state.current_stage = ""

@@ -23,6 +23,7 @@ import os
 import re
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Any, Dict, List, Optional
 
@@ -30,6 +31,32 @@ from p2pfl_tpu.config import Settings
 from p2pfl_tpu.telemetry.metrics import REGISTRY
 
 log = logging.getLogger("p2pfl_tpu")
+
+#: dump-doc schema: v2 added the common versioned "header" block
+#: (run_id / schema_version / node / clock era). v1 readers that only
+#: know the legacy top-level keys keep working — those keys are retained.
+FLIGHTREC_SCHEMA_VERSION = 2
+
+# Live-recorder registry: the evidence-bundle writer needs to dump every
+# recorder in the process, not just the one owned by the failing
+# component. Weak references — a recorder's lifetime is its owner's.
+_LIVE: "weakref.WeakSet[FlightRecorder]" = weakref.WeakSet()
+_LIVE_LOCK = threading.Lock()
+
+
+def live_recorders() -> List["FlightRecorder"]:
+    """Every recorder still alive in this process, sorted by node address
+    (stable member ordering for bundle manifests)."""
+    with _LIVE_LOCK:
+        recs = list(_LIVE)
+    return sorted(recs, key=lambda r: r._addr)
+
+
+def reset_live_recorders() -> None:
+    """Forget all live recorders (test/scenario isolation — a stale ring
+    from a previous scenario must not leak into the next bundle)."""
+    with _LIVE_LOCK:
+        _LIVE.clear()
 
 _DROPPED = REGISTRY.counter(
     "p2pfl_flightrec_events_dropped_total",
@@ -58,6 +85,8 @@ class FlightRecorder:
         self._events: deque = deque(maxlen=max(1, cap))
         self._lock = threading.Lock()
         self._dropped = _DROPPED.labels(addr)
+        with _LIVE_LOCK:
+            _LIVE.add(self)
 
     @property
     def capacity(self) -> int:
@@ -113,13 +142,21 @@ class FlightRecorder:
         wins.
         """
         try:
+            from p2pfl_tpu.telemetry.bundle import artifact_header
+
             events = self.events()
             path = self.dump_path(directory)
             os.makedirs(directory, exist_ok=True)
-            tmp = f"{path}.tmp.{os.getpid()}"
+            # pid alone collides when two threads dump into one bundle dir
+            tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
             with open(tmp, "w") as f:
                 json.dump(
                     {
+                        "header": artifact_header(
+                            node=self._addr,
+                            kind="flightrec",
+                            schema_version=FLIGHTREC_SCHEMA_VERSION,
+                        ),
                         "node": self._addr,
                         "trigger": trigger,
                         # Both clocks at dump time plus the mapping used for
@@ -147,4 +184,9 @@ class FlightRecorder:
             return None
 
 
-__all__ = ["FlightRecorder"]
+__all__ = [
+    "FLIGHTREC_SCHEMA_VERSION",
+    "FlightRecorder",
+    "live_recorders",
+    "reset_live_recorders",
+]

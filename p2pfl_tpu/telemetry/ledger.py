@@ -266,7 +266,8 @@ class TrajectoryLedger:
             # committed baselines) stay byte-identical.
             header["campaign"] = self.campaign
         evs = self.canonical_events() if canonical else self.events()
-        tmp = f"{path}.tmp.{os.getpid()}"
+        # pid alone collides when two threads dump into one bundle dir
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
         with open(tmp, "w") as f:
             f.write(json.dumps(header, sort_keys=True, separators=(",", ":")) + "\n")
             for ev in evs:
@@ -300,6 +301,14 @@ class LedgerHub:
         """The active campaign scope (empty outside campaign runs)."""
         with self._lock:
             return self._campaign
+
+    @property
+    def run_id(self) -> str:
+        """The configured run id ("" until :meth:`configure`) — the ambient
+        run context (telemetry/bundle.py) adopts a scenario-pinned id from
+        here instead of minting over it."""
+        with self._lock:
+            return self._run_id
 
     def configure(self, run_id: str, campaign: Optional[str] = None) -> None:
         """Set the experiment-wide run id stamped into every ledger created

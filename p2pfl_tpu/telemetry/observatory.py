@@ -750,12 +750,30 @@ class Observatory:
         self._overflow_gauge.set(0)
 
 
+#: snapshot-doc schema: v2 added the common versioned "header" block
+#: (run_id / schema_version / node / clock era); old readers that only
+#: know "observer"/"peers"/"fleet" keep working.
+SNAPSHOT_SCHEMA_VERSION = 2
+
+
 def write_snapshot_doc(path: str, doc: Dict[str, Any]) -> str:
     """Atomically write a federation-snapshot document (tmp + rename, the
     contract ``fed_top.py`` polls against). Shared by the real-wire
-    observatory and the fused-mesh virtual-fleet snapshot."""
+    observatory and the fused-mesh virtual-fleet snapshot — which makes it
+    the single choke point stamping the run-correlated artifact header."""
+    from p2pfl_tpu.telemetry.bundle import artifact_header
+
+    doc.setdefault(
+        "header",
+        artifact_header(
+            node=str(doc.get("observer", "")),
+            kind="snapshot",
+            schema_version=SNAPSHOT_SCHEMA_VERSION,
+        ),
+    )
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    tmp = f"{path}.tmp.{os.getpid()}"
+    # pid alone collides when two node threads write the same doc path
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     with open(tmp, "w") as f:
         json.dump(doc, f, indent=1, sort_keys=True)
     os.replace(tmp, path)
@@ -966,6 +984,7 @@ def snapshot_shape_diff(
 
 __all__ = [
     "Observatory",
+    "SNAPSHOT_SCHEMA_VERSION",
     "STALE_AFTER_S",
     "mesh_chunk_telemetry",
     "mesh_trip",

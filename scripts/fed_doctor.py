@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""fed_doctor — diagnose a run from its evidence bundle (or live artifacts).
+
+Points the diagnosis rule catalog (:mod:`p2pfl_tpu.telemetry.diagnosis`)
+at either:
+
+* a **bundle directory** (``artifacts/bundle_<run_id>/``) — the complete,
+  run-id-coherent evidence set a failure hook captured, or
+* a **live artifacts directory** (default ``artifacts/``) — whatever
+  ledger dumps / flight-recorder dumps / snapshots are lying around from
+  the most recent run (best-effort; no completeness guarantee).
+
+and prints the ranked incident report. Also (re)writes ``incident.json``
+next to the evidence so the fed_top DIAGNOSIS banner picks it up.
+
+Usage::
+
+    python scripts/fed_doctor.py                      # live artifacts/
+    python scripts/fed_doctor.py artifacts/bundle_ab12cd34ef56-0f3a
+    python scripts/fed_doctor.py --json               # machine-readable
+    python scripts/fed_doctor.py --latest             # newest bundle dir
+
+Exit codes: 0 = report produced (findings or clean), 2 = no evidence at
+the given path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from p2pfl_tpu.telemetry import diagnosis  # noqa: E402
+
+
+def _latest_bundle(root: str) -> str:
+    """Newest bundle dir under ``root`` (by directory mtime), else root."""
+    bundles = [d for d in glob.glob(os.path.join(root, "bundle_*")) if os.path.isdir(d)]
+    if not bundles:
+        return root
+    return max(bundles, key=os.path.getmtime)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "path",
+        nargs="?",
+        default="artifacts",
+        help="bundle dir or live artifacts dir (default: artifacts)",
+    )
+    ap.add_argument(
+        "--latest",
+        action="store_true",
+        help="diagnose the newest bundle_* dir under PATH instead of PATH itself",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="print the incident doc as JSON"
+    )
+    ap.add_argument(
+        "--no-write",
+        action="store_true",
+        help="do not (re)write incident.json next to the evidence",
+    )
+    args = ap.parse_args(argv)
+
+    path = _latest_bundle(args.path) if args.latest else args.path
+    if not os.path.isdir(path):
+        print(f"fed_doctor: no such directory: {path}", file=sys.stderr)
+        return 2
+    ev = diagnosis.load_evidence(path)
+    if not (ev.ledgers or ev.flightrecs or ev.snapshot or ev.metrics or ev.context):
+        print(f"fed_doctor: no evidence found under {path}", file=sys.stderr)
+        return 2
+    findings = diagnosis.diagnose(ev)
+    doc = diagnosis.incident_doc(findings, run_id=ev.run_id, source=path)
+    if not args.no_write:
+        try:
+            target = os.path.join(path, "incident.json")
+            with open(target, "w", encoding="utf-8") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+                f.write("\n")
+            # Keep the latest-incident pointer beside federation_snapshot.json
+            # fresh too, when diagnosing a bundle nested under artifacts/.
+            parent = os.path.dirname(os.path.abspath(path))
+            if os.path.basename(path).startswith("bundle_"):
+                with open(
+                    os.path.join(parent, "incident.json"), "w", encoding="utf-8"
+                ) as f:
+                    json.dump(doc, f, indent=1, sort_keys=True)
+                    f.write("\n")
+        except OSError:
+            pass
+    if args.json:
+        print(json.dumps(doc, indent=1, sort_keys=True))
+    else:
+        print(diagnosis.render_report(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -21,7 +21,11 @@ staleness, update norm, agg wait, distinct contributors) — then the live
 membership-churn tail. Snapshots written by the fused-mesh simulation
 (``MeshSimulation.fleet_snapshot``; ``bench.py --fleetobs``) render in
 the same view: the peer table is the top-N stragglers of a 10k-virtual-
-node run, the fleet row is the whole population. Stdlib-only — no curses,
+node run, the fleet row is the whole population. When an evidence bundle
+has been captured next to the snapshot (``artifacts/incident.json``,
+written by the failure hooks or ``scripts/fed_doctor.py``) a DIAGNOSIS
+banner names the top-ranked root cause; ``--doctor`` prints that report
+once and exits (``-`` when no incident exists). Stdlib-only — no curses,
 no dependencies — so it runs anywhere the repo does.
 """
 
@@ -66,6 +70,24 @@ def _parity_banner(parity: Dict[str, Any]) -> str:
     return f"PARITY DIVERGED @ {where}: {fd.get('problem', '?')}"
 
 
+def _diagnosis_banner(incident: Dict[str, Any]) -> "list[str]":
+    """DIAGNOSIS banner lines from an ``artifacts/incident.json`` report
+    (written by the evidence-bundle hooks / scripts/fed_doctor.py)."""
+    findings = incident.get("findings") or []
+    if not findings:
+        return ["DIAGNOSIS — (no findings; last doctor pass came back clean)"]
+    top = findings[0]
+    lines = [
+        f"DIAGNOSIS [{str(top.get('severity', '?')).upper()}] "
+        f"{top.get('rule', '?')} "
+        f"({float(top.get('confidence', 0.0)):.0%}) — {top.get('summary', '')}"
+    ]
+    if len(findings) > 1:
+        rest = ", ".join(str(f.get("rule", "?")) for f in findings[1:4])
+        lines.append(f"  +{len(findings) - 1} more: {rest}")
+    return lines
+
+
 def _ledger_line(ev: Dict[str, Any]) -> str:
     kind = ev.get("kind", "?")
     rnd = ev.get("round")
@@ -87,6 +109,7 @@ def render(
     snap: Dict[str, Any],
     color: bool = True,
     parity: "Dict[str, Any] | None" = None,
+    incident: "Dict[str, Any] | None" = None,
 ) -> str:
     def paint(code: str, s: str) -> str:
         return f"{code}{s}{_RESET}" if color else s
@@ -200,6 +223,16 @@ def render(
     lines.append(
         f"top straggler: {top_straggler or '-'}    top suspect: {top_suspect or '-'}"
     )
+    # DIAGNOSIS banner (artifacts/incident.json, written when a failure
+    # hook captured an evidence bundle or fed_doctor ran): "-" means no
+    # incident has ever been diagnosed next to this snapshot.
+    if incident is None:
+        lines.append(paint(_DIM, "diagnosis: -"))
+    else:
+        sev = (incident.get("findings") or [{}])[0].get("severity")
+        code = _RED if sev == "critical" else (_YELLOW if sev == "warning" else _DIM)
+        for dl in _diagnosis_banner(incident):
+            lines.append(paint(code, dl))
     # Device-observatory banner (fused engines stamp the in-scan stream's
     # headline values into snap["devobs"]): a tripped run heads the panel
     # in red — the compiled program itself raised the flag.
@@ -301,14 +334,38 @@ def main() -> int:
                     help="refresh period in seconds (default 2)")
     ap.add_argument("--once", action="store_true",
                     help="render one frame (no ANSI clear) and exit")
+    ap.add_argument("--doctor", action="store_true",
+                    help="one-shot: print the latest incident report next to "
+                         "the snapshot ('-' when none) and exit")
     args = ap.parse_args()
 
     color = sys.stdout.isatty() or not args.once
     # The parity report (scripts/parity_diff.py --out) lives next to the
     # snapshot; when present its OK/DIVERGED banner heads the ledger panel.
-    parity_path = os.path.join(
-        os.path.dirname(args.path) or ".", "parity_diff.json"
-    )
+    # The incident report (evidence-bundle hooks / scripts/fed_doctor.py)
+    # lives there too and feeds the DIAGNOSIS banner.
+    artifacts_dir = os.path.dirname(args.path) or "."
+    parity_path = os.path.join(artifacts_dir, "parity_diff.json")
+    incident_path = os.path.join(artifacts_dir, "incident.json")
+
+    if args.doctor:
+        try:
+            with open(incident_path) as f:
+                incident = json.load(f)
+        except (OSError, ValueError):
+            print("-")
+            return 0
+        rid = incident.get("run_id") or "-"
+        print(f"incident (run {rid}, source {incident.get('source') or '-'}):")
+        for line in _diagnosis_banner(incident):
+            print(line)
+        for f_ in (incident.get("findings") or [])[1:]:
+            print(
+                f"  [{str(f_.get('severity', '?')).upper()}] {f_.get('rule')} "
+                f"({float(f_.get('confidence', 0.0)):.0%}) — {f_.get('summary')}"
+            )
+        return 0
+
     while True:
         parity = None
         try:
@@ -316,10 +373,21 @@ def main() -> int:
                 parity = json.load(f)
         except (OSError, ValueError):
             parity = None
+        incident = None
+        try:
+            with open(incident_path) as f:
+                incident = json.load(f)
+        except (OSError, ValueError):
+            incident = None
         try:
             with open(args.path) as f:
                 snap = json.load(f)
-            frame = render(snap, color=color and not args.once, parity=parity)
+            frame = render(
+                snap,
+                color=color and not args.once,
+                parity=parity,
+                incident=incident,
+            )
         except FileNotFoundError:
             frame = (
                 f"waiting for {args.path} — run a federation that writes the "

@@ -65,7 +65,9 @@ the gate exists to catch).
 
 Extra comparisons: repeat ``--key extra.some.path`` to add lower-is-better
 metrics. Output is one human-readable line per metric plus a JSON summary
-line on stdout.
+line on stdout; ``--report`` swaps the JSON line for a markdown report
+(per-key table, verdict, refusal reason when the gate refused) pasteable
+into a PR description.
 """
 
 from __future__ import annotations
@@ -302,6 +304,62 @@ def compare(
     }
 
 
+def render_markdown(
+    summary: Optional[Dict[str, Any]],
+    baseline: str,
+    candidate: str,
+    refusal: Optional[str] = None,
+) -> str:
+    """Render a comparison (or a refusal) as a markdown report — what
+    ``--report`` prints, pasteable into a PR description."""
+    lines = [
+        "# perf_diff report",
+        "",
+        f"- baseline: `{baseline}`",
+        f"- candidate: `{candidate}`",
+    ]
+    if refusal is not None:
+        lines += [
+            "",
+            "## Verdict: REFUSED",
+            "",
+            "The two bench files are not comparable; no metrics were diffed.",
+            "",
+            f"> {refusal}",
+        ]
+        return "\n".join(lines)
+    assert summary is not None
+    regressions = summary.get("regressions") or []
+    verdict = (
+        f"REGRESSED — {len(regressions)} metric(s) past threshold"
+        if regressions else "PASS — no regression"
+    )
+    lines += [
+        f"- threshold: {summary.get('threshold')}",
+        f"- metrics compared: {summary.get('compared')}",
+        "",
+        f"## Verdict: {verdict}",
+        "",
+        "| key | kind | baseline | candidate | band | result |",
+        "|---|---|---:|---:|---|---|",
+    ]
+    for row in summary.get("rows", ()):
+        if "allowed_rel" in row:
+            band = f"+{100.0 * row['allowed_rel']:.0f}%"
+        elif "allowed_slack" in row:
+            band = f"+{row['allowed_slack']}"
+        else:
+            band = "higher-is-better"
+        lines.append(
+            f"| `{row['key']}` | {row['kind']} | {row['baseline']:.6g} "
+            f"| {row['candidate']:.6g} | {band} "
+            f"| {'**REGRESSED**' if row['regressed'] else 'ok'} |"
+        )
+    if regressions:
+        lines += ["", "Regressed keys: " + ", ".join(f"`{k}`" for k in regressions)]
+    return "\n".join(lines)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="diff two bench JSONs for perf regressions"
@@ -333,7 +391,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="compare runs measured on different backends (cross-platform "
         "numbers are not regression-gateable; see module docstring)",
     )
+    ap.add_argument(
+        "--report", action="store_true",
+        help="print the diff as a markdown report on stdout (per-key table, "
+        "verdict, refusal reasons) instead of the JSON summary line",
+    )
     args = ap.parse_args(argv)
+
+    def _refuse(msg: str) -> int:
+        """Print a schema/backend/telemetry refusal (exit 3); with
+        ``--report`` also render it as markdown so CI surfaces WHY the
+        gate refused instead of a bare exit code."""
+        print(msg, file=sys.stderr)
+        if args.report:
+            print(render_markdown(None, args.baseline, args.candidate, refusal=msg))
+        return 3
 
     try:
         with open(args.baseline) as f:
@@ -350,20 +422,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     b_meta, b_perf, b_metric = _schema_of(base)
     c_meta, c_perf, c_metric = _schema_of(cand)
     if b_meta != c_meta or b_perf != c_perf:
-        print(
+        return _refuse(
             f"perf_diff: SCHEMA REFUSAL — baseline meta/perf schema "
             f"({b_meta}, {b_perf}) != candidate ({c_meta}, {c_perf}); "
-            "re-run both sides on one schema before diffing",
-            file=sys.stderr,
+            "re-run both sides on one schema before diffing"
         )
-        return 3
     if b_metric != c_metric and not args.allow_metric_mismatch:
-        print(
+        return _refuse(
             f"perf_diff: SCHEMA REFUSAL — metric {b_metric!r} vs "
-            f"{c_metric!r} (pass --allow-metric-mismatch to override)",
-            file=sys.stderr,
+            f"{c_metric!r} (pass --allow-metric-mismatch to override)"
         )
-        return 3
 
     def _backend_of(doc: Dict[str, Any]) -> Tuple[Any, Any]:
         meta = doc.get("meta") or {}
@@ -381,15 +449,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         # Refuse loudly instead of noise-gating: a TPU baseline diffed
         # against a CPU-fallback candidate reports a 100x "regression" that
         # is actually a platform change.
-        print(
+        return _refuse(
             "perf_diff: BACKEND REFUSAL — baseline measured on "
             f"{_label(b_backend, b_why)} but candidate on "
             f"{_label(c_backend, c_why)}; cross-platform timings are not "
             "comparable. Re-run both sides on one backend, or pass "
-            "--allow-backend-mismatch to compare anyway (not gateable).",
-            file=sys.stderr,
+            "--allow-backend-mismatch to compare anyway (not gateable)."
         )
-        return 3
 
     b_devobs = (base.get("perf") or {}).get("devobs")
     c_devobs = (cand.get("perf") or {}).get("devobs")
@@ -402,14 +468,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         # telemetry-off run compares different programs, and skipping the
         # section would report "no devobs regression" without comparing
         # anything. Re-run the lacking side with DEVOBS_ENABLED matching.
-        print(
+        return _refuse(
             f"perf_diff: DEVOBS REFUSAL — {have} carries a perf.devobs "
             f"section but {lack} does not; one side ran with device "
             "observability the other lacked. Re-run both sides with the "
-            "same P2PFL_TPU_DEVOBS_ENABLED setting before diffing.",
-            file=sys.stderr,
+            "same P2PFL_TPU_DEVOBS_ENABLED setting before diffing."
         )
-        return 3
 
     b_sup = (base.get("perf") or {}).get("supervisor")
     c_sup = (cand.get("perf") or {}).get("supervisor")
@@ -422,14 +486,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         # writes the unsupervised run does not — diffing them compares
         # different programs, and skipping the section would report "no
         # supervisor regression" without comparing anything.
-        print(
+        return _refuse(
             f"perf_diff: SUPERVISOR REFUSAL — {have} carries a "
             f"perf.supervisor section but {lack} does not; one side ran "
             "under the engine supervisor the other lacked. Re-run both "
-            "sides through bench.py --soak (or neither) before diffing.",
-            file=sys.stderr,
+            "sides through bench.py --soak (or neither) before diffing."
         )
-        return 3
 
     summary = compare(
         base, cand,
@@ -445,7 +507,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"{row['candidate']:.6g}  [{row['kind']}] {flag}",
             file=sys.stderr,
         )
-    print(json.dumps(summary))
+    if args.report:
+        print(render_markdown(summary, args.baseline, args.candidate))
+    else:
+        print(json.dumps(summary))
     if summary["regressions"]:
         print(
             f"perf_diff: {len(summary['regressions'])} regression(s): "

@@ -56,3 +56,24 @@ def _reset_memory_transport():
         InMemoryRegistry.reset()
     except ImportError:
         pass
+
+
+@pytest.fixture(autouse=True)
+def _reset_run_context(tmp_path):
+    """Each test starts without an ambient run id or stale live flight
+    recorders: a run id established (or a recorder created) by one test
+    must not correlate — or leak into the evidence bundles of — the next.
+    Evidence bundles default into the test's tmp dir so failure-path
+    tests (parks, trips, campaign errors) never litter ``artifacts/``."""
+    from p2pfl_tpu.config import Settings
+
+    with Settings.overridden(DOCTOR_BUNDLE_DIR=str(tmp_path / "bundles")):
+        yield
+    try:
+        from p2pfl_tpu.telemetry.bundle import reset_run
+        from p2pfl_tpu.telemetry.flight_recorder import reset_live_recorders
+
+        reset_run()
+        reset_live_recorders()
+    except ImportError:
+        pass

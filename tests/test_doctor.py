@@ -1,0 +1,545 @@
+"""Diagnosis plane (fed_doctor): run ids, evidence bundles, rule catalog.
+
+Covers: the federation-wide run id (seeded-deterministic mint, first-
+establish-wins, the gRPC ``__run__:`` reserved control arg, LEDGERS-pin
+adoption); bundle COMPLETENESS on every dump-on-failure path — workflow
+exception on both wire schedulers, supervisor park (runtime and trip
+kinds), devobs tripwire in park and abort action on both fused engines,
+campaign invariant violation — each asserting the manifest lists the
+expected members under the matching run id; the end-to-end correlation
+contract (one run id stamped across ledger dumps, flight-recorder dumps,
+observatory snapshots, supervisor reports, bench meta in an 8-node run);
+manifest determinism; the happy-path zero-cost contract (no bundle unless
+triggered); and diagnosis rule units on synthesized evidence.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+
+import pytest
+
+from p2pfl_tpu.config import Settings
+from p2pfl_tpu.telemetry import REGISTRY, bundle, diagnosis
+from p2pfl_tpu.telemetry.bundle import (
+    WIRE_ARG_PREFIX,
+    artifact_header,
+    comparable_manifest,
+    current_run_id,
+    establish_run,
+    load_manifest,
+    write_bundle,
+)
+from p2pfl_tpu.telemetry.diagnosis import Evidence, diagnose
+from p2pfl_tpu.telemetry.ledger import LEDGERS
+
+_SHAPE = dict(
+    cohort_fraction=0.5, cohort_min=2, seed=11,
+    samples_per_node=8, feature_dim=8, hidden=(4,), batch_size=4,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledgers():
+    LEDGERS.reset()
+    yield
+    LEDGERS.reset()
+
+
+def _member_names(bundle_dir):
+    man = load_manifest(bundle_dir)
+    assert man is not None, f"no manifest in {bundle_dir}"
+    return man, sorted(m["name"] for m in man["members"])
+
+
+def _one_bundle(root):
+    dirs = [d for d in glob.glob(os.path.join(root, "bundle_*")) if os.path.isdir(d)]
+    assert dirs, f"no bundle under {root}"
+    assert len(dirs) == 1, dirs
+    return dirs[0]
+
+
+# --- run-id plane -------------------------------------------------------------
+
+
+def test_seeded_mint_is_deterministic_with_host_suffix():
+    a = bundle.mint_run_id(seed=42, name="engine")
+    b = bundle.mint_run_id(seed=42, name="engine")
+    assert a == b and len(a) == 17 and a[12] == "-"
+    assert bundle.mint_run_id(seed=43, name="engine") != a
+    # unseeded mints are unique
+    assert bundle.mint_run_id() != bundle.mint_run_id()
+
+
+def test_establish_first_wins_and_fresh_overrides():
+    rid = establish_run(seed=5, name="engine")
+    assert establish_run(seed=999, name="other") == rid  # first wins
+    assert current_run_id() == rid
+    rid2 = establish_run(fresh=True)
+    assert rid2 != rid and current_run_id() == rid2
+
+
+def test_settings_pin_beats_everything():
+    with Settings.overridden(RUN_ID="pinned-by-ci"):
+        assert establish_run(seed=1) == "pinned-by-ci"
+        assert current_run_id() == "pinned-by-ci"
+
+
+def test_ledgers_pin_adopted_by_engine_establish():
+    LEDGERS.configure("campaign-pinned")
+    assert establish_run(seed=3, name="engine") == "campaign-pinned"
+
+
+def test_adopt_requires_force_unless_unset():
+    rid = establish_run(seed=7, name="engine")
+    bundle.adopt_run_id("other-federation", force=False)
+    assert current_run_id() == rid  # non-start_learning frames can't steal it
+    bundle.adopt_run_id("other-federation", force=True)
+    assert current_run_id() == "other-federation"
+
+
+def test_run_id_rides_grpc_reserved_control_arg():
+    pytest.importorskip("grpc")
+    from p2pfl_tpu.comm.envelope import Envelope
+    from p2pfl_tpu.comm.grpc.grpc_protocol import _env_to_pb, _pb_to_env
+
+    rid = establish_run(seed=9, name="engine")
+    env = Envelope.message("127.0.0.1:1", "vote_train_set", args=["a", "5"], round=1)
+    assert env.run_id == rid
+    pb = _env_to_pb(env)
+    assert any(a == WIRE_ARG_PREFIX + rid for a in pb.control.args)
+    back = _pb_to_env(pb)
+    assert back.run_id == rid
+    assert back.args == ["a", "5"]  # sentinel stripped before dispatch
+
+    # absence-tolerant: a pre-run-id peer's frame decodes with run_id == ""
+    bare = Envelope(source="n1", cmd="beat", args=["1.0"], ttl=3, msg_id=7)
+    assert _pb_to_env(_env_to_pb(bare)).run_id == ""
+
+
+def test_artifact_header_shape():
+    establish_run(seed=4, name="engine")
+    h = artifact_header(node="n0", kind="flightrec", schema_version=2)
+    assert h["run_id"] == current_run_id()
+    assert h["schema_version"] == 2 and h["kind"] == "flightrec"
+    assert set(h["clock"]) == {"wall", "mono", "mono_to_wall_epoch"}
+
+
+# --- bundle completeness on every dump-on-failure path ------------------------
+
+
+def _crash_workflow(tmp_path, mode):
+    """Run a real 2-node in-memory federation whose scheduler entry stage
+    raises, then return the bundle its crash hook captured."""
+    from p2pfl_tpu.learning.dataset import (
+        RandomIIDPartitionStrategy,
+        synthetic_mnist,
+    )
+    from p2pfl_tpu.models import mlp_model
+    from p2pfl_tpu.node import Node
+
+    if mode == "sync":
+        from p2pfl_tpu.stages.base_node import StartLearningStage as Entry
+    else:
+        from p2pfl_tpu.stages.async_node import AsyncStartStage as Entry
+
+    from p2pfl_tpu.utils.utils import wait_convergence
+
+    def boom(node):
+        raise RuntimeError(f"synthetic {mode} scheduler crash")
+
+    orig = Entry.execute
+    Entry.execute = staticmethod(boom)
+    data = synthetic_mnist(n_train=64, n_test=16)
+    parts = data.generate_partitions(2, RandomIIDPartitionStrategy)
+    nodes = [Node(mlp_model(seed=i), parts[i], batch_size=8) for i in range(2)]
+    try:
+        with Settings.overridden(DOCTOR_BUNDLE_DIR=str(tmp_path)):
+            for n in nodes:
+                n.start()
+            nodes[1].connect(nodes[0].addr)
+            wait_convergence(nodes, 1, only_direct=False, wait=8.0)
+            nodes[0].set_start_learning(rounds=1, epochs=1, mode=mode)
+            deadline = time.time() + 30.0
+            while time.time() < deadline:
+                dirs = glob.glob(os.path.join(str(tmp_path), "bundle_*"))
+                if dirs and os.path.exists(os.path.join(dirs[0], "manifest.json")):
+                    return dirs[0]
+                time.sleep(0.2)
+            raise AssertionError("workflow crash produced no bundle")
+    finally:
+        Entry.execute = orig
+        for n in nodes:
+            n.stop()
+
+
+@pytest.mark.parametrize("mode", ["sync", "async"])
+def test_workflow_crash_bundles_complete(tmp_path, mode):
+    out = _crash_workflow(tmp_path, mode)
+    man, names = _member_names(out)
+    assert man["trigger"] == "workflow_crash"
+    assert man["run_id"]  # the initiator minted one at set_start_learning
+    assert "context.json" in names
+    assert "metrics.json" in names and "metrics.prom" in names
+    assert any(n.startswith("flightrec_") for n in names)
+    ctx = json.load(open(os.path.join(out, "context.json")))
+    assert ctx["header"]["run_id"] == man["run_id"]
+    assert ctx["error"]["type"] == "RuntimeError"
+    assert f"synthetic {mode} scheduler crash" in ctx["error"]["message"]
+    # the flight recorder rings rode along under the same run id
+    for fr in glob.glob(os.path.join(out, "flightrec_*.json")):
+        doc = json.load(open(fr))
+        assert doc["header"]["run_id"] == man["run_id"]
+        assert any(e.get("kind") == "workflow_crash" for e in doc["events"])
+
+
+def test_supervisor_park_bundle_complete(tmp_path):
+    from p2pfl_tpu.management.checkpoint import FLCheckpointer
+    from p2pfl_tpu.population import EngineSupervisor, PopulationEngine
+
+    class _FailingEngine(PopulationEngine):
+        def run(self, *a, **kw):
+            raise RuntimeError("synthetic chunk failure")
+
+    def factory(**kw):
+        args = dict(num_nodes=6, **_SHAPE)
+        args.update(kw)
+        return _FailingEngine(**args)
+
+    with Settings.overridden(DOCTOR_BUNDLE_DIR=str(tmp_path / "bundles")):
+        ck = FLCheckpointer(str(tmp_path / "ck"))
+        with EngineSupervisor(
+            factory, ck, node="sup-park", max_retries=0,
+            backoff_s=0.0, degrade="off",
+        ) as sup:
+            report = sup.run(2, chunk=1)
+    assert report.parked and report.park_reason == "runtime"
+    assert report.run_id  # report carries the run id
+    out = _one_bundle(str(tmp_path / "bundles"))
+    man, names = _member_names(out)
+    assert man["trigger"] == "supervisor_park"
+    assert man["run_id"] == report.run_id
+    assert "context.json" in names and "metrics.json" in names
+    assert any(n.startswith("flightrec_") for n in names)
+    ctx = json.load(open(os.path.join(out, "context.json")))
+    assert ctx["context"]["reason"] == "runtime"
+
+
+def test_supervisor_trip_park_bundle(tmp_path):
+    """Supervised devobs trip: the engine's devobs_trip hook fires first,
+    then the supervisor's trip-kind park captures its own evidence —
+    both land in the run's (shared) bundle directory."""
+    from p2pfl_tpu.management.checkpoint import FLCheckpointer
+    from p2pfl_tpu.population import EngineSupervisor, PopulationEngine
+
+    def factory(**kw):
+        args = dict(num_nodes=6, **_SHAPE)
+        args.update(kw)
+        return PopulationEngine(**args)
+
+    with Settings.overridden(
+        DOCTOR_BUNDLE_DIR=str(tmp_path / "bundles"),
+        DEVOBS_ENABLED=True,
+        DEVOBS_NAN_INJECT_ROUND=1,
+        DEVOBS_TRIP_ACTION="park",
+    ):
+        ck = FLCheckpointer(str(tmp_path / "ck"))
+        with EngineSupervisor(
+            factory, ck, node="sup-trip", max_retries=0,
+            backoff_s=0.0, degrade="off",
+        ) as sup:
+            report = sup.run(2, chunk=2)
+    assert report.parked and report.park_reason.startswith("trip")
+    out = _one_bundle(str(tmp_path / "bundles"))
+    man, names = _member_names(out)
+    assert man["run_id"] == report.run_id
+    # last writer wins on the shared per-run dir: either trigger is
+    # acceptable, both must have left a complete member set
+    assert man["trigger"] in ("devobs_trip", "supervisor_park")
+    assert "context.json" in names and "metrics.json" in names
+    triggers = {
+        labels.get("trigger")
+        for labels, _child in REGISTRY.get("p2pfl_doctor_bundles_total").samples()
+    }
+    assert {"devobs_trip", "supervisor_park"} <= triggers
+
+
+@pytest.mark.parametrize("engine_kind", ["sync", "async"])
+@pytest.mark.parametrize("action", ["park", "abort"])
+def test_devobs_trip_bundle_both_engines_both_actions(
+    tmp_path, engine_kind, action
+):
+    from p2pfl_tpu.population import AsyncPopulationEngine, PopulationEngine
+
+    with Settings.overridden(
+        DOCTOR_BUNDLE_DIR=str(tmp_path),
+        DEVOBS_ENABLED=True,
+        DEVOBS_NAN_INJECT_ROUND=2,
+        DEVOBS_TRIP_ACTION=action,
+    ):
+        if engine_kind == "sync":
+            with PopulationEngine(6, **_SHAPE) as eng:
+                rid = current_run_id()
+                if action == "abort":
+                    with pytest.raises(RuntimeError, match="devobs tripwire"):
+                        eng.run(6, rounds_per_call=2)
+                else:
+                    res = eng.run(6, rounds_per_call=2)
+                    assert res.tripped is not None
+        else:
+            with AsyncPopulationEngine(6, **_SHAPE) as eng:
+                rid = current_run_id()
+                if action == "abort":
+                    with pytest.raises(RuntimeError, match="devobs tripwire"):
+                        eng.run(6, eval_every=6, windows_per_call=2)
+                else:
+                    res = eng.run(6, eval_every=6, windows_per_call=2)
+                    assert res.tripped is not None
+    out = _one_bundle(str(tmp_path))
+    man, names = _member_names(out)
+    assert man["trigger"] == "devobs_trip"
+    assert man["run_id"] == rid
+    assert "context.json" in names and "metrics.json" in names
+    ctx = json.load(open(os.path.join(out, "context.json")))
+    assert ctx["context"]["kind"] == "nonfinite"
+    # the diagnosis engine attributed the trip
+    inc = json.load(open(os.path.join(out, "incident.json")))
+    assert inc["top"] == "device_tripwire"
+
+
+def test_campaign_violation_bundle(tmp_path, monkeypatch):
+    from p2pfl_tpu.campaigns import engine as campaign_engine
+    from p2pfl_tpu.population import scenarios as scn_mod
+
+    def explode(scn, ledger_dir=None):
+        raise RuntimeError("synthetic scenario failure")
+
+    monkeypatch.setattr(scn_mod, "run_scenario_wire", explode)
+    with Settings.overridden(DOCTOR_BUNDLE_DIR=str(tmp_path)):
+        report = campaign_engine.run_campaign(seed=3, n_scenarios=1)
+    assert report["violations_total"] >= 1
+    entry = report["scenarios"][0]
+    assert entry["verdict"] == "error"
+    assert entry["bundle"] and os.path.isdir(entry["bundle"])
+    man, names = _member_names(entry["bundle"])
+    assert man["trigger"] == "campaign_violation"
+    assert man["run_id"] == entry["run_id"]  # scenario's pinned run id
+    assert "context.json" in names
+    ctx = json.load(open(os.path.join(entry["bundle"], "context.json")))
+    assert ctx["error"]["type"] == "RuntimeError"
+
+
+def _load_bench(alias):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        alias,
+        os.path.join(os.path.dirname(os.path.dirname(__file__)), "bench.py"),
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    return bench
+
+
+def test_bench_meta_carries_run_id():
+    bench = _load_bench("bench_for_doctor")
+    establish_run(seed=12, name="engine")
+    assert bench._bench_meta(seed=12)["run_id"] == current_run_id()
+
+
+# --- end-to-end correlation (acceptance: one run id across everything) --------
+
+
+def test_8node_run_one_run_id_across_all_artifacts(tmp_path):
+    from p2pfl_tpu.management.checkpoint import FLCheckpointer
+    from p2pfl_tpu.population import EngineSupervisor, PopulationEngine
+    from p2pfl_tpu.telemetry.flight_recorder import FlightRecorder
+
+    def factory(**kw):
+        args = dict(num_nodes=8, **_SHAPE)
+        args.update(kw)
+        return PopulationEngine(**args)
+
+    ck = FLCheckpointer(str(tmp_path / "ck"))
+    snap_path = os.path.join(str(tmp_path), "federation_snapshot.json")
+    with EngineSupervisor(factory, ck, node="sup-corr", backoff_s=0.0) as sup:
+        report = sup.run(2, chunk=1)
+        snap = sup.snapshot(report.results[-1], top_n=4, path=snap_path)
+    rid = report.run_id
+    assert rid, "supervised run must establish a run id"
+    assert current_run_id() == rid
+
+    # 1. supervisor report + snapshot
+    assert snap["supervisor"]["run_id"] == rid
+    # 2. observatory snapshot doc header (write_snapshot_doc choke point)
+    doc = json.load(open(snap_path))
+    assert doc["header"]["run_id"] == rid
+    # 3. trajectory ledger dump headers
+    paths = LEDGERS.dump_all(str(tmp_path / "ledgers"))
+    assert paths
+    for p in paths:
+        head = json.loads(open(p).readline())
+        assert head["run_id"] == rid, p
+    # 4. flight-recorder dump header
+    rec = FlightRecorder("corr-node")
+    rec.record("stage", stage="x")
+    fr_path = rec.dump("manual", directory=str(tmp_path))
+    assert json.load(open(fr_path))["header"]["run_id"] == rid
+    # 5. bench meta block
+    assert _load_bench("bench_for_corr")._bench_meta()["run_id"] == rid
+    # 6. an explicitly-requested bundle joins them all under that id
+    with Settings.overridden(DOCTOR_BUNDLE_DIR=str(tmp_path / "bundles")):
+        out = write_bundle("manual")
+    man, _ = _member_names(out)
+    assert man["run_id"] == rid
+
+
+# --- manifest & happy-path contracts ------------------------------------------
+
+
+def test_manifest_determinism_and_excluded_isolation(tmp_path):
+    establish_run(run_id="det-run")
+    LEDGERS.emit("n0", "round_open", round=1)
+    with Settings.overridden(DOCTOR_BUNDLE_DIR=str(tmp_path / "a")):
+        out_a = write_bundle("manual")
+    with Settings.overridden(DOCTOR_BUNDLE_DIR=str(tmp_path / "b")):
+        out_b = write_bundle("manual")
+    man_a, man_b = load_manifest(out_a), load_manifest(out_b)
+    # wall-clock lives ONLY in the excluded section
+    assert "written_at" in man_a["excluded"]
+    assert comparable_manifest(man_a) == comparable_manifest(man_b)
+    # canonical ledger members carry sha256 in the comparable part, and the
+    # bytes really are identical
+    led = [m for m in man_a["members"] if m["kind"] == "ledger"]
+    assert led and all("sha256" in m for m in led)
+
+
+def test_happy_path_writes_no_bundle(tmp_path):
+    """No failure, no bundle: a clean engine run must not create bundle
+    dirs (the <= 1.02x overhead acceptance is 'zero artifacts unless
+    triggered')."""
+    from p2pfl_tpu.population import PopulationEngine
+
+    with Settings.overridden(DOCTOR_BUNDLE_DIR=str(tmp_path)):
+        with PopulationEngine(6, **_SHAPE) as eng:
+            eng.run(2, rounds_per_call=2)
+    assert not glob.glob(os.path.join(str(tmp_path), "bundle_*"))
+
+
+def test_bundle_disabled_master_switch(tmp_path):
+    with Settings.overridden(
+        DOCTOR_BUNDLE_DIR=str(tmp_path), DOCTOR_BUNDLE_ENABLED=False
+    ):
+        assert write_bundle("manual") is None
+    assert not glob.glob(os.path.join(str(tmp_path), "bundle_*"))
+
+
+# --- diagnosis rule units -----------------------------------------------------
+
+
+def test_clean_evidence_yields_no_findings():
+    assert diagnose(Evidence()) == []
+
+
+def test_codec_storm_routes_away_from_byzantine():
+    ev = Evidence()
+    ev.ledgers["n0"] = [
+        {"kind": "admission_rejected", "round": r, "sender": f"n{r}",
+         "reason": "decode_error"}
+        for r in (1, 2, 3)
+    ]
+    fs = diagnose(ev)
+    assert [f.rule for f in fs] == ["codec_corruption_storm"]
+
+
+def test_byzantine_burst_with_corroboration():
+    ev = Evidence()
+    ev.ledgers["n0"] = [
+        {"kind": "admission_rejected", "round": r, "sender": "adv",
+         "reason": "norm_screen"}
+        for r in (1, 2, 3)
+    ]
+    ev.snapshot = {"peers": {"adv": {"scores": {"suspect": 3.0}}}}
+    fs = diagnose(ev)
+    assert fs[0].rule == "byzantine_active"
+    assert fs[0].confidence > 0.6
+    assert any("suspect" in e for e in fs[0].evidence)
+    assert fs[0].exonerated  # the checks that came back clean are on record
+
+
+def test_under_rejection_fires_only_with_zero_rejections():
+    ev = Evidence()
+    ev.metrics = {
+        "p2pfl_chaos_faults_total": {
+            "samples": [{"labels": {"fault": "byzantine_zero"}, "value": 2.0}]
+        }
+    }
+    assert diagnose(ev)[0].rule == "adversary_under_rejection"
+    ev.ledgers["n0"] = [
+        {"kind": "admission_rejected", "round": 1, "sender": "adv",
+         "reason": "norm_screen"},
+        {"kind": "admission_rejected", "round": 2, "sender": "adv",
+         "reason": "norm_screen"},
+    ]
+    rules = [f.rule for f in diagnose(ev)]
+    assert "adversary_under_rejection" not in rules
+    assert "byzantine_active" in rules
+
+
+def test_heartbeat_false_death_requires_no_chaos():
+    ev = Evidence()
+    ev.flightrecs["n0"] = {"node": "n0", "events": [
+        {"kind": "peer_lost", "peer": "n2"},
+        {"kind": "peer_recovered", "peer": "n2"},
+    ]}
+    assert diagnose(ev)[0].rule == "heartbeat_false_death"
+    ev.metrics = {
+        "p2pfl_chaos_faults_total": {
+            "samples": [{"labels": {"fault": "partition"}, "value": 1.0}]
+        }
+    }
+    rules = [f.rule for f in diagnose(ev)]
+    assert "heartbeat_false_death" not in rules  # the flap has a cause
+
+
+def test_parity_divergence_localizes_first_event():
+    ev = Evidence()
+    ev.parity = {
+        "status": "DIVERGED",
+        "compared_events": 17,
+        "first_divergence": {"round": 3, "kind": "aggregate_committed"},
+    }
+    f = diagnose(ev)[0]
+    assert f.rule == "parity_divergence"
+    assert f.data["first_divergence"]["round"] == 3
+
+
+def test_oom_from_context_error():
+    ev = Evidence()
+    ev.context = {"trigger": "supervisor_park",
+                  "error": {"message": "RESOURCE_EXHAUSTED: out of memory"}}
+    assert diagnose(ev)[0].rule == "oom_degrade_ladder"
+
+
+def test_min_confidence_floor_filters():
+    ev = Evidence()
+    ev.flightrecs["n0"] = {"node": "n0", "events": [
+        {"kind": "peer_lost", "peer": "n2"},
+        {"kind": "peer_recovered", "peer": "n2"},
+    ]}
+    assert diagnose(ev)  # 0.6 confidence passes the default 0.5 floor
+    with Settings.overridden(DOCTOR_MIN_CONFIDENCE=0.9):
+        assert diagnose(ev) == []
+
+
+def test_incident_doc_and_render():
+    ev = Evidence(run_id="r7")
+    ev.parity = {"status": "DIVERGED", "first_divergence": {"round": 1}}
+    findings = diagnose(ev)
+    doc = diagnosis.incident_doc(findings, run_id="r7", source="here")
+    assert doc["top"] == "parity_divergence" and doc["run_id"] == "r7"
+    text = diagnosis.render_report(doc)
+    assert "parity_divergence" in text and "run r7" in text
